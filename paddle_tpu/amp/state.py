@@ -1,0 +1,96 @@
+"""AMP autocast state consulted by eager dispatch.
+
+Analog of the reference's AMP insertion in the generated ad_func preamble
+(/root/reference/paddle/fluid/eager/amp_auto_cast.h and the per-op
+black/white lists in /root/reference/python/paddle/amp/amp_lists.py).
+bf16 is the TPU-native low precision (MXU-native), so level O1/O2 default
+to bfloat16 rather than float16.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+
+# ops that benefit from low precision (MXU-bound)
+WHITE_LIST = {
+    "matmul", "conv2d", "conv1d", "conv3d", "conv2d_transpose", "mm", "bmm",
+    "einsum", "addmm", "linear", "flash_attention", "fused_linear",
+}
+# ops that need fp32 accumulate / are numerically sensitive
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "square", "reciprocal", "rsqrt",
+    "pow", "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "mean", "sum", "norm", "cumsum", "cumprod", "layer_norm", "rms_norm",
+    "batch_norm", "group_norm", "instance_norm", "sigmoid_cross_entropy_with_logits",
+    "binary_cross_entropy", "nll_loss", "kl_div", "erf", "erfinv", "expm1",
+    "logsumexp", "var", "std",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = dtypes.bfloat16
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def amp_dtype():
+    return _state.dtype
+
+
+def is_auto_cast_enabled():
+    return _state.enabled
+
+
+def maybe_cast_inputs(opdef, arguments: dict) -> dict:
+    if not _state.enabled:
+        return arguments
+    name = opdef.name
+    policy = opdef.amp_policy
+    in_white = (policy == "white") or name in WHITE_LIST or name in _state.custom_white
+    in_black = (policy == "black") or name in BLACK_LIST or name in _state.custom_black
+    if policy == "keep":
+        return arguments
+    low = _state.dtype.np_dtype
+    if _state.level == "O2":
+        target = None if in_black else low
+        if in_black:
+            target = jnp.float32
+    else:  # O1
+        if in_white:
+            target = low
+        elif in_black:
+            target = jnp.float32
+        else:
+            return arguments
+
+    from ..core.tensor import Tensor
+    import jax
+
+    def cast_leaf(x):
+        if isinstance(x, Tensor) and jnp.issubdtype(x._data.dtype, jnp.floating):
+            if x._data.dtype != target and x._data.dtype in (
+                    jnp.float32, jnp.bfloat16, jnp.float16):
+                if not x.stop_gradient:
+                    # route through the cast op so the cotangent is cast
+                    # back and accumulates on the original (master) tensor
+                    from ..ops import cast as cast_op
+                    return cast_op(x, dtypes.from_np(target))
+                return Tensor._wrap(x._data.astype(target), stop_gradient=True)
+        return x
+
+    return jax.tree_util.tree_map(
+        cast_leaf, arguments,
+        is_leaf=lambda x: isinstance(x, Tensor))

@@ -1,0 +1,167 @@
+"""Autograd public API (ref: python/paddle/autograd/).
+
+backward() / grad() drive the tape engine in tape.py; PyLayer is the
+custom-autograd escape hatch (ref: python/paddle/autograd/py_layer.py,
+native pylayer at /root/reference/paddle/fluid/eager/pylayer/)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .tape import (  # noqa: F401
+    no_grad, enable_grad, is_grad_enabled, set_grad_enabled, run_backward,
+    GradNode, InputEdge,
+)
+from ..core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad analog (ref: GeneralGrad, fluid/eager/general_grad.h).
+
+    Returns grads of `outputs` w.r.t. `inputs` without touching .grad of
+    leaves outside `inputs`.
+    """
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    # save/restore .grad of input leaves so grad() stays side-effect free
+    saved = [t._grad for t in inputs]
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
+    results = run_backward(outputs, grad_outputs, retain_graph=retain,
+                           grad_targets=list(inputs))
+    for t, s in zip(inputs, saved):
+        t._grad = s
+    out = []
+    for i, r in enumerate(results):
+        if r is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input {i} is unreachable from outputs "
+                    "(pass allow_unused=True to return None)")
+            out.append(None)
+        else:
+            out.append(Tensor._wrap(jnp.asarray(r), stop_gradient=True))
+    return out
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd function (ref: python/paddle/autograd/py_layer.py).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle_tpu.exp(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from . import tape
+
+        ctx = PyLayerContext()
+        flat_in, in_tree = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_inputs = [l for l in flat_in if isinstance(l, Tensor)]
+        record = tape.is_grad_enabled() and any(
+            (not t.stop_gradient) for t in tensor_inputs)
+
+        with tape.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        if not record:
+            return out
+
+        edges = []
+        diff_inputs = []
+        for t in tensor_inputs:
+            if t.stop_gradient:
+                edges.append(InputEdge("stop"))
+            elif t._grad_node is not None:
+                edges.append(InputEdge("node", node=t._grad_node,
+                                       out_idx=t._out_idx))
+                diff_inputs.append(t)
+            else:
+                edges.append(InputEdge("leaf", tensor=t))
+                diff_inputs.append(t)
+
+        out_avals = [jax.ShapeDtypeStruct(o._data.shape, o._data.dtype)
+                     for o in outs]
+
+        def vjp_fn(cots):
+            grads_in = [Tensor._wrap(c, stop_gradient=True) for c in cots]
+            with tape.no_grad():
+                res = cls.backward(ctx, *grads_in)
+            if not isinstance(res, (tuple, list)):
+                res = (res,)
+            res = list(res)
+            n_t = len(tensor_inputs)
+            if len(res) != n_t:
+                # backward returns grads only for tensor inputs, in order
+                res = res + [None] * (n_t - len(res))
+            out_cots = []
+            for t, r in zip(tensor_inputs, res):
+                if r is None:
+                    out_cots.append(jnp.zeros(t._data.shape, t._data.dtype))
+                else:
+                    out_cots.append(r._data if isinstance(r, Tensor)
+                                    else jnp.asarray(r))
+            return tuple(out_cots)
+
+        node = GradNode(f"pylayer_{cls.__name__}", vjp_fn, edges, out_avals)
+        new_outs = []
+        for i, o in enumerate(outs):
+            t = Tensor._wrap(o._data, stop_gradient=False)
+            t._grad_node = node
+            t._out_idx = i
+            node.register_output(i, t)
+            new_outs.append(t)
+        return new_outs[0] if single else tuple(new_outs)

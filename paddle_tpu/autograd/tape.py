@@ -1,0 +1,285 @@
+"""Tape-based eager autograd engine.
+
+TPU-native analog of the reference's eager autograd
+(/root/reference/paddle/fluid/eager/: GradNodeBase grad_node_info.h:197,
+engine backward.cc:428/105 — reverse-topological queue with an in-degree
+map, GradTensorHolder accumulation). Here each eager op records ONE GradNode
+whose vjp is produced by `jax.vjp` over the op's pure-jnp forward — so
+every op's backward rule is derived from the same function that computed
+the forward (no 560 hand-written grad kernels), and backward itself runs
+eagerly on TPU via XLA.
+"""
+from __future__ import annotations
+
+import weakref
+from collections import defaultdict, deque
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+# --------------------------------------------------------------------------
+# global tape state (analog of eager's tracer_has_grad)
+# --------------------------------------------------------------------------
+_grad_enabled: bool = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    global _grad_enabled
+    old = _grad_enabled
+    _grad_enabled = bool(mode)
+    return old
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording
+    (ref: python/paddle/base/dygraph/base.py no_grad)."""
+
+    def __enter__(self):
+        self._old = set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._old)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._old = set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._old)
+        return False
+
+
+# --------------------------------------------------------------------------
+# graph nodes
+# --------------------------------------------------------------------------
+class InputEdge:
+    """Edge from a GradNode to one of its differentiable inputs.
+
+    kind: 'node' (input produced by parent node at out_idx),
+          'leaf' (input is a leaf tensor — accumulate into .grad),
+          'stop' (input does not require grad).
+    """
+
+    __slots__ = ("kind", "node", "out_idx", "tensor_ref")
+
+    def __init__(self, kind, node=None, out_idx=0, tensor=None):
+        self.kind = kind
+        self.node = node
+        self.out_idx = out_idx
+        self.tensor_ref = weakref.ref(tensor) if tensor is not None else None
+
+
+class GradNode:
+    __slots__ = (
+        "name", "vjp_fn", "edges", "out_avals", "out_tensor_refs",
+        "__weakref__",
+    )
+
+    def __init__(self, name: str, vjp_fn, edges: List[InputEdge],
+                 out_avals: List[Any]):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.edges = edges
+        self.out_avals = out_avals  # list of jax.ShapeDtypeStruct per output
+        self.out_tensor_refs: List[Optional[weakref.ref]] = [None] * len(out_avals)
+
+    def register_output(self, idx: int, tensor):
+        self.out_tensor_refs[idx] = weakref.ref(tensor)
+
+    def __repr__(self):
+        return f"GradNode({self.name}, n_out={len(self.out_avals)})"
+
+
+def _zero_cotangent(aval):
+    if jax.numpy.issubdtype(aval.dtype, jax.numpy.inexact):
+        return jax.numpy.zeros(aval.shape, aval.dtype)
+    return np.zeros(aval.shape, jax.dtypes.float0)
+
+
+# --------------------------------------------------------------------------
+# engine (ref: backward.cc RunBackward — in-degree map + ready queue)
+# --------------------------------------------------------------------------
+def _collect_graph(roots: Sequence[GradNode]):
+    """BFS over parent edges; returns reachable set and consumer counts."""
+    consumers = defaultdict(int)  # node -> number of edges into it
+    seen = set()
+    stack = list(roots)
+    for r in roots:
+        seen.add(id(r))
+    node_by_id = {id(r): r for r in roots}
+    while stack:
+        node = stack.pop()
+        for e in node.edges:
+            if e.kind == "node":
+                consumers[id(e.node)] += 1
+                if id(e.node) not in seen:
+                    seen.add(id(e.node))
+                    node_by_id[id(e.node)] = e.node
+                    stack.append(e.node)
+    return node_by_id, consumers
+
+
+def _accumulate(slot_map, key, idx, value):
+    slots = slot_map[key]
+    if slots[idx] is None:
+        slots[idx] = value
+    else:
+        prev = slots[idx]
+        if hasattr(value, "dtype") and value.dtype == jax.dtypes.float0:
+            pass
+        else:
+            slots[idx] = prev + value
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 grad_targets=None):
+    """Run the reverse pass from `tensors`.
+
+    grad_targets: optional list of Tensors; when given, returns the cotangent
+    reaching each target (paddle.grad semantics) instead of (in addition to)
+    accumulating leaf .grad.
+    """
+    from ..core.tensor import Tensor  # local import, avoids cycle
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # seed cotangents
+    cot = defaultdict(lambda: None)  # id(node) -> list per output
+    node_store = {}
+
+    def seed(node, idx, value):
+        if id(node) not in node_store:
+            node_store[id(node)] = node
+            cot[id(node)] = [None] * len(node.out_avals)
+        _accumulate(cot, id(node), idx, value)
+
+    target_ids = None
+    target_results = None
+    if grad_targets is not None:
+        target_ids = {id(t): i for i, t in enumerate(grad_targets)}
+        target_results = [None] * len(grad_targets)
+
+    leaf_results = {}
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        node = t._grad_node
+        if g is None:
+            if t._data.ndim != 0 and t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t._data.shape)}")
+            gval = jax.numpy.ones(t._data.shape, t._data.dtype)
+        else:
+            gval = g._data if isinstance(g, Tensor) else jax.numpy.asarray(g)
+        if node is None:
+            if not t.stop_gradient:
+                leaf_results[id(t)] = gval
+                _apply_leaf_grad(t, gval)
+                if target_ids and id(t) in target_ids:
+                    target_results[target_ids[id(t)]] = gval
+            continue
+        seed(node, t._out_idx, gval)
+        roots.append(node)
+
+    if roots:
+        node_by_id, consumers = _collect_graph(roots)
+        # ready = nodes with no unprocessed consumers within the graph
+        pending = dict(consumers)
+        queue = deque(n for nid, n in node_by_id.items()
+                      if pending.get(nid, 0) == 0)
+        while queue:
+            node = queue.popleft()
+            slots = cot.get(id(node))
+            if slots is None:
+                slots = [None] * len(node.out_avals)
+            cots = tuple(
+                s if s is not None else _zero_cotangent(a)
+                for s, a in zip(slots, node.out_avals)
+            )
+            # fire tensor hooks / retain_grad on this node's outputs
+            cots = list(cots)
+            for i, ref in enumerate(node.out_tensor_refs):
+                t = ref() if ref is not None else None
+                if t is None:
+                    continue
+                if t._hooks:
+                    for h in t._hooks.values():
+                        new = h(Tensor._wrap(cots[i]))
+                        if new is not None:
+                            cots[i] = new._data if isinstance(new, Tensor) else new
+                if t._retain_grad or (target_ids and id(t) in target_ids):
+                    if target_ids and id(t) in target_ids:
+                        r = target_results[target_ids[id(t)]]
+                        target_results[target_ids[id(t)]] = (
+                            cots[i] if r is None else r + cots[i])
+                    if t._retain_grad:
+                        _apply_leaf_grad(t, cots[i])
+            # dispatch always builds vjp over a flat-tuple-output function,
+            # so the cotangent argument is always a tuple
+            in_cots = node.vjp_fn(tuple(cots))
+            if not isinstance(in_cots, (tuple, list)):
+                in_cots = (in_cots,)
+            assert len(in_cots) == len(node.edges), (
+                f"{node}: vjp returned {len(in_cots)} cotangents for "
+                f"{len(node.edges)} edges")
+            for e, g in zip(node.edges, in_cots):
+                if e.kind == "stop":
+                    continue
+                if e.kind == "leaf":
+                    t = e.tensor_ref() if e.tensor_ref is not None else None
+                    if t is not None:
+                        if t._hooks:
+                            for h in t._hooks.values():
+                                new = h(Tensor._wrap(g))
+                                if new is not None:
+                                    g = new._data if isinstance(new, Tensor) else new
+                        if target_ids and id(t) in target_ids:
+                            i = target_ids[id(t)]
+                            r = target_results[i]
+                            target_results[i] = g if r is None else r + g
+                        _apply_leaf_grad(t, g)
+                else:
+                    seed(e.node, e.out_idx, g)
+                    pending[id(e.node)] -= 1
+                    if pending[id(e.node)] == 0:
+                        queue.append(e.node)
+            if not retain_graph:
+                node.vjp_fn = None  # release residuals
+            cot.pop(id(node), None)
+
+    if grad_targets is not None:
+        return target_results
+    return None
+
+
+def _apply_leaf_grad(tensor, g):
+    """Accumulate cotangent into tensor.grad (GradTensorHolder analog)."""
+    from ..core.tensor import Tensor
+
+    if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+        return
+    if tensor._grad is None:
+        tensor._grad = Tensor._wrap(jax.numpy.asarray(g), stop_gradient=True)
+    else:
+        tensor._grad = Tensor._wrap(tensor._grad._data + g, stop_gradient=True)

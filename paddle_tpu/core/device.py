@@ -1,0 +1,118 @@
+"""Device / place abstraction.
+
+TPU-native analog of the reference's Place zoo
+(/root/reference/paddle/fluid/pybind/place.cc — CPUPlace/CUDAPlace/XPUPlace/
+CustomPlace) and paddle.device.set_device
+(/root/reference/python/paddle/device/__init__.py:265).
+
+Here a Place names a jax device. The default place follows jax's default
+backend (TPU when present, else CPU); `set_device("tpu:0")` pins eager op
+outputs to that device.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = jax.devices() if self.device_type != "cpu" else jax.devices("cpu")
+        if self.device_type == "cpu":
+            return devs[self.device_id]
+        return jax.devices()[self.device_id]
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+
+class CPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("cpu", device_id)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+# CUDAPlace alias kept for API familiarity: maps to the accelerator place.
+CUDAPlace = TPUPlace
+
+_current_place: Place | None = None
+
+
+def _default_device_type() -> str:
+    try:
+        plat = jax.default_backend()
+    except Exception:
+        return "cpu"
+    if plat in ("tpu", "axon"):
+        return "tpu"
+    return "cpu" if plat == "cpu" else plat
+
+
+def get_device() -> str:
+    p = get_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def get_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = Place(_default_device_type(), 0)
+    return _current_place
+
+
+def set_device(device: str) -> Place:
+    """Accepts "tpu", "tpu:1", "cpu", "gpu" (alias of the accelerator)."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    dev = device.lower()
+    if ":" in dev:
+        kind, idx = dev.split(":")
+        idx = int(idx)
+    else:
+        kind, idx = dev, 0
+    if kind in ("gpu", "cuda", "xpu", "tpu", "axon"):
+        kind = _default_device_type() if _default_device_type() != "cpu" else "cpu"
+        # when no accelerator exists, fall back to cpu transparently
+        if kind == "cpu" and dev.split(":")[0] != "cpu":
+            kind = "cpu"
+    _current_place = Place(kind, idx)
+    return _current_place
+
+
+def is_compiled_with_cuda() -> bool:  # API-compat shim
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return _default_device_type() == "tpu"
+
+
+def device_count() -> int:
+    return len(jax.devices())

@@ -1,0 +1,101 @@
+"""Data types for paddle_tpu.
+
+TPU-native analog of the reference's dtype surface
+(/root/reference/paddle/phi/common/data_type.h): a small DType wrapper over
+numpy/jax dtypes, with the canonical singletons exported at package level
+(paddle_tpu.float32, ...). bfloat16 is first-class (TPU MXU native).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DType:
+    """A framework dtype: thin, hashable wrapper over a jnp dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = jnp.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        try:
+            return self.np_dtype == jnp.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def is_floating_point(self):
+        return jnp.issubdtype(self.np_dtype, jnp.floating)
+
+    @property
+    def is_integer(self):
+        return jnp.issubdtype(self.np_dtype, jnp.integer)
+
+    @property
+    def is_complex(self):
+        return jnp.issubdtype(self.np_dtype, jnp.complexfloating)
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", jnp.bool_)
+uint8 = DType("uint8", jnp.uint8)
+int8 = DType("int8", jnp.int8)
+int16 = DType("int16", jnp.int16)
+int32 = DType("int32", jnp.int32)
+int64 = DType("int64", jnp.int64)
+float16 = DType("float16", jnp.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", jnp.float32)
+float64 = DType("float64", jnp.float64)
+complex64 = DType("complex64", jnp.complex64)
+complex128 = DType("complex128", jnp.complex128)
+float8_e4m3 = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
+
+_ALL = [
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3, float8_e5m2,
+]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NP = {d.np_dtype: d for d in _ALL}
+
+
+def to_dtype(x) -> DType:
+    """Coerce str / np.dtype / jnp dtype / DType to a DType."""
+    if isinstance(x, DType):
+        return x
+    if isinstance(x, str):
+        if x in _BY_NAME:
+            return _BY_NAME[x]
+        return from_np(np.dtype(x))
+    return from_np(jnp.dtype(x))
+
+
+def from_np(np_dtype) -> DType:
+    np_dtype = jnp.dtype(np_dtype)
+    d = _BY_NP.get(np_dtype)
+    if d is None:
+        d = DType(np_dtype.name, np_dtype)
+        _BY_NP[np_dtype] = d
+        _BY_NAME[np_dtype.name] = d
+    return d
+
+
+def to_jnp(x):
+    """Coerce any dtype-like to the underlying jnp dtype."""
+    return to_dtype(x).np_dtype

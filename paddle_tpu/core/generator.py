@@ -1,0 +1,95 @@
+"""RNG generator.
+
+Analog of the reference's phi::Generator (/root/reference/paddle/phi/core/
+generator.h) rebuilt on JAX's splittable PRNG: a Generator owns a root key
+and an offset counter; every random op draws a fresh fold of the key, so
+eager randomness is reproducible from `seed()` while remaining functional
+underneath (trace-safe).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._offset = 0
+            self._root = jax.random.PRNGKey(int(seed))
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def next_key(self):
+        """Return a fresh PRNG key (deterministic stream from the seed)."""
+        with self._lock:
+            off = self._offset
+            self._offset += 1
+        return jax.random.fold_in(self._root, off)
+
+    def get_state(self):
+        return (self._seed, self._offset)
+
+    def set_state(self, state):
+        self._seed, self._offset = state
+        self._root = jax.random.PRNGKey(int(self._seed))
+        return self
+
+
+class _RngScope:
+    """Functional RNG scope for traced code: while active, next_key() folds
+    from the scope's (possibly traced) base key, so a jitted train step that
+    threads a per-step key re-randomizes every step instead of baking the
+    eager key in as a constant (TP-safe dropout discipline — analog of the
+    reference's RNGStatesTracker, mpu/random.py:34, comes on top of this in
+    distributed/mpu)."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.counter = 0
+
+
+_scope_stack: list = []
+
+
+class rng_scope:
+    def __init__(self, base_key):
+        self._scope = _RngScope(base_key)
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed analog: reset the global generator."""
+    return _default_generator.manual_seed(value)
+
+
+def next_key():
+    if _scope_stack:
+        scope = _scope_stack[-1]
+        k = jax.random.fold_in(scope.base_key, scope.counter)
+        scope.counter += 1
+        return k
+    return _default_generator.next_key()

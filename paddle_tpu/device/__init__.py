@@ -1,0 +1,119 @@
+"""paddle_tpu.device (ref: python/paddle/device/__init__.py — set_device:265,
+Stream:617/Event:445). On TPU, streams/events are owned by XLA; the classes
+keep API parity and expose synchronization via jax block_until_ready."""
+from __future__ import annotations
+
+import jax
+
+from ..core.device import (  # noqa: F401
+    set_device, get_device, get_place, Place, CPUPlace, TPUPlace, CUDAPlace,
+    device_count, is_compiled_with_cuda, is_compiled_with_tpu,
+)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def synchronize(device=None):
+    # XLA queues are flushed by blocking on a trivial transfer
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """API-parity stream object; XLA owns real stream assignment
+    (the reference's StreamAnalyzer role is inside the compiler here)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        self.device = device
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+class cuda:  # namespace shim: paddle.device.cuda.*
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def empty_cache():
+        pass

@@ -1,0 +1,265 @@
+"""Probability distributions (ref: python/paddle/distribution/)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.generator import next_key
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32)
+
+
+def _wrap(a):
+    return Tensor._wrap(a)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(jnp.square(self.scale),
+                                      self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        z = jax.random.normal(next_key(), shape)
+        return _wrap(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = jnp.square(self.scale)
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _wrap(0.5 + 0.5 * math.log(2 * math.pi)
+                     + jnp.log(self.scale)
+                     + jnp.zeros(self.batch_shape))
+
+    def cdf(self, value):
+        return _wrap(0.5 * (1 + jax.scipy.special.erf(
+            (_arr(value) - self.loc) / (self.scale * math.sqrt(2)))))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        return _wrap(jnp.where(inside, -jnp.log(self.high - self.low),
+                               -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low)
+                     + jnp.zeros(self.batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.maximum(_arr(probs), 1e-30))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _wrap(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.categorical(next_key(), self.logits,
+                                            shape=shape).astype(jnp.int64))
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        idx = _arr(value).astype(jnp.int32)
+        return _wrap(jnp.take_along_axis(logp, idx[..., None],
+                                         axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return _wrap(-jnp.sum(p * logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.bernoulli(
+            next_key(), jnp.broadcast_to(self.probs_arr, shape))
+            .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = self.probs_arr
+        return _wrap(v * jnp.log(jnp.maximum(p, 1e-30)) +
+                     (1 - v) * jnp.log(jnp.maximum(1 - p, 1e-30)))
+
+    def entropy(self):
+        p = self.probs_arr
+        return _wrap(-(p * jnp.log(jnp.maximum(p, 1e-30)) +
+                       (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-30))))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.beta(next_key(), self.alpha, self.beta,
+                                     shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return _wrap((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.gamma(next_key(), self.concentration, shape)
+                     / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, r = self.concentration, self.rate
+        return _wrap(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                     - jax.scipy.special.gammaln(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _wrap(jax.random.dirichlet(next_key(), self.concentration,
+                                          shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a = self.concentration
+        lognorm = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                   - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+        return _wrap(jnp.sum((a - 1) * jnp.log(v), -1) - lognorm)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape[:-1],
+                         self.probs_arr.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.total_count
+        k = self.probs_arr.shape[-1]
+        cat = jax.random.categorical(
+            next_key(), jnp.log(jnp.maximum(self.probs_arr, 1e-30)),
+            shape=tuple(shape) + self.batch_shape + (n,))
+        return _wrap(jax.nn.one_hot(cat, k).sum(-2))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = jnp.log(jnp.maximum(self.probs_arr, 1e-30))
+        return _wrap(jax.scipy.special.gammaln(v.sum(-1) + 1)
+                     - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+                     + jnp.sum(v * logp, -1))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = jnp.square(p.scale / q.scale)
+        t1 = jnp.square((p.loc - q.loc) / q.scale)
+        return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        logp = jax.nn.log_softmax(p.logits, -1)
+        logq = jax.nn.log_softmax(q.logits, -1)
+        return _wrap(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,3 @@
+"""hapi (ref: python/paddle/hapi/)."""
+from .model_api import Model, summary, Callback, ProgBarLogger, \
+    ModelCheckpoint, EarlyStopping  # noqa: F401

@@ -1,0 +1,197 @@
+"""Fused functional ops (ref: python/paddle/incubate/nn/functional/ —
+fused_rms_norm.py, fused_rotary_position_embedding.py,
+fused_multi_transformer, masked_multihead_attention).
+
+Each op prefers the Pallas TPU kernel (paddle_tpu/kernels/pallas) and falls
+back to an XLA composite off-TPU; both are registered through the standard
+op registry so autograd/AMP/jit apply uniformly."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....ops.registry import register_op
+from ....kernels import pallas as pk
+
+
+@register_op("fused_rms_norm", amp_policy="black")
+def fused_rms_norm(x, weight=None, epsilon=1e-6):
+    return pk.rms_norm(x, weight, epsilon)
+
+
+@register_op("fused_layer_norm", amp_policy="black")
+def fused_layer_norm(x, weight=None, bias=None, epsilon=1e-5):
+    return pk.layer_norm(x, weight, bias, epsilon)
+
+
+@register_op("fused_rotary_position_embedding")
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """RoPE over [batch, seq, heads, head_dim] (paddle layout,
+    ref: incubate/nn/functional/fused_rotary_position_embedding.py)."""
+    seq = q.shape[1]
+    hd = q.shape[-1]
+    if sin is None or cos is None:
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+        t = jnp.arange(seq, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)  # [seq, hd/2]
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        sin = jnp.sin(emb)[None, :, None, :]
+        cos = jnp.cos(emb)[None, :, None, :]
+    else:
+        if sin.ndim == 2:
+            sin = sin[None, :, None, :]
+            cos = cos[None, :, None, :]
+    if position_ids is not None:
+        sin = jnp.take(sin[0, :, 0], position_ids, axis=0)[:, :, None, :]
+        cos = jnp.take(cos[0, :, 0], position_ids, axis=0)[:, :, None, :]
+
+    def rot(x):
+        if x is None:
+            return None
+        if use_neox_rotary_style:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            rotated = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            rotated = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        return (x * cos + rotated * sin).astype(x.dtype)
+
+    outs = tuple(rot(t) for t in (q, k, v) if t is not None)
+    return outs if len(outs) > 1 else outs[0]
+
+
+@register_op("fused_flash_attention", amp_policy="white")
+def fused_flash_attention(query, key, value, attn_mask=None, causal=False,
+                          dropout=0.0, training=True, softmax_scale=None):
+    """Flash attention, [batch, seq, heads, dim] layout
+    (ref: nn/functional/flash_attention.py:146 -> dynloaded CUDA kernel;
+    here -> Pallas TPU kernel, fallback XLA attention)."""
+    return pk.flash_attention(query, key, value, attn_mask=attn_mask,
+                              causal=causal, softmax_scale=softmax_scale)
+
+
+@register_op("fused_linear", amp_policy="white")
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    if transpose_weight:
+        weight = weight.T
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(x, weight, preferred_element_type=acc)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("fused_linear_activation", amp_policy="white")
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    if trans_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if trans_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if bias is not None:
+        out = out + bias
+    if activation == "gelu":
+        return jax.nn.gelu(out)
+    if activation == "relu":
+        return jax.nn.relu(out)
+    return out
+
+
+@register_op("fused_bias_dropout_residual_layer_norm", amp_policy="black")
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, key=None):
+    if bias is not None:
+        x = x + bias
+    if dropout_rate > 0.0 and training:
+        if key is None:
+            from ....core.generator import next_key
+            key = next_key()
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, x.shape)
+        x = jnp.where(keep, x / (1.0 - dropout_rate), 0.0).astype(x.dtype)
+    y = x + residual
+    return pk.layer_norm(y, ln_scale, ln_bias, ln_epsilon)
+
+
+@register_op("swiglu", amp_policy="white")
+def swiglu(x, y=None):
+    """SwiGLU gate (LLaMA FFN): silu(x) * y; single-arg form splits x."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@register_op("fused_dropout_add")
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      key=None):
+    if training and p > 0.0:
+        if key is None:
+            from ....core.generator import next_key
+            key = next_key()
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+        x = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return x + y
+
+
+def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
+                               linear_bias, num_heads, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, training=True):
+    """Composite fused MHA (ref: incubate fused_attention_op)."""
+    from .... import ops
+    residual = x
+    if pre_layer_norm:
+        x = fused_layer_norm(x, pre_ln_scale, pre_ln_bias)
+    b, s, d = x.shape
+    qkv = ops.matmul(x, qkv_weight)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias
+    qkv = ops.reshape(qkv, (b, s, 3, num_heads, d // num_heads))
+    q, k, v = ops.unbind(qkv, axis=2)
+    out = fused_flash_attention(q, k, v, attn_mask=attn_mask)
+    out = ops.reshape(out, (b, s, d))
+    out = ops.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = ops.dropout(out, dropout_rate, training=training)
+    out = out + residual
+    if not pre_layer_norm:
+        out = fused_layer_norm(out, ln_scale, ln_bias)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True):
+    from .... import ops
+    residual = x
+    if pre_layer_norm:
+        x = fused_layer_norm(x, ln1_scale, ln1_bias, ln1_epsilon)
+    x = ops.matmul(x, linear1_weight)
+    if linear1_bias is not None:
+        x = x + linear1_bias
+    x = getattr(ops, activation)(x)
+    x = ops.dropout(x, dropout1_rate, training=training)
+    x = ops.matmul(x, linear2_weight)
+    if linear2_bias is not None:
+        x = x + linear2_bias
+    x = ops.dropout(x, dropout2_rate, training=training)
+    x = x + residual
+    if not pre_layer_norm:
+        x = fused_layer_norm(x, ln2_scale, ln2_bias, ln2_epsilon)
+    return x

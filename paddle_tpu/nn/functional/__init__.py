@@ -1,0 +1,123 @@
+"""nn.functional (ref: python/paddle/nn/functional/) — mostly re-exports of
+registered ops, plus a few composites."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import ops
+from ...ops import (  # noqa: F401
+    relu, relu6, leaky_relu, prelu, elu, selu, celu, gelu, silu, swish,
+    mish, hardswish, hardsigmoid, hardtanh, hardshrink, softshrink,
+    tanhshrink, softplus, softsign, thresholded_relu, maxout, glu, softmax,
+    log_softmax, gumbel_softmax, sigmoid, logsigmoid, tanh,
+    dropout, dropout2d, alpha_dropout,
+    linear, embedding, one_hot,
+    conv1d, conv2d, conv3d, conv2d_transpose,
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool2d,
+    layer_norm, rms_norm, batch_norm, group_norm, instance_norm,
+    local_response_norm,
+    mse_loss, l1_loss, smooth_l1_loss, cross_entropy,
+    softmax_with_cross_entropy, nll_loss, binary_cross_entropy,
+    binary_cross_entropy_with_logits, sigmoid_cross_entropy_with_logits,
+    kl_div, margin_ranking_loss, hinge_embedding_loss,
+    cosine_embedding_loss, triplet_margin_loss, square_error_cost, log_loss,
+    label_smooth, npair_loss,
+    scaled_dot_product_attention,
+    pixel_shuffle, pixel_unshuffle, channel_shuffle, interpolate, upsample,
+    temporal_shift, affine_grid, pad,
+)
+from ...ops.registry import register_op
+from ...core.tensor import Tensor
+
+
+unfold = ops.unfold_im2col
+
+
+@register_op("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@register_op("normalize")
+def normalize(x, p=2.0, axis=1, epsilon=1e-12):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=True), 1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+@register_op("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    # x: [N, C, H, W]; grid: [N, Hg, Wg, 2] in [-1, 1]
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = fx - x0
+    wy = fy - y0
+
+    # vectorized gather: flatten spatial
+    def gather(xi, yi):
+        xi_c = jnp.clip(xi, 0, w - 1)
+        yi_c = jnp.clip(yi, 0, h - 1)
+        idx = yi_c * w + xi_c  # [N, Hg, Wg]
+        flat = x.reshape(n, c, h * w)
+        out = jnp.take_along_axis(
+            flat, idx.reshape(n, 1, -1).astype(jnp.int32).repeat(c, 1),
+            axis=2)
+        val = out.reshape(n, c, *idx.shape[1:])
+        if padding_mode == "zeros":
+            valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+            val = val * valid[:, None].astype(val.dtype)
+        return val
+
+    if mode == "nearest":
+        return gather(jnp.round(fx).astype(jnp.int32),
+                      jnp.round(fy).astype(jnp.int32))
+    v00 = gather(x0, y0)
+    v01 = gather(x1, y0)
+    v10 = gather(x0, y1)
+    v11 = gather(x1, y1)
+    wx_ = wx[:, None]
+    wy_ = wy[:, None]
+    return (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_) +
+            v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+
+
+@register_op("sequence_mask")
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    from ...core import dtype as dtypes
+    ml = int(maxlen) if maxlen is not None else None
+    if ml is None:
+        raise ValueError("maxlen must be given under XLA (static shapes)")
+    r = jnp.arange(ml)
+    return (r < x[..., None]).astype(dtypes.to_jnp(dtype))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True, name=None):
+    """ref API: python/paddle/nn/functional/flash_attention.py:146.
+    Dispatches to the Pallas flash-attention kernel on TPU when available,
+    else the XLA softmax-attention composite."""
+    from ...incubate.nn.functional import fused_flash_attention
+    out = fused_flash_attention(query, key, value, causal=causal,
+                                dropout=dropout, training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def softmax_(x, axis=-1):
+    return softmax(x, axis)

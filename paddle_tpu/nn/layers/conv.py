@@ -1,0 +1,149 @@
+"""Conv layers (ref: python/paddle/nn/layer/conv.py).
+
+Paddle kernel layout [out_c, in_c/groups, *k] is kept so state_dicts match
+the reference; the op lowers to lax.conv_general_dilated (MXU)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ..layer import Layer
+from ..initializer import KaimingUniform, Uniform
+
+
+def _ntuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(i) for i in v)
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, ndim,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 transpose=False, output_padding=0):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, ndim)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self.output_padding = output_padding
+        if transpose:
+            shape = (in_channels, out_channels // groups) + self.kernel_size
+        else:
+            shape = (out_channels, in_channels // groups) + self.kernel_size
+        fan_in = in_channels // groups * int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr,
+            default_initializer=KaimingUniform(fan_in=fan_in,
+                                               negative_slope=np.sqrt(5.0)))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            bound = 1.0 / np.sqrt(fan_in)
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=Uniform(-bound, bound)
+                if bias_attr is None else None)
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, "
+                f"kernel_size={self.kernel_size}, stride={self.stride}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return ops.conv1d(x, self.weight, self.bias, self.stride,
+                          self.padding, self.dilation, self.groups,
+                          self.data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return ops.conv2d(x, self.weight, self.bias, self.stride,
+                          self.padding, self.dilation, self.groups,
+                          self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return ops.conv3d(x, self.weight, self.bias, self.stride,
+                          self.padding, self.dilation, self.groups,
+                          self.data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return ops.conv2d_transpose(x, self.weight, self.bias, self.stride,
+                                    self.padding, self.output_padding,
+                                    self.dilation, self.groups,
+                                    self.data_format)
+
+
+class Conv1DTranspose(Conv2DTranspose):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        Layer.__init__(self)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, 1)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.output_padding = output_padding
+        shape = (in_channels, out_channels // groups) + self.kernel_size
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_channels,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x, output_size=None):
+        # route through 2d transpose by unsqueezing a spatial dim
+        x4 = ops.unsqueeze(x, 2)
+        w4 = ops.unsqueeze(self.weight, 2)
+        out = ops.conv2d_transpose(
+            x4, w4, self.bias, (1, self.stride) if isinstance(
+                self.stride, int) else (1,) + tuple(self.stride),
+            (0, self.padding) if isinstance(self.padding, int) else
+            [0] + list(self.padding),
+            (0, self.output_padding) if isinstance(self.output_padding, int)
+            else self.output_padding,
+            (1, self.dilation) if isinstance(self.dilation, int) else
+            self.dilation,
+            self.groups)
+        return ops.squeeze(out, 2)

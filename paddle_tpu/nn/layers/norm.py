@@ -1,0 +1,230 @@
+"""Normalization layers (ref: python/paddle/nn/layer/norm.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+from ..layer import Layer
+from ..initializer import Constant
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self.normalized_shape, attr=weight_attr,
+                default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                self.normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return ops.layer_norm(x, self.weight, self.bias, self.epsilon,
+                              normalized_shape=self.normalized_shape)
+
+    def extra_repr(self):
+        return f"normalized_shape={self.normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """TPU-favorite norm (LLaMA-class models); fused Pallas kernel available
+    via incubate.nn.functional.fused_rms_norm."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        return ops.rms_norm(x, self.weight, self.epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr,
+                default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                              is_bias=True)
+        import jax.numpy as jnp
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,),
+                                                       jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,),
+                                                          jnp.float32)))
+
+    def forward(self, x):
+        training = self.training and not (self.use_global_stats is True)
+        out, new_mean, new_var = ops.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format)
+        if training:
+            self._mean._set_data(new_mean._data)
+            self._variance._set_data(new_var._data)
+        return out
+
+    def extra_repr(self):
+        return f"num_features={self.num_features}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCHW" if data_format == "NCL" else
+                         data_format, use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """On TPU, batch statistics are synchronized by running batch_norm under
+    GSPMD with the batch axis sharded — XLA inserts the cross-replica means
+    (ref intent: nn/layer/norm.py SyncBatchNorm over NCCL allreduce)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        # in GSPMD data-parallel execution plain BN already sees the global
+        # batch when the reduction is over a sharded axis; keep structure
+        return layer
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = (None if weight_attr is False else
+                       self.create_parameter((num_channels,),
+                                             attr=weight_attr,
+                                             default_initializer=Constant(1.0)))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((num_channels,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x):
+        return ops.group_norm(x, self.num_groups, self.weight, self.bias,
+                              self.epsilon, self.data_format)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = (None if weight_attr is False else
+                       self.create_parameter((num_features,),
+                                             attr=weight_attr,
+                                             default_initializer=Constant(1.0)))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((num_features,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x):
+        return ops.instance_norm(x, self.weight, self.bias, self.epsilon)
+
+
+class InstanceNorm2D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k)
+
+    def forward(self, x):
+        return ops.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        from ..initializer import Normal
+        self.weight_u = self.create_parameter(
+            (h,), default_initializer=Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+        w = ops.moveaxis(weight, self.dim, 0)
+        h = w.shape[0]
+        wm = ops.reshape(w, (h, -1))
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self.power_iters):
+            v = ops.matmul(wm, u, transpose_x=True)
+            v = v / (ops.norm(v) + self.epsilon)
+            u = ops.matmul(wm, v)
+            u = u / (ops.norm(u) + self.epsilon)
+        self.weight_u._set_data(u.detach()._data)
+        self.weight_v._set_data(v.detach()._data)
+        sigma = ops.sum(u * ops.matmul(wm, v))
+        return weight / sigma

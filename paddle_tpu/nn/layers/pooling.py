@@ -1,0 +1,100 @@
+"""Pooling layers (ref: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from ... import ops
+from ..layer import Layer
+
+
+class _Pool(Layer):
+    def __init__(self, op, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format=None, **kw):
+        super().__init__()
+        self._op = op
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+        self._kw = kw
+
+    def forward(self, x):
+        kwargs = dict(self._kw)
+        if self.data_format is not None:
+            kwargs["data_format"] = self.data_format
+        return getattr(ops, self._op)(x, self.kernel_size, self.stride,
+                                      self.padding,
+                                      ceil_mode=self.ceil_mode, **kwargs)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, name=None):
+        super().__init__("max_pool1d", kernel_size, stride, padding,
+                         ceil_mode)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCHW",
+                 name=None):
+        super().__init__("max_pool2d", kernel_size, stride, padding,
+                         ceil_mode, data_format)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCDHW",
+                 name=None):
+        super().__init__("max_pool3d", kernel_size, stride, padding,
+                         ceil_mode, data_format)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__("avg_pool1d", kernel_size, stride, padding,
+                         ceil_mode, exclusive=exclusive)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__("avg_pool2d", kernel_size, stride, padding,
+                         ceil_mode, data_format, exclusive=exclusive)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__("avg_pool3d", kernel_size, stride, padding,
+                         ceil_mode, data_format, exclusive=exclusive)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return ops.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return ops.adaptive_max_pool2d(x, self.output_size)

@@ -1,0 +1,249 @@
+"""RNN layers (ref: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the time loop is jax.lax.scan inside ONE registered op
+per direction/layer, so the whole recurrence compiles to a single XLA
+while-loop (no per-step Python dispatch) — the compiler-friendly control
+flow the build brief mandates."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import ops
+from ...ops.registry import register_op
+from ..layer import Layer
+from ..initializer import Uniform
+import numpy as np
+
+
+@register_op("lstm_scan")
+def _lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse=False):
+    """x: [seq, batch, in], weights in paddle gate order i,f,g(c),o."""
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ w_ih.T + h @ w_hh.T
+        if b_ih is not None:
+            gates = gates + b_ih + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hT, cT), out = jax.lax.scan(step, (h0, c0), x, reverse=reverse)
+    return out, hT, cT
+
+
+@register_op("gru_scan")
+def _gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh, reverse=False):
+    def step(h, xt):
+        gi = xt @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+        gh = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        ir, iz, ic = jnp.split(gi, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(ic + r * hc)
+        h = (1.0 - z) * n + z * h
+        return h, h
+
+    hT, out = jax.lax.scan(step, h0, x, reverse=reverse)
+    return out, hT
+
+
+@register_op("simple_rnn_scan")
+def _rnn_scan(x, h0, w_ih, w_hh, b_ih, b_hh, activation="tanh",
+              reverse=False):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(h, xt):
+        h = act(xt @ w_ih.T + h @ w_hh.T +
+                (b_ih if b_ih is not None else 0.0) +
+                (b_hh if b_hh is not None else 0.0))
+        return h, h
+
+    hT, out = jax.lax.scan(step, h0, x, reverse=reverse)
+    return out, hT
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_size = (input_size if layer == 0
+                           else hidden_size * self.bidirect)
+                suffix = "_reverse" if d == 1 else ""
+                w_ih = self.create_parameter(
+                    (gate_mult * hidden_size, in_size),
+                    default_initializer=init)
+                w_hh = self.create_parameter(
+                    (gate_mult * hidden_size, hidden_size),
+                    default_initializer=init)
+                b_ih = self.create_parameter(
+                    (gate_mult * hidden_size,), is_bias=True,
+                    default_initializer=init)
+                b_hh = self.create_parameter(
+                    (gate_mult * hidden_size,), is_bias=True,
+                    default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", w_ih)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", w_hh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", b_ih)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", b_hh)
+                self._all_weights.append(
+                    (f"weight_ih_l{layer}{suffix}",
+                     f"weight_hh_l{layer}{suffix}",
+                     f"bias_ih_l{layer}{suffix}",
+                     f"bias_hh_l{layer}{suffix}"))
+
+    def _weights(self, layer, d):
+        idx = layer * self.bidirect + d
+        names = self._all_weights[idx]
+        return tuple(self._parameters[n] for n in names)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if not self.time_major:
+            x = ops.transpose(x, (1, 0, 2))  # -> [seq, batch, feat]
+        seq, batch = x.shape[0], x.shape[1]
+        n_states = self.num_layers * self.bidirect
+        if self.mode == "LSTM":
+            if initial_states is None:
+                h0 = ops.zeros((n_states, batch, self.hidden_size))
+                c0 = ops.zeros((n_states, batch, self.hidden_size))
+            else:
+                h0, c0 = initial_states
+        else:
+            h0 = (initial_states if initial_states is not None
+                  else ops.zeros((n_states, batch, self.hidden_size)))
+            c0 = None
+        h_outs, c_outs = [], []
+        out = x
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(self.bidirect):
+                w_ih, w_hh, b_ih, b_hh = self._weights(layer, d)
+                sidx = layer * self.bidirect + d
+                if self.mode == "LSTM":
+                    o, hT, cT = _lstm_scan(out, h0[sidx], c0[sidx], w_ih,
+                                              w_hh, b_ih, b_hh,
+                                              reverse=(d == 1))
+                    c_outs.append(cT)
+                elif self.mode == "GRU":
+                    o, hT = _gru_scan(out, h0[sidx], w_ih, w_hh, b_ih,
+                                         b_hh, reverse=(d == 1))
+                else:
+                    o, hT = _rnn_scan(
+                        out, h0[sidx], w_ih, w_hh, b_ih, b_hh,
+                        activation="tanh" if self.mode == "RNN_TANH"
+                        else "relu", reverse=(d == 1))
+                h_outs.append(hT)
+                dir_outs.append(o)
+            out = (dir_outs[0] if self.bidirect == 1
+                   else ops.concat(dir_outs, axis=-1))
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                out = ops.dropout(out, self.dropout, training=self.training)
+        if not self.time_major:
+            out = ops.transpose(out, (1, 0, 2))
+        hN = ops.stack(h_outs, axis=0)
+        if self.mode == "LSTM":
+            cN = ops.stack(c_outs, axis=0)
+            return out, (hN, cN)
+        return out, hN
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", name=None, **kw):
+        super().__init__("RNN_TANH" if activation == "tanh" else "RNN_RELU",
+                         input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            b = inputs.shape[0]
+            states = (ops.zeros((b, self.hidden_size)),
+                      ops.zeros((b, self.hidden_size)))
+        h, c = states
+        seq = ops.unsqueeze(inputs, 0)
+        out, hT, cT = _lstm_scan(seq, h, c, self.weight_ih,
+                                    self.weight_hh, self.bias_ih,
+                                    self.bias_hh)
+        return hT, (hT, cT)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, name=None, **kw):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = ops.zeros((inputs.shape[0], self.hidden_size))
+        seq = ops.unsqueeze(inputs, 0)
+        out, hT = _gru_scan(seq, states, self.weight_ih, self.weight_hh,
+                               self.bias_ih, self.bias_hh)
+        return hT, hT

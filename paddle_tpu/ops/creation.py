@@ -1,0 +1,173 @@
+"""Creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from ..core.generator import next_key
+from .registry import register_op
+
+
+def _dt(dtype, default=jnp.float32):
+    return dtypes.to_jnp(dtype) if dtype is not None else default
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+# creation ops do not differentiate through inputs -> plain functions
+def zeros(shape, dtype=None):
+    return Tensor._wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor._wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor._wrap(jnp.full(_shape(shape), fill_value, _dt(dtype, None)))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor._wrap(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    for v in (start, end, step):
+        pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = jnp.float32
+        else:
+            dtype = jnp.int64
+    else:
+        dtype = dtypes.to_jnp(dtype)
+    return Tensor._wrap(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = num.item() if isinstance(num, Tensor) else int(num)
+    return Tensor._wrap(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor._wrap(jnp.logspace(start, stop, int(num), base=base,
+                                     dtype=_dt(dtype)))
+
+
+@register_op("zeros_like")
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtypes.to_jnp(dtype) if dtype else None)
+
+
+@register_op("ones_like")
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtypes.to_jnp(dtype) if dtype else None)
+
+
+@register_op("full_like")
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value,
+                         dtype=dtypes.to_jnp(dtype) if dtype else None)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+@register_op("assign")
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+@register_op("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register_op("diag")
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+        return jnp.where(mask, d, padding_value)
+    return jnp.diag(x, k=offset)
+
+
+@register_op("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@register_op("meshgrid_stub", tags=("internal",))
+def _meshgrid_stub(x):
+    return x
+
+
+def meshgrid(*args):
+    from .registry import register_op as _r
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    arrays = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in tensors]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor._wrap(o) for o in outs]
+
+
+def tril_indices(row, col, offset=0):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def triu_indices(row, col=None, offset=0):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def clone(x):
+    return assign(x)
+
+
+def complex(real, imag):
+    from .registry import OPS
+    return _complex(real, imag)
+
+
+@register_op("complex")
+def _complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+@register_op("as_complex")
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op("as_real")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)

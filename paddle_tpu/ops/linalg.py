@@ -1,0 +1,304 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py; matmul:146).
+
+matmul is THE MXU op — keep operands large/batched and prefer bf16 inputs
+with fp32 accumulation (preferred_element_type), which is the TPU-native
+mixed-precision contract."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _mm_precision(x):
+    """TPU MXU note: f32 matmuls default to bf16 passes under XLA; users
+    writing f32 expect f32 numerics, so force HIGHEST there. bf16 inputs
+    (the perf path — AMP casts to bf16) run at native MXU speed with f32
+    accumulation via preferred_element_type."""
+    return jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+
+
+@register_op("matmul", amp_policy="white")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(x, y, preferred_element_type=acc,
+                     precision=_mm_precision(x))
+    return out.astype(x.dtype) if acc is not None else out
+
+
+@register_op("mm", amp_policy="white")
+def mm(x, y):
+    return jnp.matmul(x, y, precision=_mm_precision(x))
+
+
+@register_op("bmm", amp_policy="white")
+def bmm(x, y):
+    return jnp.matmul(x, y, precision=_mm_precision(x))
+
+
+@register_op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@register_op("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register_op("addmm", amp_policy="white")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register_op("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@register_op("t")
+def t(x):
+    return x.T if x.ndim >= 2 else x
+
+
+@register_op("cross")
+def cross(x, y, axis=9):
+    axis = -1 if axis == 9 else axis
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_op("norm")
+def norm(x, p=None, axis=None, keepdim=False):
+    if p is None or p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord=None, axis=_axtuple(axis), keepdims=keepdim)
+    if p == float("inf") or p == "inf":
+        p = jnp.inf
+    elif p == float("-inf"):
+        p = -jnp.inf
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.linalg.norm(x, ord=p, axis=_axtuple(axis), keepdims=keepdim)
+
+
+def _axtuple(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+@register_op("vector_norm")
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    return jnp.linalg.vector_norm(x, ord=p, axis=_axtuple(axis), keepdims=keepdim)
+
+
+@register_op("matrix_norm")
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+
+
+@register_op("dist")
+def dist(x, y, p=2.0):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+@register_op("histogram")
+def histogram(input, bins=100, min=0, max=0, weight=None):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(input), jnp.max(input)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(input.reshape(-1), bins=bins, range=(lo, hi),
+                            weights=None if weight is None else weight.reshape(-1))
+    return hist
+
+
+@register_op("bincount")
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+@register_op("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op("transpose_matmul_stub", tags=("internal",))
+def _tm(x):
+    return x
+
+
+# --- decompositions / solvers (XLA has native lowerings for these) ---
+@register_op("cholesky")
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@register_op("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    L = jnp.swapaxes(y, -1, -2) if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2), z,
+                                             lower=False)
+
+
+@register_op("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register_op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rcond=rcond, hermitian=hermitian)
+
+
+@register_op("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register_op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@register_op("lstsq")
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op("qr")
+def qr(x, mode="reduced"):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+@register_op("svd")
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+@register_op("svdvals")
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@register_op("eig")
+def eig(x):
+    # CPU-only in XLA; eager path moves to host transparently
+    w, v = jnp.linalg.eig(jax.device_get(x) if not isinstance(
+        x, jax.core.Tracer) else x)
+    return w, v
+
+
+@register_op("eigh")
+def eigh(x, UPLO="L"):
+    return tuple(jnp.linalg.eigh(x, UPLO=UPLO))
+
+
+@register_op("eigvals")
+def eigvals(x):
+    return jnp.linalg.eigvals(jax.device_get(x) if not isinstance(
+        x, jax.core.Tracer) else x)
+
+
+@register_op("eigvalsh")
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register_op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register_op("slogdet")
+def slogdet(x):
+    s, l = jnp.linalg.slogdet(x)
+    return s, l
+
+
+@register_op("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register_op("lu")
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv + 1  # paddle uses 1-based pivots
+
+
+@register_op("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register_op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@register_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op("multi_dot")
+def multi_dot(x):
+    return jnp.linalg.multi_dot(list(x))
+
+
+@register_op("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + (offset if offset > 0 else -offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + (-offset if offset < 0 else 0)
+    c = idx + (offset if offset > 0 else 0)
+    out = out.at[..., r, c].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@register_op("householder_product")
+def householder_product(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+@register_op("einsum_op")
+def _einsum(equation, operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum(equation, list(operands))

@@ -1,0 +1,422 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py;
+kernels phi/kernels/{reshape,transpose,concat,split,...}). XLA treats most
+of these as free layout changes; keeping them as pure metadata ops preserves
+fusion."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .registry import register_op
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in shape)
+
+
+@register_op("reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, _shape_arg(shape))
+
+
+@register_op("transpose")
+def transpose(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+@register_op("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = list(x.shape)
+    new_shape = shape[:start] + [int(np.prod(shape[start:stop + 1]) or 1)] + shape[stop + 1:]
+    return x.reshape(new_shape)
+
+
+@register_op("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    axis = axis % x.ndim
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@register_op("unsqueeze")
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+@register_op("concat")
+def concat(x, axis=0):
+    return jnp.concatenate(list(x), axis=int(axis))
+
+
+@register_op("stack")
+def stack(x, axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+@register_op("split_op", tags=("multi_out",))
+def _split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    # allow one -1 entry
+    known = 0
+    for s in sections:
+        if s != -1:
+            known += s
+    sections = [total - known if s == -1 else s for s in sections]
+    idx = np.cumsum(sections[:-1])
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0):
+    return list(_split(x, num_or_sections, axis))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    n = x.shape[axis] if isinstance(x, Tensor) else jnp.shape(x)[axis]
+    parts = split(x, n, axis)
+    return [squeeze(p, axis) for p in parts]
+
+
+@register_op("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, _shape_arg(repeat_times))
+
+
+@register_op("expand")
+def expand(x, shape):
+    shape = _shape_arg(shape)
+    # -1 means keep dim
+    cur = list(x.shape)
+    cur = [1] * (len(shape) - len(cur)) + cur
+    tgt = [c if s == -1 else s for s, c in zip(shape, cur)]
+    return jnp.broadcast_to(x.reshape(cur), tgt)
+
+
+@register_op("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, _shape_arg(shape))
+
+
+def broadcast_tensors(inputs):
+    arrs = jnp.broadcast_arrays(*[t._data if isinstance(t, Tensor) else t
+                                  for t in inputs])
+    return [Tensor._wrap(a) for a in arrs]
+
+
+@register_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register_op("flip")
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@register_op("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_op("gather")
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=int(axis))
+
+
+@register_op("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register_op("take_along_axis")
+def take_along_axis(arr, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(arr.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@register_op("put_along_axis")
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, values, axis=axis,
+                                  inplace=False)
+    dnums = None
+    # scatter-with-reduction via .at
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1
+                                  for i in range(indices.ndim)])
+           for d, s in enumerate(indices.shape)]
+    idx[axis] = indices
+    if reduce in ("add", "sum"):
+        return arr.at[tuple(idx)].add(values)
+    if reduce in ("multiply", "mul"):
+        return arr.at[tuple(idx)].multiply(values)
+    if reduce == "amax":
+        return arr.at[tuple(idx)].max(values)
+    if reduce == "amin":
+        return arr.at[tuple(idx)].min(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    zeros = jnp.zeros_like(x)
+    scattered = zeros.at[index].add(updates)
+    mask = jnp.zeros(x.shape[0], dtype=bool).at[index].set(True)
+    mask = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(mask, scattered, x)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register_op("scatter_nd")
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(_shape_arg(shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+@register_op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=axis)
+
+
+@register_op("index_sample")
+def index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]
+
+
+@register_op("index_add")
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    moved = moved.at[index.reshape(-1)].add(vmoved)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+@register_op("index_put")
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(i for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@register_op("index_fill")
+def index_fill(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index.reshape(-1)].set(value)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+@register_op("masked_select")
+def masked_select(x, mask):
+    # dynamic-shape op: eager-only (documented; XLA needs static shapes)
+    xb = jnp.broadcast_to(x, jnp.broadcast_shapes(x.shape, mask.shape))
+    return xb[jnp.broadcast_to(mask, xb.shape)]
+
+
+@register_op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@register_op("where")
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        # nonzero mode (dynamic shape — eager only)
+        return jnp.stack(jnp.nonzero(condition), axis=1)
+    return jnp.where(condition, x, y)
+
+
+@register_op("pad_op")
+def _pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle full-rank pad: [dim0_l, dim0_r, dim1_l, dim1_r, ...]
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims (torch-style order:
+        # last dim first)
+        width = [(0, 0)] * nd
+        k = len(pad) // 2
+        if data_format.endswith("C") or data_format in ("NLC", "NHWC", "NDHWC"):
+            spatial = list(range(1, 1 + k))
+        else:
+            spatial = list(range(nd - k, nd))
+        spatial = spatial[::-1]
+        for i, d in enumerate(spatial):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, width, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, width, mode=jmode)
+
+
+@register_op("slice_op")
+def _slice(x, axes, starts, ends):
+    import builtins
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = builtins.slice(int(st), int(en))
+    return x[tuple(sl)]
+
+
+def slice(x, axes, starts, ends):
+    return _slice(x, axes, starts, ends)
+
+
+@register_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    import builtins
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[ax] = builtins.slice(int(st), int(en), int(sd))
+    return x[tuple(sl)]
+
+
+@register_op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op("swapaxes")
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@register_op("as_strided")
+def as_strided(x, shape, stride, offset=0):
+    # emulate via gather on flattened array (no real strides on TPU)
+    flat = x.reshape(-1)
+    shape = _shape_arg(shape)
+    idx = jnp.asarray(offset)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    lin = jnp.zeros(shape, jnp.int32) + offset
+    for g, s in zip(grids, stride):
+        lin = lin + g * s
+    return flat[lin]
+
+
+@register_op("unfold")
+def unfold(x, axis, size, step):
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    def take(s):
+        return jax.lax.dynamic_slice_in_dim(x, s, size, axis=axis)
+    out = jax.vmap(take)(starts)  # [n, ...size at axis...]
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register_op("cast")
+def cast(x, dtype):
+    from ..core import dtype as dtypes
+    return x.astype(dtypes.to_jnp(dtype))
+
+
+@register_op("tensordot")
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@register_op("atleast_1d_op")
+def _atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+def atleast_1d(*xs):
+    outs = [_atleast_1d(x) for x in xs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+@register_op("atleast_2d_op")
+def _atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+def atleast_2d(*xs):
+    outs = [_atleast_2d(x) for x in xs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+@register_op("atleast_3d_op")
+def _atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+def atleast_3d(*xs):
+    outs = [_atleast_3d(x) for x in xs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+@register_op("view")
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, _shape_arg(shape_or_dtype))
+    from ..core import dtype as dtypes
+    return x.view(dtypes.to_jnp(shape_or_dtype))
+
+
+@register_op("crop")
+def crop(x, shape=None, offsets=None):
+    shape = _shape_arg(shape) if shape is not None else x.shape
+    offsets = list(offsets) if offsets is not None else [0] * x.ndim
+    shape = [x.shape[i] if s == -1 else s for i, s in enumerate(shape)]
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+@register_op("shard_index")
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)

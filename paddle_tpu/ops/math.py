@@ -1,0 +1,426 @@
+"""Elementwise & scalar math ops (ref: python/paddle/tensor/math.py, ~142
+defs; kernels at /root/reference/paddle/phi/kernels/elementwise_*,
+activation_kernel.cc). All lower to XLA elementwise HLO; fusion with
+surrounding matmuls is XLA's job (HBM-bandwidth note in the build brief)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+# ---- binary ----
+@register_op("add")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@register_op("subtract")
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@register_op("multiply")
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@register_op("divide")
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+@register_op("floor_divide")
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@register_op("mod")
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+@register_op("pow")
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@register_op("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register_op("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@register_op("fmax")
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@register_op("fmin")
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@register_op("atan2")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@register_op("hypot")
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@register_op("logaddexp")
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@register_op("heaviside")
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@register_op("copysign")
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@register_op("nextafter")
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@register_op("gcd")
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@register_op("lcm")
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@register_op("ldexp")
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+# ---- unary ----
+@register_op("abs")
+def abs(x):
+    return jnp.abs(x)
+
+
+@register_op("neg")
+def neg(x):
+    return jnp.negative(x)
+
+
+@register_op("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@register_op("expm1")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@register_op("log")
+def log(x):
+    return jnp.log(x)
+
+
+@register_op("log2")
+def log2(x):
+    return jnp.log2(x)
+
+
+@register_op("log10")
+def log10(x):
+    return jnp.log10(x)
+
+
+@register_op("log1p")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@register_op("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register_op("rsqrt")
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@register_op("square")
+def square(x):
+    return jnp.square(x)
+
+
+@register_op("reciprocal")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@register_op("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@register_op("sin")
+def sin(x):
+    return jnp.sin(x)
+
+
+@register_op("cos")
+def cos(x):
+    return jnp.cos(x)
+
+
+@register_op("tan")
+def tan(x):
+    return jnp.tan(x)
+
+
+@register_op("asin")
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@register_op("acos")
+def acos(x):
+    return jnp.arccos(x)
+
+
+@register_op("atan")
+def atan(x):
+    return jnp.arctan(x)
+
+
+@register_op("sinh")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@register_op("cosh")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@register_op("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register_op("asinh")
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@register_op("acosh")
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@register_op("atanh")
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@register_op("ceil")
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@register_op("floor")
+def floor(x):
+    return jnp.floor(x)
+
+
+@register_op("round")
+def round(x, decimals=0):
+    return jnp.round(x, decimals)
+
+
+@register_op("trunc")
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@register_op("frac")
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@register_op("erf")
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@register_op("erfinv")
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@register_op("lgamma")
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@register_op("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@register_op("polygamma")
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@register_op("i0")
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+@register_op("i0e")
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+@register_op("i1")
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+@register_op("i1e")
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+@register_op("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register_op("logit")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jax.scipy.special.logit(x)
+
+
+@register_op("logsigmoid")
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op("rad2deg")
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@register_op("deg2rad")
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@register_op("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+@register_op("conj")
+def conj(x):
+    return jnp.conj(x)
+
+
+@register_op("real")
+def real(x):
+    return jnp.real(x)
+
+
+@register_op("imag")
+def imag(x):
+    return jnp.imag(x)
+
+
+@register_op("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@register_op("isnan")
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register_op("isinf")
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@register_op("isfinite")
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op("multiplex")
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+@register_op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register_op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_op("increment")
+def increment(x, value=1.0):
+    return x + value
+
+
+@register_op("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is None and dx is None:
+        dx = 1.0
+    if x is not None:
+        return jax.scipy.integrate.trapezoid(y, x=x, axis=axis)
+    return jax.scipy.integrate.trapezoid(y, dx=dx, axis=axis)
+
+
+@register_op("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)

@@ -1,0 +1,994 @@
+"""Neural-net ops: activations, convs, pools, norms, embedding, losses,
+attention (ref: python/paddle/nn/functional/*; kernels phi/kernels/gpu/*).
+
+Convs/matmuls lower to MXU-native XLA ops; norms and softmax are written so
+XLA fuses them into surrounding ops (Pallas fused variants live in
+paddle_tpu/kernels/pallas and are swapped in by incubate.nn.functional)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from .registry import register_op
+
+
+# ======================= activations =======================
+@register_op("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register_op("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register_op("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@register_op("prelu")
+def prelu(x, weight, data_format="NCHW"):
+    w = weight
+    if w.ndim == 1 and x.ndim > 1 and w.shape[0] > 1:
+        ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@register_op("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@register_op("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op("celu")
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@register_op("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register_op("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@register_op("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@register_op("hardsigmoid")
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+@register_op("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@register_op("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@register_op("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@register_op("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@register_op("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@register_op("maxout")
+def maxout(x, groups, axis=1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+@register_op("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register_op("softmax", amp_policy="black")
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax", amp_policy="black")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("gumbel_softmax")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ..core.generator import next_key
+    g = jax.random.gumbel(next_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        # straight-through: hard value forward, soft gradient backward
+        y = y_hard + y - jax.lax.stop_gradient(y)
+    return y
+
+
+# ======================= dropout =======================
+@register_op("dropout")
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        from ..core.generator import next_key
+        key = next_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+@register_op("dropout2d")
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        from ..core.generator import next_key
+        key = next_key()
+    if data_format == "NCHW":
+        mshape = x.shape[:2] + (1, 1)
+    else:
+        mshape = (x.shape[0], 1, 1, x.shape[3])
+    keep = jax.random.bernoulli(key, 1.0 - p, mshape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+@register_op("alpha_dropout")
+def alpha_dropout(x, p=0.5, training=True, key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        from ..core.generator import next_key
+        key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / (1.0 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+# ======================= linear / embedding =======================
+@register_op("linear", amp_policy="white")
+def linear(x, weight, bias=None):
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    prec = jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+    out = jnp.matmul(x, weight, preferred_element_type=acc, precision=prec)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("embedding")
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+@register_op("one_hot")
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+# ======================= conv =======================
+def _conv_dn(ndim, channel_last):
+    if ndim == 3:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim == 4:
+        return (("NHWC", "HWIO", "NHWC") if channel_last
+                else ("NCHW", "OIHW", "NCHW"))
+    return (("NDHWC", "DHWIO", "NDHWC") if channel_last
+            else ("NCDHW", "OIDHW", "NCDHW"))
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(i) for i in v)
+
+
+def _conv_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding),) * 2] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format):
+    n = x.ndim - 2
+    channel_last = data_format[-1] == "C"
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        _conv_dn(x.ndim, channel_last))
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    prec = jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=_norm_tuple(stride, n),
+        padding=_conv_padding(padding, n),
+        rhs_dilation=_norm_tuple(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=acc,
+        precision=prec)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        bshape = [1] * x.ndim
+        bshape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@register_op("conv1d", amp_policy="white")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 "NWC" if data_format == "NLC" else "NCW")
+
+
+@register_op("conv2d", amp_policy="white")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format)
+
+
+@register_op("conv3d", amp_policy="white")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format)
+
+
+@register_op("conv2d_transpose", amp_policy="white")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    n = 2
+    channel_last = data_format[-1] == "C"
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _conv_padding(padding, n)
+    outpad = _norm_tuple(output_padding, n)
+    # weight layout for paddle transpose conv: [in, out/groups, kh, kw]
+    kernel = jnp.swapaxes(weight, 0, 1) if not channel_last else weight
+    kh, kw = kernel.shape[-2:]
+    if isinstance(pad, str):
+        lax_pad = pad
+    else:
+        lax_pad = []
+        for i, (lo, hi) in enumerate(pad):
+            k = (kernel.shape[2 + i] - 1) * dilation[i]
+            lax_pad.append((k - lo, k - hi + outpad[i]))
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, kernel.shape, _conv_dn(x.ndim, channel_last))
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(kernel, (-1, -2)),
+        window_strides=(1, 1),
+        padding=lax_pad,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        bshape = [1] * x.ndim
+        bshape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+# ======================= pooling =======================
+def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW",
+          ceil_mode=False, norm=None):
+    n = x.ndim - 2
+    channel_last = data_format[-1] == "C"
+    kernel = _norm_tuple(kernel, n)
+    stride = _norm_tuple(stride if stride is not None else kernel, n)
+    pad = _conv_padding(padding, n)
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + (pad if not isinstance(pad, str) else pad) + [(0, 0)] \
+            if not isinstance(pad, str) else pad
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ([(0, 0), (0, 0)] + pad) if not isinstance(pad, str) else pad
+    out = jax.lax.reduce_window(x, init, reducer, dims, strides,
+                                pads if not isinstance(pads, str) else pads)
+    if norm is not None:
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides,
+                                    pads if not isinstance(pads, str) else pads)
+        out = out / cnt if norm == "count" else out / float(np.prod(kernel))
+    return out
+
+
+@register_op("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, jax.lax.max,
+                 -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                 else jnp.iinfo(x.dtype).min,
+                 data_format, ceil_mode)
+
+
+@register_op("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0,
+                 data_format, ceil_mode,
+                 norm="count" if exclusive else "size")
+
+
+@register_op("max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf,
+                 "NCW", ceil_mode)
+
+
+@register_op("avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    return _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, "NCW",
+                 ceil_mode, norm="count" if exclusive else "size")
+
+
+@register_op("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf,
+                 data_format, ceil_mode)
+
+
+@register_op("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0,
+                 data_format, ceil_mode, norm="count" if exclusive else "size")
+
+
+@register_op("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    out = _norm_tuple(output_size, 2)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    if h % out[0] == 0 and w % out[1] == 0:
+        kh, kw = h // out[0], w // out[1]
+        return avg_pool2d.raw_fn(x, (kh, kw), (kh, kw), 0,
+                                 data_format=data_format)
+    # general case: mean over variable windows via interpolation-style gather
+    return _adaptive_pool(x, out, jnp.mean, data_format)
+
+
+@register_op("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    out = _norm_tuple(output_size, 2)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    if h % out[0] == 0 and w % out[1] == 0:
+        kh, kw = h // out[0], w // out[1]
+        return max_pool2d.raw_fn(x, (kh, kw), (kh, kw), 0,
+                                 data_format=data_format)
+    return _adaptive_pool(x, out, jnp.max, data_format)
+
+
+def _adaptive_pool(x, out, reducer, data_format):
+    # slow general path (rare shapes): python loop over output cells
+    channel_last = data_format[-1] == "C"
+    hax, wax = (1, 2) if channel_last else (2, 3)
+    h, w = x.shape[hax], x.shape[wax]
+    rows = []
+    for i in range(out[0]):
+        h0, h1 = (i * h) // out[0], -(-((i + 1) * h) // out[0])
+        cols = []
+        for j in range(out[1]):
+            w0, w1 = (j * w) // out[1], -(-((j + 1) * w) // out[1])
+            sl = [slice(None)] * x.ndim
+            sl[hax] = slice(h0, h1)
+            sl[wax] = slice(w0, w1)
+            cols.append(reducer(x[tuple(sl)], axis=(hax, wax)))
+        rows.append(jnp.stack(cols, axis=-1))
+    stacked = jnp.stack(rows, axis=-2)  # [n, c, out_h, out_w]
+    if channel_last:
+        return jnp.transpose(stacked, (0, 2, 3, 1))
+    return stacked
+
+
+@register_op("adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size):
+    out = output_size if isinstance(output_size, int) else output_size[0]
+    l = x.shape[2]
+    if l % out == 0:
+        k = l // out
+        return avg_pool1d.raw_fn(x, k, k, 0)
+    cols = []
+    for j in range(out):
+        w0, w1 = (j * l) // out, -(-((j + 1) * l) // out)
+        cols.append(jnp.mean(x[:, :, w0:w1], axis=2))
+    return jnp.stack(cols, axis=-1)
+
+
+# ======================= normalization =======================
+@register_op("layer_norm", amp_policy="black")
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5,
+               begin_norm_axis=None, normalized_shape=None):
+    if begin_norm_axis is None:
+        if normalized_shape is not None:
+            n = len(normalized_shape) if isinstance(
+                normalized_shape, (list, tuple)) else 1
+            begin_norm_axis = x.ndim - n
+        else:
+            begin_norm_axis = x.ndim - 1
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("rms_norm", amp_policy="black")
+def rms_norm(x, weight=None, epsilon=1e-6):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    out = (x32 * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@register_op("batch_norm", amp_policy="black", tags=("multi_out",))
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW"):
+    channel_last = data_format[-1] == "C" and x.ndim > 2
+    ch_axis = x.ndim - 1 if channel_last else 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    if training:
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.var(x32, axis=axes)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (x - mean.reshape(shape).astype(x.dtype)) * jax.lax.rsqrt(
+        var.reshape(shape).astype(jnp.float32) + epsilon).astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, new_rm, new_rv
+
+
+@register_op("group_norm", amp_policy="black")
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    channel_last = data_format[-1] == "C" and x.ndim > 2
+    if channel_last:
+        x_ = jnp.moveaxis(x, -1, 1)
+    else:
+        x_ = x
+    n, c = x_.shape[0], x_.shape[1]
+    g = num_groups
+    rest = x_.shape[2:]
+    xg = x_.reshape((n, g, c // g) + rest).astype(jnp.float32)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x_.shape)
+    out = out.astype(x.dtype)
+    shape = [1, c] + [1] * len(rest)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_op("instance_norm", amp_policy="black")
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    out = ((x32 - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pad = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2))
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        acc = acc + jax.lax.slice_in_dim(pad, i, i + c, axis=1)
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+# ======================= losses =======================
+@register_op("mse_loss")
+def mse_loss(input, label, reduction="mean"):
+    out = jnp.square(input - label)
+    return _reduce(out, reduction)
+
+
+@register_op("l1_loss")
+def l1_loss(input, label, reduction="mean"):
+    out = jnp.abs(input - label)
+    return _reduce(out, reduction)
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = input - label
+    out = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta,
+                    jnp.abs(d) - 0.5 * delta)
+    return _reduce(out, reduction)
+
+
+def _reduce(x, reduction):
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
+@register_op("cross_entropy", amp_policy="black")
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    if use_softmax:
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(input.astype(jnp.float32), 1e-30))
+    if soft_label:
+        lbl = label.astype(jnp.float32)
+        if label_smoothing > 0:
+            n = input.shape[axis]
+            lbl = lbl * (1 - label_smoothing) + label_smoothing / n
+        loss = -jnp.sum(lbl * logp, axis=axis)
+        valid = None
+    else:
+        lbl = label
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = (lbl != ignore_index)
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            n = input.shape[axis]
+            smooth = jnp.mean(logp, axis=axis)
+            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+        loss = jnp.where(valid, -picked, 0.0)
+        if weight is not None:
+            w = jnp.take(weight, safe)
+            loss = loss * jnp.where(valid, w, 0.0)
+    if reduction == "mean":
+        if valid is not None:
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            if weight is not None:
+                denom = jnp.maximum(jnp.sum(
+                    jnp.where(valid, jnp.take(weight, jnp.where(
+                        valid, label.astype(jnp.int32) if label.ndim != logp.ndim
+                        else jnp.squeeze(label, axis).astype(jnp.int32), 0)), 0.0)), 1e-12)
+            return jnp.sum(loss) / denom
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op("softmax_with_cross_entropy", amp_policy="black")
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis),
+                                     axis=axis)
+        loss = jnp.where(jnp.expand_dims(valid, axis), -picked, 0.0)
+    loss = loss.astype(logits.dtype)
+    if return_softmax:
+        return loss, jnp.exp(logp).astype(logits.dtype)
+    return loss
+
+
+@register_op("nll_loss", amp_policy="black")
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, safe[:, None], axis=1)[:, 0]
+    loss = jnp.where(valid, -picked, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * jnp.where(valid, w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    return _reduce(loss, reduction)
+
+
+@register_op("binary_cross_entropy", amp_policy="black")
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    out = -(label * jnp.log(jnp.maximum(input, eps)) +
+            (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        out = out * weight
+    return _reduce(out, reduction)
+
+
+@register_op("binary_cross_entropy_with_logits", amp_policy="black")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    logit = logit.astype(jnp.float32)
+    max_val = jnp.maximum(-logit, 0.0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        out = (1 - label) * logit + log_w * (
+            jnp.log(1 + jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        out = (1 - label) * logit + max_val + jnp.log(
+            jnp.exp(-max_val) + jnp.exp(-logit - max_val))
+    if weight is not None:
+        out = out * weight
+    return _reduce(out, reduction)
+
+
+@register_op("sigmoid_cross_entropy_with_logits", amp_policy="black")
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False):
+    x32 = x.astype(jnp.float32)
+    loss = jnp.maximum(x32, 0.0) - x32 * label + jnp.log1p(
+        jnp.exp(-jnp.abs(x32)))
+    valid = label != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return loss
+
+
+@register_op("kl_div", amp_policy="black")
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        out = jnp.exp(label) * (label - input)
+    else:
+        out = label * (jnp.log(jnp.maximum(label, 1e-30)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(out) / input.shape[0]
+    return _reduce(out, reduction)
+
+
+@register_op("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    out = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(out, reduction)
+
+
+@register_op("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    out = jnp.where(label == 1.0, input,
+                    jnp.maximum(0.0, margin - input))
+    return _reduce(out, reduction)
+
+
+@register_op("cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1)
+        + 1e-12)
+    out = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(out, reduction)
+
+
+@register_op("triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    out = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    return _reduce(out, reduction)
+
+
+@register_op("square_error_cost")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@register_op("log_loss")
+def log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) - (
+        1 - label) * jnp.log(1 - input + epsilon)
+
+
+# ======================= attention =======================
+@register_op("scaled_dot_product_attention", amp_policy="white")
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True):
+    # [batch, seq, heads, head_dim] (paddle convention,
+    # ref: python/paddle/nn/functional/flash_attention.py:441)
+    q = jnp.swapaxes(query, 1, 2)  # [b, h, s, d]
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+    if is_causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool))
+        scores = jnp.where(causal, scores, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, -jnp.inf)
+        else:
+            scores = scores + attn_mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        from ..core.generator import next_key
+        keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)  # back to [b, s, h, d]
+
+
+# ======================= misc nn =======================
+@register_op("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+@register_op("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = jnp.matmul(anchor, positive.T)
+    b = anchor.shape[0]
+    tgt = jnp.arange(b)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.take_along_axis(logp, tgt[:, None], axis=1).mean()
+    l2 = l2_reg * (jnp.sum(jnp.square(anchor)) +
+                   jnp.sum(jnp.square(positive))) / (2.0 * b)
+    return ce + l2
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@register_op("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+@register_op("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW"):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(n, c, h, w)
+
+
+@register_op("interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    channel_last = data_format[-1] == "C"
+    spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = [int(s.item()) if hasattr(s, "item") else int(s) for s in (
+        size if isinstance(size, (list, tuple)) else [size])]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if channel_last:
+        shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    else:
+        shape = x.shape[:2] + tuple(size)
+    if mode == "nearest":
+        return jax.image.resize(x, shape, method="nearest")
+    if align_corners:
+        # emulate align_corners with explicit coordinate map
+        return _resize_align_corners(x, shape, jmode, channel_last)
+    return jax.image.resize(x, shape, method=jmode)
+
+
+def _resize_align_corners(x, shape, method, channel_last):
+    import jax.image as jimage
+    spatial_axes = range(1, x.ndim - 1) if channel_last else range(2, x.ndim)
+    out = x
+    for ax in spatial_axes:
+        n_in, n_out = x.shape[ax], shape[ax]
+        if n_in == n_out:
+            continue
+        pos = jnp.linspace(0, n_in - 1, n_out)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        w = (pos - lo).astype(x.dtype)
+        lo_v = jnp.take(out, lo, axis=ax)
+        hi_v = jnp.take(out, hi, axis=ax)
+        bshape = [1] * out.ndim
+        bshape[ax] = n_out
+        w = w.reshape(bshape)
+        out = lo_v * (1 - w) + hi_v * w
+    return out
+
+
+@register_op("upsample")
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    return interpolate.raw_fn(x, size, scale_factor, mode, align_corners,
+                              data_format)
+
+
+@register_op("unfold_im2col")
+def unfold_im2col(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    n, c, h, w = x.shape
+    kh, kw = _norm_tuple(kernel_sizes, 2)
+    sh, sw = _norm_tuple(strides, 2)
+    ph, pw = _norm_tuple(paddings, 2)
+    dh, dw = _norm_tuple(dilations, 2)
+    xp = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh:i * dh + oh * sh:sh,
+                       j * dw:j * dw + ow * sw:sw]
+            patches.append(patch)
+    out = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@register_op("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])], 1)
+    right = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+                             xr[:, :-1, fold:2 * fold]], 1)
+    rest = xr[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+@register_op("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True):
+    n, c, h, w = [int(v) for v in out_shape]
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) / h * 2 - 1
+        xs = (jnp.arange(w) + 0.5) / w * 2 - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+    return jnp.einsum("hwk,njk->nhwj", base, theta)

@@ -1,0 +1,139 @@
+"""Random ops (ref: python/paddle/tensor/random.py).
+
+Eager randomness draws deterministic fresh keys from the global Generator
+(core/generator.py). Inside jit-traced code, keys are threaded functionally
+by the train-step compiler (jit/), so traced steps re-randomize per step
+(the reference meets the same need with seeded cuRAND states)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.generator import next_key
+from ..core.tensor import Tensor
+from .registry import register_op
+
+
+def _dt(dtype, default=jnp.float32):
+    return dtypes.to_jnp(dtype) if dtype is not None else default
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+def rand(shape, dtype=None):
+    return Tensor._wrap(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor._wrap(jax.random.uniform(
+        key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def randn(shape, dtype=None):
+    return Tensor._wrap(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor._wrap(jax.random.normal(next_key(), shp) * s + m)
+    return Tensor._wrap(
+        jax.random.normal(next_key(), _shape(shape or [1])) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor._wrap(
+        jax.random.normal(key, _shape(shape), _dt(dtype)) * std + mean)
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor._wrap(jax.random.randint(
+        next_key(), _shape(shape), low, high, _dt(dtype, jnp.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    if high is None:
+        low, high = 0, low
+    shape = x.shape if isinstance(x, Tensor) else jnp.shape(x)
+    dt = _dt(dtype, x._data.dtype if isinstance(x, Tensor) else jnp.int64)
+    return Tensor._wrap(jax.random.randint(next_key(), tuple(shape), low, high)
+                        .astype(dt))
+
+
+def randperm(n, dtype=None):
+    return Tensor._wrap(jax.random.permutation(next_key(), n)
+                        .astype(_dt(dtype, jnp.int64)))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        if replacement:
+            out = jax.random.categorical(next_key(), logits,
+                                         shape=(num_samples,))
+        else:
+            g = jax.random.gumbel(next_key(), data.shape)
+            _, out = jax.lax.top_k(logits + g, num_samples)
+    else:
+        if replacement:
+            out = jax.vmap(lambda l, k: jax.random.categorical(
+                k, l, shape=(num_samples,)))(
+                logits, jax.random.split(next_key(), data.shape[0]))
+        else:
+            g = jax.random.gumbel(next_key(), data.shape)
+            _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor._wrap(out.astype(jnp.int64))
+
+
+def bernoulli(x):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._wrap(jax.random.bernoulli(next_key(), data)
+                        .astype(data.dtype))
+
+
+def poisson(x):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._wrap(jax.random.poisson(next_key(), data)
+                        .astype(data.dtype))
+
+
+def exponential_(x, lam=1.0):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    out = jax.random.exponential(next_key(), data.shape, data.dtype) / lam
+    if isinstance(x, Tensor):
+        x._set_data(out)
+        return x
+    return Tensor._wrap(out)
+
+
+def rand_like(x, dtype=None):
+    return Tensor._wrap(jax.random.uniform(
+        next_key(), tuple(x.shape), _dt(dtype, x._data.dtype)))
+
+
+def randn_like(x, dtype=None):
+    return Tensor._wrap(jax.random.normal(
+        next_key(), tuple(x.shape), _dt(dtype, x._data.dtype)))
+
+
+def normal_like(x, mean=0.0, std=1.0):
+    return Tensor._wrap(jax.random.normal(
+        next_key(), tuple(x.shape), x._data.dtype) * std + mean)

@@ -1,0 +1,176 @@
+"""Reduction & statistics ops (ref: python/paddle/tensor/math.py sum/mean/...
+and stat.py; kernels phi/kernels/reduce_*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@register_op("sum")
+def sum(x, axis=None, dtype=None, keepdim=False):
+    from ..core import dtype as dtypes
+    dt = dtypes.to_jnp(dtype) if dtype is not None else None
+    return jnp.sum(x, axis=_ax(axis), dtype=dt, keepdims=keepdim)
+
+
+@register_op("mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("max")
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("min")
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    from ..core import dtype as dtypes
+    dt = dtypes.to_jnp(dtype) if dtype is not None else None
+    return jnp.prod(x, axis=_ax(axis), dtype=dt, keepdims=keepdim)
+
+
+@register_op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_ax(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@register_op("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_ax(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@register_op("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("nanmedian")
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("quantile")
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("all")
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("any")
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_ax(axis), keepdims=keepdim)
+
+
+@register_op("cumsum")
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis)
+
+
+@register_op("cumprod")
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim)
+
+
+@register_op("cummax")
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals, _cum_arg(x, vals, axis)
+
+
+@register_op("cummin")
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+    return vals, _cum_arg(x, vals, axis)
+
+
+def _cum_arg(x, vals, axis):
+    n = x.shape[axis]
+    ar = jnp.arange(n).reshape([n if i == (axis % x.ndim) else 1
+                                for i in range(x.ndim)])
+    match = (x == vals)
+    idx = jnp.where(match, ar, -1)
+    return jax.lax.associative_scan(jnp.maximum, idx, axis=axis).astype(jnp.int64)
+
+
+@register_op("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    if dx is None:
+        dx = 1.0
+    n = y.shape[axis]
+    y0 = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    if x is not None:
+        x0 = jax.lax.slice_in_dim(x, 0, n - 1, axis=axis)
+        x1 = jax.lax.slice_in_dim(x, 1, n, axis=axis)
+        d = x1 - x0
+    else:
+        d = dx
+    return jnp.cumsum((y0 + y1) * d / 2.0, axis=axis)

@@ -1,0 +1,170 @@
+"""Op registry and eager dispatch.
+
+TPU-native analog of the reference's central architectural fact ("op
+definitions are data, not code" — SURVEY.md §1; the YAML registry at
+/root/reference/paddle/phi/api/yaml/ops.yaml and the generated ad_func
+recipe at /root/reference/paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py:251). Here one `register_op` decorator replaces five code
+generators: each op is a pure-jnp forward; the SAME definition yields
+
+  (a) the eager API (this dispatcher: AMP cast -> vjp record -> call),
+  (b) the autograd rule (jax.vjp over the forward — no hand-written grads),
+  (c) the traced/compiled surface (the forward is traceable, so whole
+      graphs jit to StableHLO/XLA),
+  (d) the dist surface (DistTensor dispatch hooks in, see
+      paddle_tpu/distributed).
+
+The per-op dispatch sequence mirrors the generated ad_func
+(RecordEvent -> AMP -> autograd-meta -> PHI call -> grad-node linking).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.flags import flag_value
+from ..core.tensor import Tensor
+from ..autograd import tape
+from ..autograd.tape import GradNode, InputEdge
+
+OPS: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "sig", "amp_policy", "n_grad_exempt", "tags")
+
+    def __init__(self, name, fn, amp_policy=None, tags=()):
+        self.name = name
+        self.fn = fn
+        self.sig = inspect.signature(fn)
+        # amp_policy: None (follow input), 'white' (bf16-friendly),
+        # 'black' (force fp32), 'keep' (never cast)
+        self.amp_policy = amp_policy
+        self.tags = tags
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _diffable(t: Tensor) -> bool:
+    return (not t.stop_gradient) and jnp.issubdtype(t._data.dtype, jnp.inexact)
+
+
+def dispatch(opdef: OpDef, args, kwargs):
+    """The eager per-op path (ad_func analog)."""
+    bound = opdef.sig.bind(*args, **kwargs)
+    arguments = dict(bound.arguments)
+
+    # --- AMP logic (ref: eager_gen.py template "AMP Logic") ---
+    from ..amp.state import maybe_cast_inputs
+    arguments = maybe_cast_inputs(opdef, arguments)
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        arguments, is_leaf=_is_tensor)
+    tensor_pos = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    record = tape.is_grad_enabled() and any(
+        _diffable(leaves[i]) for i in tensor_pos)
+
+    fn = opdef.fn
+
+    if not record:
+        vals = list(leaves)
+        for i in tensor_pos:
+            vals[i] = leaves[i]._data
+        out = fn(**jax.tree_util.tree_unflatten(treedef, vals))
+        return _wrap_outputs(opdef, out, node=None)
+
+    diff_pos = [i for i in tensor_pos if _diffable(leaves[i])]
+    const_vals = list(leaves)
+    for i in tensor_pos:
+        const_vals[i] = leaves[i]._data
+
+    def g(*diff_arrs):
+        vals = list(const_vals)
+        for p, a in zip(diff_pos, diff_arrs):
+            vals[p] = a
+        out = fn(**jax.tree_util.tree_unflatten(treedef, vals))
+        flat, out_tree = jax.tree_util.tree_flatten(out)
+        g._out_tree = out_tree
+        return tuple(flat)
+
+    primals = tuple(const_vals[i] for i in diff_pos)
+    flat_out, vjp_fn = jax.vjp(g, *primals)
+    out_tree = g._out_tree
+
+    edges = []
+    for i in diff_pos:
+        t = leaves[i]
+        if t._grad_node is not None:
+            edges.append(InputEdge("node", node=t._grad_node,
+                                   out_idx=t._out_idx))
+        else:
+            edges.append(InputEdge("leaf", tensor=t))
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in flat_out]
+    node = GradNode(opdef.name, vjp_fn, edges, out_avals)
+
+    out = jax.tree_util.tree_unflatten(out_tree, list(flat_out))
+    return _wrap_outputs(opdef, out, node=node)
+
+
+def _wrap_outputs(opdef, out, node: Optional[GradNode]):
+    flat, out_tree = jax.tree_util.tree_flatten(out)
+    wrapped = []
+    check_nan = flag_value("FLAGS_check_nan_inf")
+    for idx, arr in enumerate(flat):
+        if check_nan and jnp.issubdtype(arr.dtype, jnp.inexact):
+            _check_nan_inf(opdef.name, arr)
+        if node is not None and jnp.issubdtype(arr.dtype, jnp.inexact):
+            t = Tensor._wrap(arr, stop_gradient=False)
+            t._grad_node = node
+            t._out_idx = idx
+            node.register_output(idx, t)
+        else:
+            t = Tensor._wrap(arr, stop_gradient=True)
+        wrapped.append(t)
+    result = jax.tree_util.tree_unflatten(out_tree, wrapped)
+    return result
+
+
+def _check_nan_inf(op_name, arr):
+    """FLAGS_check_nan_inf sanitizer (ref: fluid/eager/nan_inf_utils.cc)."""
+    if isinstance(arr, jax.core.Tracer):
+        return  # sanitizer is an eager-only debug feature
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(arr)))
+    if bool(bad):
+        raise FloatingPointError(
+            f"NaN or Inf detected in output of op `{op_name}`")
+
+
+def register_op(name: str = None, amp_policy: str = None, tags=()):
+    """Register a pure-jnp forward as a framework op.
+
+    The decorated function must be pure (jnp in, jnp out); Tensor arguments
+    arrive unwrapped as jax arrays. The returned wrapper is the public eager
+    API and accepts Tensors, arrays, and python scalars.
+    """
+
+    def deco(fn: Callable):
+        op_name = name or fn.__name__
+        opdef = OpDef(op_name, fn, amp_policy=amp_policy, tags=tags)
+        OPS[op_name] = opdef
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return dispatch(opdef, args, kwargs)
+
+        wrapper.op_def = opdef
+        wrapper.raw_fn = fn
+        return wrapper
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return OPS[name]

@@ -1,0 +1,162 @@
+"""Search / sort ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from ..core import dtype as dtypes
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtypes.to_jnp(dtype))
+
+
+@register_op("argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from ..core import dtype as dtypes
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtypes.to_jnp(dtype))
+
+
+@register_op("argsort")
+def argsort(x, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(jnp.int64)
+
+
+@register_op("sort")
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+@register_op("topk")
+def topk(x, k, axis=None, largest=True, sorted=True):
+    if isinstance(k, jnp.ndarray):
+        k = int(k)
+    if axis is None:
+        axis = -1
+    x_m = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(x_m, k)
+    else:
+        vals, idx = jax.lax.top_k(-x_m, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+
+
+@register_op("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False):
+    s = jnp.sort(x, axis=axis)
+    si = jnp.argsort(x, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(si, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op("mode")
+def mode(x, axis=-1, keepdim=False):
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+
+    def count_runs(a):
+        # works on last axis
+        eq = a[..., 1:] == a[..., :-1]
+        run = jnp.concatenate(
+            [jnp.zeros(a.shape[:-1] + (1,), jnp.int32),
+             jnp.cumsum(eq, axis=-1).astype(jnp.int32)], axis=-1)
+        # length of run ending at i: need run-id trick
+        rid = jnp.cumsum(jnp.concatenate(
+            [jnp.zeros(a.shape[:-1] + (1,), jnp.int32),
+             (~eq).astype(jnp.int32)], axis=-1), axis=-1)
+        pos = jnp.arange(a.shape[-1])
+        # count within run = pos - first pos of run
+        first = jnp.min(jnp.where(rid[..., None] == rid[..., None, :],
+                                  pos, a.shape[-1]), axis=-1)
+        return pos - first
+
+    xm = jnp.moveaxis(sorted_x, axis, -1)
+    cnt = count_runs(xm)
+    best = jnp.argmax(cnt, axis=-1)
+    vals = jnp.take_along_axis(xm, best[..., None], axis=-1)[..., 0]
+    orig = jnp.moveaxis(x, axis, -1)
+    idx = jnp.argmax(orig == vals[..., None], axis=-1)
+    if keepdim:
+        vals = jnp.expand_dims(jnp.moveaxis(vals, -1, -1), axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op("nonzero")
+def nonzero(x, as_tuple=False):
+    # dynamic output shape: eager-only
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(n[:, None] for n in nz)
+    return jnp.stack(nz, axis=1).astype(jnp.int64)
+
+
+@register_op("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("bucketize")
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("unique_op")
+def _unique(x, return_index=False, return_inverse=False, return_counts=False,
+            axis=None):
+    res = jnp.unique(x, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    out = _unique(x, return_index, return_inverse, return_counts, axis)
+    return out
+
+
+@register_op("unique_consecutive")
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    flat = x.reshape(-1) if axis is None else x
+    keep = jnp.concatenate([jnp.array([True]), flat[1:] != flat[:-1]])
+    vals = flat[keep]
+    outs = [vals]
+    if return_inverse:
+        outs.append(jnp.cumsum(keep) - 1)
+    if return_counts:
+        idx = jnp.nonzero(keep)[0]
+        counts = jnp.diff(jnp.concatenate([idx, jnp.array([flat.shape[0]])]))
+        outs.append(counts)
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register_op("masked_scatter")
+def masked_scatter(x, mask, value):
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    flat_m = mask_b.reshape(-1)
+    src_idx = jnp.cumsum(flat_m) - 1
+    vals = value.reshape(-1)[jnp.clip(src_idx, 0, value.size - 1)]
+    return jnp.where(flat_m, vals, x.reshape(-1)).reshape(x.shape)

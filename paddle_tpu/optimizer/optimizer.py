@@ -1,0 +1,222 @@
+"""Optimizer base (ref: python/paddle/optimizer/optimizer.py:99).
+
+Each optimizer defines a pure functional `_update_rule(param, grad, state,
+lr, **hyper) -> (new_param, new_state)` over jax arrays. The eager `step()`
+applies it per-parameter; the jit train-step compiler (paddle_tpu.jit)
+reuses the SAME rule inside one fused XLA executable — one definition, two
+surfaces, like the reference's YAML-generated optimizer kernels."""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..autograd import no_grad
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._lr = learning_rate
+        self._parameter_list = self._flatten_params(parameters)
+        self._param_groups = self._build_groups(parameters)
+        self.weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # state: param id -> dict of accumulator name -> jax array
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
+        self._master_weights: Dict[int, jax.Array] = {}
+        self._step_count = 0
+
+    # -- param plumbing --
+    @staticmethod
+    def _flatten_params(parameters):
+        if parameters is None:
+            return []
+        out = []
+        for p in parameters:
+            if isinstance(p, dict):
+                out.extend(p["params"])
+            else:
+                out.append(p)
+        return out
+
+    @staticmethod
+    def _build_groups(parameters):
+        if parameters is None:
+            return []
+        groups = []
+        plain = []
+        for p in parameters:
+            if isinstance(p, dict):
+                groups.append(p)
+            else:
+                plain.append(p)
+        if plain:
+            groups.insert(0, {"params": plain})
+        return groups
+
+    # -- lr --
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # -- state --
+    def _state_names(self) -> List[str]:
+        """accumulator names, e.g. ['moment1', 'moment2', ...]"""
+        return []
+
+    def _init_state(self, p: Tensor) -> Dict[str, jax.Array]:
+        return {}
+
+    def _get_state(self, p: Tensor) -> Dict[str, jax.Array]:
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _master(self, p: Tensor):
+        if not self._multi_precision:
+            return None
+        if p._data.dtype == jnp.float32:
+            return None
+        mw = self._master_weights.get(id(p))
+        if mw is None:
+            mw = p._data.astype(jnp.float32)
+            self._master_weights[id(p)] = mw
+        return mw
+
+    # -- the rule (override) --
+    def _update_rule(self, param, grad, state, lr, group):
+        raise NotImplementedError
+
+    def _group_hyper(self, group):
+        return {
+            "weight_decay": group.get("weight_decay", self.weight_decay),
+            "lr_scale": group.get("learning_rate", 1.0),
+        }
+
+    # -- public API --
+    @no_grad()
+    def step(self):
+        lr = self.get_lr()
+        params_grads = []
+        for group in (self._param_groups or [{"params": self._parameter_list}]):
+            for p in group["params"]:
+                if p.stop_gradient or p._grad is None:
+                    continue
+                params_grads.append((p, p._grad, group))
+        if self._grad_clip is not None:
+            pg = [(p, g) for p, g, _ in params_grads]
+            clipped = self._grad_clip(pg)
+            params_grads = [(p, g2, grp) for (p, g, grp), (_, g2) in
+                            zip(params_grads, clipped)]
+        self._step_count += 1
+        for p, g, group in params_grads:
+            state = self._get_state(p)
+            garr = g._data
+            mw = self._master(p)
+            parr = mw if mw is not None else p._data
+            if garr.dtype != parr.dtype:
+                garr = garr.astype(parr.dtype)
+            new_p, new_state = self._update_rule(parr, garr, state, lr,
+                                                 group)
+            if mw is not None:
+                self._master_weights[id(p)] = new_p
+                p._set_data(new_p.astype(p._data.dtype))
+            else:
+                p._set_data(new_p)
+            self._accumulators[id(p)] = new_state
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._all_params():
+            p._grad = None
+
+    clear_gradients = clear_grad
+
+    def _all_params(self):
+        if self._param_groups:
+            for g in self._param_groups:
+                yield from g["params"]
+        else:
+            yield from self._parameter_list
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # -- checkpointing --
+    def state_dict(self):
+        sd = OrderedDict()
+        for i, p in enumerate(self._all_params()):
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    sd[f"{p.name}_{k}"] = Tensor._wrap(v)
+            mw = self._master_weights.get(id(p))
+            if mw is not None:
+                sd[f"{p.name}_master"] = Tensor._wrap(mw)
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        sd["global_step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for p in self._all_params():
+            st = {}
+            for name in self._state_names():
+                key = f"{p.name}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[name] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            if st:
+                self._accumulators[id(p)] = st
+            mk = f"{p.name}_master"
+            if mk in state_dict:
+                v = state_dict[mk]
+                self._master_weights[id(p)] = (
+                    v._data if isinstance(v, Tensor) else jnp.asarray(v))
+        if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        self._step_count = int(state_dict.get("global_step", 0))
+
+    load_state_dict = set_state_dict
+
+    # hook for the jit train-step compiler: functional view of this optimizer
+    def functional_update(self, params_flat, grads_flat, states, lr):
+        """params/grads: flat lists of arrays; states: list of dicts.
+        Returns (new_params, new_states). Pure — safe under jit."""
+        new_ps, new_sts = [], []
+        group = (self._param_groups[0] if self._param_groups else {})
+        for parr, garr, st in zip(params_flat, grads_flat, states):
+            if garr.dtype != parr.dtype:
+                garr = garr.astype(parr.dtype)
+            np_, ns_ = self._update_rule(parr, garr, st, lr, group)
+            new_ps.append(np_)
+            new_sts.append(ns_)
+        return new_ps, new_sts
+
+    def _apply_decay(self, param, grad, group):
+        """coupled L2: grad += wd * param (ref: regularizer semantics)."""
+        wd = group.get("weight_decay", self.weight_decay)
+        if wd:
+            wd = float(wd) if not hasattr(wd, "_coeff") else wd._coeff
+            return grad + wd * param
+        return grad

@@ -1,0 +1,171 @@
+"""Profiler (ref: python/paddle/profiler/profiler.py:346 + C++ host/device
+tracers §5.1).
+
+Host spans: RecordEvent context managers into an in-process event store,
+exported as chrome-trace JSON (the reference's ChromeTracingLogger role).
+Device timeline: jax.profiler (XLA/PJRT trace) captured alongside when a
+dir is given — TPU kernels, transfers, and host callbacks land in the same
+tensorboard-loadable trace."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+_events: List[dict] = []
+_events_lock = threading.Lock()
+_enabled = False
+
+
+class RecordEvent:
+    """(ref: paddle.profiler.RecordEvent / C++ platform/profiler/
+    event_tracing.h:43)"""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if self._t0 is None or not _enabled:
+            return
+        t1 = time.perf_counter_ns()
+        with _events_lock:
+            _events.append({
+                "name": self.name, "ph": "X", "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "ts": self._t0 / 1000.0, "dur": (t1 - self._t0) / 1000.0,
+            })
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed=0, ready=1, record=4, repeat=0, skip_first=0):
+    total = closed + ready + record
+
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{fname}.pb.trace.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": prof.events()}, f)
+        return path
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self._jax_trace_dir = None
+
+    def start(self):
+        global _enabled, _events
+        _enabled = True
+        with _events_lock:
+            _events = []
+        if not self.timer_only:
+            self._jax_trace_dir = os.environ.get(
+                "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+            try:
+                jax.profiler.start_trace(self._jax_trace_dir)
+            except Exception:
+                self._jax_trace_dir = None
+        return self
+
+    def stop(self):
+        global _enabled
+        _enabled = False
+        if self._jax_trace_dir is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_trace_dir = None
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self.step_num += 1
+
+    def events(self):
+        with _events_lock:
+            return list(_events)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        evs = self.events()
+        agg = {}
+        for e in evs:
+            a = agg.setdefault(e["name"], [0.0, 0])
+            a[0] += e["dur"] / 1000.0
+            a[1] += 1
+        lines = [f"{'name':<50} {'calls':>8} {'total_ms':>12}"]
+        for name, (tot, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:<50} {n:>8} {tot:>12.3f}")
+        return "\n".join(lines)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)

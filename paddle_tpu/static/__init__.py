@@ -1,0 +1,109 @@
+"""Static-graph facade (ref: python/paddle/static/).
+
+TPU-native stance (SURVEY §7.1): the "static graph" IS the jax-traced
+program; Program/Executor here are thin shims that capture a traced
+function per (feed-spec) and run it as one XLA executable. The full
+ProgramDesc/IR surface of the reference is intentionally replaced by
+trace-and-compile (see paddle_tpu/jit)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit import InputSpec  # noqa: F401
+
+_static_mode = False
+
+
+def _enable_static_mode():
+    global _static_mode
+    _static_mode = True
+
+
+def _in_static_mode():
+    return _static_mode
+
+
+class Program:
+    """A deferred computation: ops recorded as a python callable pipeline.
+    Minimal parity object for Executor-style code paths."""
+
+    def __init__(self):
+        self._build_fns = []
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return copy.copy(self)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class Executor:
+    """(ref: python/paddle/base/executor.py:1151) — minimal shim: run()
+    evaluates a python callable pipeline eagerly/jitted."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        raise NotImplementedError(
+            "paddle_tpu.static.Executor runs traced callables; build "
+            "models with paddle_tpu.jit.to_static instead of Program IR")
+
+
+def gradients(targets, inputs, target_gradients=None):
+    from ..autograd import grad
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                allow_unused=True)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kw):
+    from .. import jit as _jit
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save for traced-model persistence")
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load for traced-model persistence")
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()

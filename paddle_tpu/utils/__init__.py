@@ -1,0 +1,87 @@
+"""Utilities (ref: python/paddle/utils/)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"cannot import {module_name}")
+
+
+@contextlib.contextmanager
+def unique_name_guard(prefix=""):
+    yield
+
+
+def to_dlpack(tensor):
+    import jax
+    return jax.dlpack.to_dlpack(tensor._data)
+
+
+def from_dlpack(capsule):
+    import jax
+    from ..core.tensor import Tensor
+    return Tensor._wrap(jax.dlpack.from_dlpack(capsule))
+
+
+dlpack = type("dlpack", (), {"to_dlpack": staticmethod(to_dlpack),
+                             "from_dlpack": staticmethod(from_dlpack)})
+
+
+def run_check():
+    """paddle.utils.run_check analog — verifies the TPU stack end-to-end."""
+    import jax
+    import jax.numpy as jnp
+    from .. import ops, nn, optimizer
+    from ..core.tensor import to_tensor
+    dev = jax.devices()[0]
+    x = to_tensor(np.random.randn(8, 4).astype(np.float32),
+                  stop_gradient=False)
+    w = to_tensor(np.random.randn(4, 4).astype(np.float32),
+                  stop_gradient=False)
+    y = ops.matmul(x, w).sum()
+    y.backward()
+    assert w.grad is not None
+    print(f"paddle_tpu is installed successfully! device = {dev}")
+    return True
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs counter (ref: python/paddle/utils/flops.py)."""
+    from ..nn.layer import Layer
+    from .. import nn as _nn
+    total = [0]
+
+    def hook(layer, inputs, output):
+        import numpy as _np
+        if isinstance(layer, _nn.Linear):
+            total[0] += 2 * int(_np.prod(inputs[0].shape)) // inputs[0].shape[-1] \
+                * layer.weight.shape[0] * layer.weight.shape[1]
+        elif isinstance(layer, _nn.Conv2D):
+            oshape = output.shape
+            kh, kw = layer.kernel_size
+            total[0] += (2 * oshape[0] * oshape[1] * oshape[2] * oshape[3]
+                         * layer.in_channels // layer.groups * kh * kw)
+
+    handles = [l.register_forward_post_hook(hook)
+               for l in net.sublayers(include_self=True)]
+    from ..ops import zeros
+    x = zeros(input_size)
+    net(x)
+    for h in handles:
+        h.remove()
+    return total[0]
+
+
+class deprecated:
+    def __init__(self, update_to="", since="", reason=""):
+        self.update_to = update_to
+
+    def __call__(self, fn):
+        return fn

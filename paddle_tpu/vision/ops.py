@@ -1,0 +1,114 @@
+"""Vision ops (ref: python/paddle/vision/ops.py: roi_align, nms,
+deform_conv2d...)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import register_op
+from ..core.tensor import Tensor
+
+
+@register_op("nms")
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS. Dynamic-output op -> eager only (returns kept indices)."""
+    n = boxes.shape[0]
+    if scores is None:
+        order = jnp.arange(n)
+    else:
+        order = jnp.argsort(-scores)
+    b = boxes[order]
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    areas = (x2 - x1) * (y2 - y1)
+
+    def iou(i, js):
+        xx1 = jnp.maximum(x1[i], x1[js])
+        yy1 = jnp.maximum(y1[i], y1[js])
+        xx2 = jnp.minimum(x2[i], x2[js])
+        yy2 = jnp.minimum(y2[i], y2[js])
+        inter = jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0)
+        return inter / (areas[i] + areas[js] - inter + 1e-10)
+
+    keep_mask = jnp.ones(n, bool)
+    for i in range(n):
+        if not bool(keep_mask[i]):
+            continue
+        rest = jnp.arange(n) > i
+        sup = (iou(i, jnp.arange(n)) > iou_threshold) & rest
+        keep_mask = keep_mask & ~sup
+    kept = order[jnp.nonzero(keep_mask)[0]]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return kept.astype(jnp.int64)
+
+
+@register_op("roi_align")
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign via bilinear grid sampling (ref: vision/ops.py roi_align)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    num_rois = boxes.shape[0]
+    # map each roi to its batch image
+    counts = boxes_num.astype(jnp.int32)
+    batch_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                           total_repeat_length=num_rois)
+    offset = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    rw = jnp.maximum(x2 - x1, 1e-3)
+    rh = jnp.maximum(y2 - y1, 1e-3)
+    ys = (jnp.arange(oh) + 0.5) / oh  # [oh]
+    xs = (jnp.arange(ow) + 0.5) / ow
+    gy = y1[:, None] + rh[:, None] * ys[None, :]  # [R, oh]
+    gx = x1[:, None] + rw[:, None] * xs[None, :]  # [R, ow]
+
+    def bilinear(img, yy, xx):
+        # img [c,h,w]; yy [oh], xx [ow]
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        y1_ = jnp.clip(y0 + 1, 0, h - 1)
+        x1_ = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(yy - y0, 0, 1)[None, :, None]
+        wx = jnp.clip(xx - x0, 0, 1)[None, None, :]
+        v00 = img[:, y0][:, :, x0]
+        v01 = img[:, y0][:, :, x1_]
+        v10 = img[:, y1_][:, :, x0]
+        v11 = img[:, y1_][:, :, x1_]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    out = jax.vmap(lambda bi, yy, xx: bilinear(x[bi], yy, xx))(
+        batch_idx, gy, gx)
+    return out  # [R, c, oh, ow]
+
+
+@register_op("box_coder")
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True):
+    pw = prior_box[:, 2] - prior_box[:, 0]
+    ph = prior_box[:, 3] - prior_box[:, 1]
+    px = prior_box[:, 0] + pw / 2
+    py = prior_box[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0]
+        th = target_box[:, 3] - target_box[:, 1]
+        tx = target_box[:, 0] + tw / 2
+        ty = target_box[:, 1] + th / 2
+        out = jnp.stack([(tx - px) / pw, (ty - py) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+        if prior_box_var is not None:
+            out = out / prior_box_var
+        return out
+    raise NotImplementedError(code_type)
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d needs a dedicated gather kernel; tracked for the "
+        "Pallas kernel milestone")

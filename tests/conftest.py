@@ -1,0 +1,32 @@
+"""Test harness config.
+
+Per the build brief: tests run on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count) so multi-chip sharding logic is
+exercised without TPU hardware. Must run before jax import."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+# the environment's sitecustomize pre-imports jax with the TPU plugin;
+# jax_platforms can still be flipped before any computation runs
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.default_backend() == "cpu", "tests must run on the CPU mesh"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

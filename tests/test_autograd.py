@@ -1,0 +1,195 @@
+"""Autograd engine tests (ref test strategy: test/autograd/ +
+eager backward semantics, SURVEY §3.2)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, grad, no_grad
+
+
+def _t(x, sg=False):
+    return paddle.to_tensor(np.asarray(x, np.float32), stop_gradient=sg)
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = _t([2.0])
+        y = x * x + 3.0 * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_fan_out_accumulation(self):
+        x = _t([3.0])
+        y = x * x
+        z = y + y + x
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [13.0])  # 2*2x + 1
+
+    def test_deep_graph(self):
+        x = _t([[1.0, 2.0], [3.0, 4.0]])
+        w = _t([[0.5, 0.1], [0.2, 0.3]])
+        h = paddle.matmul(x, w)
+        h = paddle.tanh(h)
+        loss = (h * h).sum()
+        loss.backward()
+        assert x.grad is not None and w.grad is not None
+        assert x.grad.shape == [2, 2]
+
+    def test_grad_accumulates_across_backwards(self):
+        x = _t([1.0])
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_clear_grad(self):
+        x = _t([1.0])
+        (x * 2).backward()
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient(self):
+        x = _t([1.0], sg=True)
+        y = _t([1.0])
+        z = x * y
+        z.backward()
+        assert x.grad is None
+        assert y.grad is not None
+
+    def test_detach(self):
+        x = _t([2.0])
+        y = (x * x).detach()
+        z = y * x
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])  # only y*dx
+
+    def test_non_scalar_backward_with_grad(self):
+        x = _t([[1.0, 2.0]])
+        y = x * 2
+        y.backward(paddle.to_tensor(np.ones((1, 2), np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), [[2.0, 2.0]])
+
+    def test_backward_non_scalar_raises(self):
+        x = _t([[1.0, 2.0]])
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_multi_output_op(self):
+        x = _t([[3.0, 1.0], [2.0, 4.0]])
+        vals, idx = paddle.topk(x, k=1, axis=1)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[1.0, 0.0], [0.0, 1.0]])
+
+    def test_retain_graph(self):
+        x = _t([2.0])
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_no_grad_context(self):
+        x = _t([1.0])
+        with no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_hooks(self):
+        x = _t([1.0])
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+class TestGradAPI:
+    def test_grad_basic(self):
+        x = _t([3.0])
+        y = x * x
+        (gx,) = grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # side-effect free
+
+    def test_grad_intermediate(self):
+        x = _t([2.0])
+        y = x * x
+        z = y * 3
+        (gy,) = grad(z, y)
+        np.testing.assert_allclose(gy.numpy(), [3.0])
+
+    def test_grad_unused(self):
+        x = _t([1.0])
+        u = _t([1.0])
+        y = x * 2
+        res = grad(y, [x, u], allow_unused=True)
+        assert res[1] is None
+
+    def test_double_backward_via_retain(self):
+        x = _t([2.0])
+        y = x * x * x
+        (g1,) = grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g1.numpy(), [12.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 2
+
+        x = _t([1.0, 2.0])
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_custom_nonstandard_grad(self):
+        class StraightThrough(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return paddle.sign(x)
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy  # pretend identity
+
+        x = _t([0.5, -0.5])
+        y = StraightThrough.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+class TestAmpAutograd:
+    def test_autocast_matmul_bf16(self):
+        x = _t(np.random.randn(4, 4))
+        w = _t(np.random.randn(4, 4))
+        with paddle.amp.auto_cast(level="O1"):
+            y = paddle.matmul(x, w)
+        assert y.dtype == paddle.bfloat16
+        y.astype("float32").sum().backward()
+        # master grads arrive in fp32 on the fp32 leaves
+        assert w.grad.dtype == paddle.float32
+
+    def test_grad_scaler(self):
+        model = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = _t(np.random.randn(2, 4), sg=True)
+        with paddle.amp.auto_cast():
+            loss = model(x).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        assert opt._step_count == 1

@@ -1,0 +1,282 @@
+"""Op conformance: math/reduction/linalg/manipulation vs numpy
+(OpTest analog, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+def _rand(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class TestBinaryOps:
+    def test_add(self):
+        check_output(paddle.add, np.add,
+                     {"x": _rand(3, 4), "y": _rand(3, 4)})
+
+    def test_broadcast_add(self):
+        check_output(paddle.add, np.add, {"x": _rand(3, 4), "y": _rand(4)})
+
+    def test_subtract(self):
+        check_output(paddle.subtract, np.subtract,
+                     {"x": _rand(3, 4), "y": _rand(3, 4)})
+
+    def test_multiply(self):
+        check_output(paddle.multiply, np.multiply,
+                     {"x": _rand(3, 4), "y": _rand(3, 4)})
+
+    def test_divide(self):
+        check_output(paddle.divide, np.true_divide,
+                     {"x": _rand(3, 4), "y": np.abs(_rand(3, 4)) + 1})
+
+    def test_pow(self):
+        check_output(paddle.pow, np.power,
+                     {"x": np.abs(_rand(3, 4)) + 0.5, "y": _rand(3, 4)})
+
+    def test_maximum(self):
+        check_output(paddle.maximum, np.maximum,
+                     {"x": _rand(3, 4), "y": _rand(3, 4)})
+
+    def test_mod(self):
+        check_output(paddle.mod, np.mod,
+                     {"x": np.abs(_rand(3, 4)) * 10,
+                      "y": np.abs(_rand(3, 4)) + 1})
+
+    def test_add_grad(self):
+        check_grad(paddle.multiply, {"x": _rand(3, 4), "y": _rand(3, 4)})
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("op,ref", [
+        ("exp", np.exp), ("log", None), ("sqrt", None), ("tanh", np.tanh),
+        ("sin", np.sin), ("cos", np.cos), ("abs", np.abs),
+        ("floor", np.floor), ("ceil", np.ceil), ("square", np.square),
+        ("sign", np.sign),
+    ])
+    def test_unary(self, op, ref):
+        x = np.abs(_rand(3, 4)) + 0.5 if op in ("log", "sqrt") else _rand(3, 4)
+        ref = ref or getattr(np, op)
+        # XLA CPU uses fast vectorized transcendentals: tolerate ~1e-4 abs
+        check_output(getattr(paddle, op), ref, {"x": x}, atol=1e-4,
+                     rtol=1e-3)
+
+    def test_sigmoid(self):
+        check_output(paddle.sigmoid, lambda x: 1 / (1 + np.exp(-x)),
+                     {"x": _rand(3, 4)})
+
+    def test_clip(self):
+        check_output(paddle.clip, lambda x, min, max: np.clip(x, min, max),
+                     {"x": _rand(3, 4)}, {"min": -0.5, "max": 0.5})
+
+    def test_rsqrt_grad(self):
+        check_grad(paddle.rsqrt, {"x": np.abs(_rand(3, 3)) + 0.5})
+
+    def test_tanh_grad(self):
+        check_grad(paddle.tanh, {"x": _rand(3, 3)})
+
+
+class TestReductions:
+    def test_sum(self):
+        check_output(paddle.sum, lambda x: np.sum(x), {"x": _rand(3, 4)})
+
+    def test_sum_axis(self):
+        check_output(paddle.sum,
+                     lambda x, axis, keepdim: np.sum(x, axis,
+                                                     keepdims=keepdim),
+                     {"x": _rand(3, 4, 5)}, {"axis": 1, "keepdim": True})
+
+    def test_mean(self):
+        check_output(paddle.mean,
+                     lambda x, axis: np.mean(x, axis),
+                     {"x": _rand(3, 4)}, {"axis": 0})
+
+    def test_max_min(self):
+        check_output(paddle.max, lambda x, axis: np.max(x, axis),
+                     {"x": _rand(3, 4)}, {"axis": 1})
+        check_output(paddle.min, lambda x: np.min(x), {"x": _rand(3, 4)})
+
+    def test_prod(self):
+        check_output(paddle.prod, lambda x: np.prod(x), {"x": _rand(2, 3)})
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse
+        check_output(paddle.logsumexp, lambda x: np_lse(x),
+                     {"x": _rand(3, 4)})
+
+    def test_var_std(self):
+        check_output(paddle.var, lambda x: np.var(x, ddof=1),
+                     {"x": _rand(4, 5)})
+        check_output(paddle.std, lambda x: np.std(x, ddof=1),
+                     {"x": _rand(4, 5)})
+
+    def test_cumsum(self):
+        check_output(paddle.cumsum, lambda x, axis: np.cumsum(x, axis),
+                     {"x": _rand(3, 4)}, {"axis": 1})
+
+    def test_mean_grad(self):
+        check_grad(paddle.mean, {"x": _rand(3, 4)})
+
+
+class TestLinalg:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul,
+                     {"x": _rand(3, 4), "y": _rand(4, 5)}, rtol=1e-4,
+                     atol=1e-4)
+
+    def test_matmul_transpose(self):
+        check_output(paddle.matmul,
+                     lambda x, y, transpose_y: x @ y.T,
+                     {"x": _rand(3, 4), "y": _rand(5, 4)},
+                     {"transpose_y": True}, rtol=1e-4, atol=1e-4)
+
+    def test_batched_matmul(self):
+        check_output(paddle.matmul, np.matmul,
+                     {"x": _rand(2, 3, 4), "y": _rand(2, 4, 5)}, rtol=1e-4,
+                     atol=1e-4)
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, {"x": _rand(3, 4), "y": _rand(4, 2)})
+
+    def test_norm(self):
+        check_output(paddle.norm, lambda x: np.linalg.norm(x.ravel()),
+                     {"x": _rand(3, 4)}, rtol=1e-4)
+
+    def test_einsum(self):
+        x, y = _rand(3, 4), _rand(4, 5)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(x),
+                            paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), x @ y, rtol=1e-4, atol=1e-4)
+
+    def test_svd_solve(self):
+        a = _rand(4, 4) + 4 * np.eye(4, dtype=np.float32)
+        b = _rand(4, 2)
+        out = paddle.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.linalg.solve(a, b),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_cholesky(self):
+        a = _rand(3, 3)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        out = paddle.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(out.numpy(), np.linalg.cholesky(spd),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape(self):
+        check_output(paddle.reshape, lambda x, shape: x.reshape(shape),
+                     {"x": _rand(3, 4)}, {"shape": [2, 6]})
+
+    def test_transpose(self):
+        check_output(paddle.transpose,
+                     lambda x, perm: np.transpose(x, perm),
+                     {"x": _rand(2, 3, 4)}, {"perm": [2, 0, 1]})
+
+    def test_concat(self):
+        x, y = _rand(2, 3), _rand(2, 3)
+        out = paddle.concat([paddle.to_tensor(x), paddle.to_tensor(y)],
+                            axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([x, y], 0))
+
+    def test_stack_split(self):
+        x, y = _rand(2, 3), _rand(2, 3)
+        st = paddle.stack([paddle.to_tensor(x), paddle.to_tensor(y)], axis=0)
+        np.testing.assert_allclose(st.numpy(), np.stack([x, y]))
+        parts = paddle.split(st, 2, axis=0)
+        assert len(parts) == 2
+        np.testing.assert_allclose(parts[0].numpy()[0], x)
+
+    def test_squeeze_unsqueeze(self):
+        check_output(paddle.unsqueeze,
+                     lambda x, axis: np.expand_dims(x, axis),
+                     {"x": _rand(3, 4)}, {"axis": 1})
+        check_output(paddle.squeeze, lambda x, axis: np.squeeze(x, axis),
+                     {"x": _rand(3, 1, 4)}, {"axis": 1})
+
+    def test_gather(self):
+        x = _rand(5, 4)
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[idx])
+
+    def test_where(self):
+        c = np.array([[True, False], [False, True]])
+        x, y = _rand(2, 2), _rand(2, 2)
+        out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(x),
+                           paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), np.where(c, x, y))
+
+    def test_tile_expand(self):
+        check_output(paddle.tile, lambda x, repeat_times: np.tile(
+            x, repeat_times), {"x": _rand(2, 3)}, {"repeat_times": (2, 1)})
+
+    def test_pad(self):
+        x = _rand(2, 3)
+        out = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 2], value=1.0)
+        np.testing.assert_allclose(
+            out.numpy(), np.pad(x, [(1, 1), (2, 2)], constant_values=1.0))
+
+    def test_getitem(self):
+        x = _rand(4, 5, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1].numpy(), x[1])
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_allclose(t[:, None, 0].numpy(), x[:, None, 0])
+
+    def test_setitem(self):
+        x = _rand(4, 5)
+        t = paddle.to_tensor(x)
+        t[1] = 0.0
+        x[1] = 0.0
+        np.testing.assert_allclose(t.numpy(), x)
+
+    def test_cast(self):
+        x = _rand(3, 3)
+        out = paddle.cast(paddle.to_tensor(x), "int32")
+        assert out.dtype == paddle.int32
+
+
+class TestSearchSort:
+    def test_argmax(self):
+        check_output(paddle.argmax, lambda x, axis: np.argmax(x, axis),
+                     {"x": _rand(3, 4)}, {"axis": 1})
+
+    def test_sort_argsort(self):
+        check_output(paddle.sort, lambda x, axis: np.sort(x, axis),
+                     {"x": _rand(3, 4)}, {"axis": 1})
+
+    def test_topk(self):
+        x = _rand(3, 10)
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=3)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_unique(self):
+        x = np.array([1, 3, 1, 2, 3], np.int64)
+        out = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+    def test_nonzero(self):
+        x = np.array([[1, 0], [0, 2]], np.float32)
+        out = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [[0, 0], [1, 1]])
+
+
+class TestLogic:
+    def test_compare(self):
+        x, y = _rand(3, 3), _rand(3, 3)
+        out = paddle.to_tensor(x) > paddle.to_tensor(y)
+        np.testing.assert_array_equal(out.numpy(), x > y)
+
+    def test_allclose_isclose(self):
+        x = _rand(3, 3)
+        assert bool(paddle.allclose(paddle.to_tensor(x),
+                                    paddle.to_tensor(x)))
+
+    def test_logical(self):
+        a = np.array([True, False, True])
+        b = np.array([True, True, False])
+        out = paddle.logical_and(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_array_equal(out.numpy(), a & b)
