@@ -1,0 +1,105 @@
+"""Benchmark: GPT causal-LM training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no absolute numbers (BASELINE.md); the recorded
+north star is >=45% MFU on GPT-class training, so vs_baseline = MFU/0.45.
+The step is the framework's intended perf path: paddle_tpu.jit.TrainStep
+(fwd+bwd+AdamW fused into a single donated-buffer XLA executable) with
+bf16 autocast.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+PEAK_BF16_FLOPS = {
+    # per-chip peak bf16 FLOP/s
+    "v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12, "v4": 275e12,
+    "v3": 123e12, "v6e": 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12  # conservative default: v5e
+
+
+def main():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import amp
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
+    from paddle_tpu.models.gpt import GPTConfig, num_params
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer import AdamW
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        batch, seq, steps = 8, 1024, 20
+    else:  # smoke-test shape for CPU runs of this script
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=256,
+                        hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        batch, seq, steps = 2, 64, 3
+
+    model = GPTForCausalLM(cfg)
+    model.train()
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                weight_decay=0.01)
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(m, ids, labels):
+        with amp.auto_cast(enable=True, level="O1", dtype="bfloat16"):
+            logits = m(ids)
+        return crit(logits, labels)
+
+    step = TrainStep(model, opt, loss_fn)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+
+    # warmup (compile) + one settle step
+    step(ids, labels)
+    loss = step(ids, labels)
+    float(loss.numpy())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    float(loss.numpy())  # block on the device
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n = num_params(cfg)
+    # standard 6ND approximation for fwd+bwd FLOPs/token
+    model_flops = 6.0 * n * tokens_per_sec
+    mfu = model_flops / peak_flops(dev)
+    print(json.dumps({
+        "metric": "gpt2_small_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "params": n,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "batch": batch, "seq": seq, "steps": steps,
+            "final_loss": round(float(loss.numpy()), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

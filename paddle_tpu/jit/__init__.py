@@ -183,7 +183,8 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable = None,
-                 has_aux=False, donate=True):
+                 has_aux=False, donate=True, mesh=None, shard_param=None,
+                 shard_data=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -198,6 +199,33 @@ class TrainStep:
         self._trainable = trainable
         self.opt_states = [optimizer._get_state(p) if t else {}
                            for p, t in zip(ptensors, trainable)]
+        # --- multi-chip: commit params/opt-states to the mesh; XLA's GSPMD
+        # propagation shards the whole fwd+bwd+update program from these
+        # committed input shardings (SURVEY §7.1: completion+partition+
+        # reshard collapse into sharding propagation) ---
+        self.mesh = mesh
+        self._data_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            shard_param = shard_param or (lambda name, shape: PartitionSpec())
+            shardings = [
+                NamedSharding(mesh, shard_param(n, tuple(p.shape)))
+                for n, p in zip(pnames, self.params)]
+            self.params = [jax.device_put(p, s)
+                           for p, s in zip(self.params, shardings)]
+            repl = NamedSharding(mesh, PartitionSpec())
+
+            def _shard_state(v, psh):
+                # moment buffers follow the param sharding; scalars replicate
+                return jax.device_put(
+                    v, psh if getattr(v, "shape", ()) != () else repl)
+
+            self.opt_states = [
+                {k: _shard_state(v, s) for k, v in st.items()}
+                for st, s in zip(self.opt_states, shardings)]
+            self.buffers = [jax.device_put(b, repl) for b in self.buffers]
+            if shard_data is not None:
+                self._data_sharding = NamedSharding(mesh, shard_data)
         self._step_fn = self._build(donate)
         self._rng = jax.random.PRNGKey(0)
         self._step_count = 0
@@ -259,6 +287,8 @@ class TrainStep:
         args = [a._data for a in args]
         kwargs = {k: (v._data if isinstance(v, Tensor) else v)
                   for k, v in kwargs.items()}
+        if self._data_sharding is not None:
+            args = [jax.device_put(a, self._data_sharding) for a in args]
         seed = jax.random.fold_in(self._rng, self._step_count)
         self._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)

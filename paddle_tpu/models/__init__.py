@@ -1,0 +1,19 @@
+"""Flagship model families (GPT / LLaMA / BERT).
+
+The reference keeps language models out-of-tree (PaddleNLP) but its
+north-star benchmarks are GPT-3/LLaMA hybrid-parallel training
+(BASELINE.json configs 2-4); vision models live in paddle.vision.models.
+Here the LM families are first-class so the framework's parallelism and
+benchmarks are self-contained.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
+    gpt_tiny, gpt2_small, gpt3_1p3b, gpt3_6p7b,
+)
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaModel, LlamaForCausalLM, llama_tiny, llama2_7b,
+    llama2_13b,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForMaskedLM, bert_tiny, bert_base,
+)

@@ -1,0 +1,117 @@
+"""BERT encoder + MLM head.
+
+Capability target: BASELINE.json config 2 (BERT-base MLM with fused
+flash-attention + layer-norm). Built on nn.TransformerEncoder so the stock
+layer zoo is exercised end-to-end.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import ops
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear, Embedding, Dropout
+from ..nn.layers.norm import LayerNorm
+from ..nn.layers.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..nn.initializer import Normal
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                      num_heads=4, intermediate_size=256,
+                      max_position_embeddings=128, **kw)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        w = Normal(std=config.initializer_range)
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size, weight_attr=w)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=w)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=w)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = ops.arange(0, s, dtype="int32")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(
+            position_ids)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_heads, config.intermediate_size,
+            dropout=config.hidden_dropout_prob, activation="gelu",
+            attn_dropout=config.attention_dropout_prob,
+            normalize_before=False,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, config.num_layers)
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [b, s] 1/0 mask -> additive [b, 1, 1, s]
+            m = ops.reshape(attention_mask,
+                            (attention_mask.shape[0], 1, 1, -1))
+            attention_mask = (1.0 - ops.cast(m, "float32")) * -1e9
+        x = self.encoder(x, attention_mask)
+        pooled = ops.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        config.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            (config.vocab_size,), is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        hidden, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(ops.gelu(self.transform(hidden)))
+        w = self.bert.embeddings.word_embeddings.weight
+        logits = ops.matmul(h, w, transpose_y=True) + self.decoder_bias
+        if labels is not None:
+            loss = ops.cross_entropy(logits, labels, ignore_index=-100)
+            return loss, logits
+        return logits

@@ -824,8 +824,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) / np.sqrt(d)
     if is_causal:
+        # bottom-right aligned causal mask: with a kv-cache (s_k > s_q)
+        # query i attends keys <= (s_k - s_q) + i; reduces to plain tril
+        # when s_q == s_k and to "attend everything" when s_q == 1
         s_q, s_k = scores.shape[-2], scores.shape[-1]
-        causal = jnp.tril(jnp.ones((s_q, s_k), bool))
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
         scores = jnp.where(causal, scores, -jnp.inf)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
