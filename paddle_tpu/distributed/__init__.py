@@ -1,0 +1,25 @@
+"""paddle_tpu.distributed: mesh-based distributed training.
+
+Reference surface: python/paddle/distributed (130k LoC — SURVEY §2.2).
+TPU-native execution model: ONE SPMD controller owns every device; comm
+groups are mesh axes; collectives are XLA/GSPMD; hybrid parallel is
+sharding placement + a host-driven pipeline schedule.
+"""
+from .communication import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, is_initialized,
+    destroy_process_group, all_reduce, all_gather, all_to_all, alltoall,
+    reduce, broadcast, reduce_scatter, scatter, barrier, send, recv,
+    isend, irecv, P2POp, batch_isend_irecv,
+)
+from .parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv,
+    DataParallel, spawn,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+)
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+
+get_world_size_by_group = get_world_size

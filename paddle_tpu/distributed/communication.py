@@ -1,0 +1,438 @@
+"""Collective communication API.
+
+Reference surface: paddle.distributed.{all_reduce,all_gather,all_to_all,
+broadcast,reduce,reduce_scatter,scatter,send,recv,barrier,
+batch_isend_irecv} + Group registry
+(/root/reference/python/paddle/distributed/communication/*.py,
+communication/group.py). The reference backs these with ProcessGroupNCCL
+per-process; here a single SPMD controller owns every device, so each
+function has TWO modes:
+
+1. **In-trace** (inside `shard_map` with the group's axis bound): the
+   argument is the per-rank local view; collectives are `jax.lax`
+   primitives (psum/all_gather/ppermute/all_to_all) that XLA lowers onto
+   ICI. This is the mode the hybrid-parallel layers use.
+
+2. **Eager rank-major**: a "distributed tensor" of a size-G group is a
+   jax array with leading dim G, sharded over the group's 1-D device
+   mesh; index r along dim 0 is rank r's local tensor. Collectives are
+   shape-preserving jnp programs on that array whose jit lowers to the
+   matching XLA collective (e.g. all_reduce == broadcast(sum(dim0))).
+   This single-controller rendering keeps the reference API shape
+   (tests exercise it on the 8-device CPU mesh).
+
+Async `sync_op=False` returns a completed-Task shim: XLA dispatch is
+already async (the reference's async Task maps onto XLA async
+collectives, SURVEY §5.8).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: (jnp.sum, "add"),
+    ReduceOp.MAX: (jnp.max, "max"),
+    ReduceOp.MIN: (jnp.min, "min"),
+    ReduceOp.PROD: (jnp.prod, "mul"),
+}
+
+
+def _reduce_dim0(x, op):
+    if op == ReduceOp.AVG:
+        return jnp.mean(x, axis=0)
+    if op not in _REDUCE_FNS:
+        raise ValueError(f"unknown ReduceOp {op!r}")
+    return _REDUCE_FNS[op][0](x, axis=0)
+
+
+class Group:
+    """A communication group == an ordered device list with a 1-D mesh
+    (ref: python/paddle/distributed/communication/group.py Group)."""
+
+    def __init__(self, gid: int, ranks: List[int], devices=None,
+                 axis_name: Optional[str] = None, mesh=None,
+                 mesh_axis: Optional[str] = None):
+        self.id = gid
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.axis_name = axis_name or f"_pg{gid}"
+        if mesh is not None:
+            # group backed by an axis of an existing multi-axis mesh
+            self.mesh = mesh
+            self.mesh_axis = mesh_axis
+        else:
+            if devices is None:
+                devices = [jax.devices()[r] for r in ranks]
+            self.mesh = jax.sharding.Mesh(np.array(devices),
+                                          (self.axis_name,))
+            self.mesh_axis = self.axis_name
+        self.process_group = self  # API-compat shim
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def rank(self):
+        return 0
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, ranks={self.ranks})"
+
+
+_GROUP_COUNTER = [0]
+_GROUP_MAP = {}
+_GLOBAL_GROUP: Optional[Group] = None
+
+
+def _new_group_obj(ranks, devices=None, axis_name=None, mesh=None,
+                   mesh_axis=None) -> Group:
+    gid = _GROUP_COUNTER[0]
+    _GROUP_COUNTER[0] += 1
+    g = Group(gid, ranks, devices=devices, axis_name=axis_name, mesh=mesh,
+              mesh_axis=mesh_axis)
+    _GROUP_MAP[gid] = g
+    return g
+
+
+def init_default_group() -> Group:
+    global _GLOBAL_GROUP
+    if _GLOBAL_GROUP is None:
+        n = len(jax.devices())
+        _GLOBAL_GROUP = _new_group_obj(list(range(n)), axis_name="world")
+    return _GLOBAL_GROUP
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return init_default_group()
+    return _GROUP_MAP[gid]
+
+
+def new_group(ranks: Sequence[int] = None, backend=None, timeout=None) -> Group:
+    """ref: python/paddle/distributed/communication/group.py new_group"""
+    if ranks is None:
+        return init_default_group()
+    return _new_group_obj(list(ranks))
+
+
+def _resolve_group(group) -> Group:
+    if group is None:
+        return init_default_group()
+    return group
+
+
+def is_initialized() -> bool:
+    return _GLOBAL_GROUP is not None
+
+
+def destroy_process_group(group=None):
+    global _GLOBAL_GROUP
+    if group is None or group is _GLOBAL_GROUP:
+        _GLOBAL_GROUP = None
+        _GROUP_MAP.clear()
+        _GROUP_COUNTER[0] = 0
+
+
+def _in_trace(group: Group) -> bool:
+    """True when called inside a shard_map region that binds the group's
+    axis (or axes, for fused groups) — arguments are then per-rank local
+    views."""
+    try:
+        names = jax.core.unsafe_get_axis_names_DO_NOT_USE()
+    except Exception:
+        names = []
+    axes = group.mesh_axis if isinstance(group.mesh_axis, tuple) \
+        else (group.mesh_axis,)
+    return all(a in names for a in axes)
+
+
+class _Task:
+    """Completed-task shim (XLA dispatch is already async)."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        if self._result is not None:
+            jax.block_until_ready(
+                self._result._data if isinstance(self._result, Tensor)
+                else self._result)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _rankmajor(x, group: Group):
+    """Commit x to the group's mesh, dim0 sharded over the group axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if x.shape[0] != group.nranks:
+        raise ValueError(
+            f"eager collective expects rank-major dim0 == group size "
+            f"({group.nranks}), got shape {tuple(x.shape)}")
+    ax = group.mesh_axis
+    spec = P(ax, *([None] * (x.ndim - 1)))
+    return jax.device_put(x, NamedSharding(group.mesh, spec))
+
+
+def _finish(tensor, out, sync_op):
+    """Write result back in-place (paddle collectives mutate) and wrap."""
+    if isinstance(tensor, Tensor):
+        tensor._set_data(out)
+        return _Task(tensor) if not sync_op else tensor
+    t = Tensor._wrap(out)
+    return _Task(t) if not sync_op else t
+
+
+# --------------------------------------------------------------------------
+# collectives
+# --------------------------------------------------------------------------
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = _resolve_group(group)
+    x = _unwrap(tensor)
+    if _in_trace(group):
+        if op == ReduceOp.SUM:
+            return Tensor._wrap(jax.lax.psum(x, group.mesh_axis))
+        if op == ReduceOp.MAX:
+            return Tensor._wrap(jax.lax.pmax(x, group.mesh_axis))
+        if op == ReduceOp.MIN:
+            return Tensor._wrap(jax.lax.pmin(x, group.mesh_axis))
+        if op == ReduceOp.AVG:
+            return Tensor._wrap(jax.lax.pmean(x, group.mesh_axis))
+        raise NotImplementedError("PROD inside trace")
+    x = _rankmajor(x, group)
+    if op == ReduceOp.AVG:
+        red = jnp.mean(x, axis=0, keepdims=True)
+    else:
+        red = _REDUCE_FNS[op][0](x, axis=0, keepdims=True)
+    out = jnp.broadcast_to(red, x.shape)
+    out = jax.device_put(out, x.sharding)
+    return _finish(tensor, out, sync_op)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = _resolve_group(group)
+    x = _unwrap(tensor)
+    if _in_trace(group):
+        # every rank computes the reduction; dst semantics are a
+        # multi-process artifact
+        return Tensor._wrap(jax.lax.psum(x, group.mesh_axis))
+    x = _rankmajor(x, group)
+    dst_idx = group.get_group_rank(dst) if dst in group.ranks else dst
+    red = _reduce_dim0(x, op)
+    out = x.at[dst_idx].set(red)
+    return _finish(tensor, out, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    group = _resolve_group(group)
+    x = _unwrap(tensor)
+    if _in_trace(group):
+        src_idx = group.get_group_rank(src) if src in group.ranks else src
+        out = jax.lax.all_gather(x, group.mesh_axis)[src_idx]
+        return Tensor._wrap(out)
+    x = _rankmajor(x, group)
+    src_idx = group.get_group_rank(src) if src in group.ranks else src
+    out = jnp.broadcast_to(x[src_idx:src_idx + 1], x.shape)
+    out = jax.device_put(out, x.sharding)
+    return _finish(tensor, out, sync_op)
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
+    """Two call styles (both in the reference):
+    all_gather(list, tensor) appends G tensors to `list`;
+    all_gather(tensor) (axis-concat style) returns [G*d0, ...]."""
+    group = _resolve_group(group)
+    if tensor is None:
+        tensor, tensor_list = tensor_list, None
+    x = _unwrap(tensor)
+    if _in_trace(group):
+        out = jax.lax.all_gather(x, group.mesh_axis)  # [G, ...]
+        if tensor_list is not None:
+            for i in range(group.nranks):
+                tensor_list.append(Tensor._wrap(out[i]))
+            return _Task() if not sync_op else None
+        return Tensor._wrap(out.reshape((-1,) + x.shape[1:]))
+    x = _rankmajor(x, group)
+    g = group.nranks
+    # out[r] = concat of every rank's local tensor
+    flat = x.reshape((1, g * x.shape[1]) + x.shape[2:]) if x.ndim > 1 \
+        else x.reshape(1, g)
+    out = jnp.broadcast_to(flat, (g,) + flat.shape[1:])
+    if tensor_list is not None:
+        # split back into per-rank pieces of the ORIGINAL local shape
+        # (device-side slicing; no host round-trip)
+        per = out[0].reshape((g,) + x.shape[1:])
+        for i in range(g):
+            tensor_list.append(Tensor._wrap(per[i]))
+        return _Task() if not sync_op else None
+    return _finish(None, out, sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    group = _resolve_group(group)
+    if tensor_or_tensor_list is None:
+        src = tensor
+        dst = None
+    else:
+        dst, src = tensor, tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        x = jnp.stack([_unwrap(t) for t in src])
+        x = x.reshape((-1,) + x.shape[2:])
+    else:
+        x = _unwrap(src)
+    if _in_trace(group):
+        out = jax.lax.psum_scatter(x, group.mesh_axis, tiled=True)
+        if dst is not None:
+            dst._set_data(out)
+            return _Task(dst) if not sync_op else dst
+        return Tensor._wrap(out)
+    g = group.nranks
+    x = _rankmajor(x, group)
+    red = _reduce_dim0(x, op)
+    # scatter: rank r gets chunk r (local dim0 must divide by G)
+    out = red.reshape((g, red.shape[0] // g) + red.shape[1:])
+    out = jax.device_put(out, x.sharding)
+    if dst is not None:
+        dst._set_data(out)
+        return _Task(dst) if not sync_op else dst
+    return _finish(None, out, sync_op)
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
+               sync_op=True):
+    group = _resolve_group(group)
+    g = group.nranks
+    if in_tensor_list is None:
+        # tensor style: [G, d, ...] rank-major, each local split into G
+        x = _unwrap(out_tensor_list)
+        if _in_trace(group):
+            out = jax.lax.all_to_all(
+                x.reshape((g, x.shape[0] // g) + x.shape[1:]),
+                group.mesh_axis, split_axis=0, concat_axis=0, tiled=False)
+            return Tensor._wrap(out.reshape(x.shape))
+        x = _rankmajor(x, group)
+        d = x.shape[1]
+        blocks = x.reshape((g, g, d // g) + x.shape[2:])
+        out = jnp.swapaxes(blocks, 0, 1).reshape(x.shape)
+        out = jax.device_put(out, x.sharding)
+        return _finish(None, out, sync_op)
+    # list style (in_tensor_list = G tensors on "this rank")
+    x = jnp.stack([_unwrap(t) for t in in_tensor_list])
+    if _in_trace(group):
+        out = jax.lax.all_to_all(x, group.mesh_axis, split_axis=0,
+                                 concat_axis=0, tiled=True)
+        outs = jnp.split(out, g, axis=0)
+    else:
+        outs = [x[i] for i in range(g)]  # degenerate single-controller view
+    out_tensor_list.extend(Tensor._wrap(o) for o in outs)
+    return _Task() if not sync_op else None
+
+
+alltoall = all_to_all
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = _resolve_group(group)
+    g = group.nranks
+    if tensor_list is not None:
+        out = _rankmajor(jnp.stack([_unwrap(t) for t in tensor_list]),
+                         group)
+        return _finish(tensor, out, sync_op)
+    else:
+        x = _unwrap(tensor)
+        x = _rankmajor(x, group)
+        src_idx = group.get_group_rank(src) if src in group.ranks else src
+        # src's local tensor is split into G chunks
+        chunks = x[src_idx].reshape((g, x.shape[1] // g) + x.shape[2:])
+        out = jax.device_put(chunks, x.sharding)
+    return _finish(tensor, out, sync_op)
+
+
+def barrier(group=None):
+    group = _resolve_group(group)
+    jax.block_until_ready(jnp.zeros(()))
+    return None
+
+
+# ---- p2p: single-controller renderings of send/recv ----------------------
+# The controller runs BOTH sides of every send/recv pair, so messages form
+# a strict FIFO per group: recv pops the oldest outstanding send. This is
+# exact for the pipeline/pairwise-group patterns the reference tests use;
+# rank-addressed p2p inside a traced region should use `ppermute` instead.
+import collections as _collections  # noqa: E402
+
+_P2P_BUF = {}
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    group = _resolve_group(group)
+    _P2P_BUF.setdefault(group.id, _collections.deque()).append(
+        (dst, _unwrap(tensor)))
+    return _Task() if not sync_op else None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    group = _resolve_group(group)
+    buf = _P2P_BUF.get(group.id)
+    if buf:
+        _, v = buf.popleft()
+        tensor._set_data(v)
+    return _Task(tensor) if not sync_op else tensor
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    """ref: python/paddle/distributed/communication/batch_isend_irecv.py"""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, group=op.group,
+                           sync_op=False))
+    return tasks
+
+
+# ---- in-trace helpers used by the parallel layers ------------------------
+def ppermute(x, group: Group, perm):
+    """collective_permute on the per-rank view (in-trace only)."""
+    x = _unwrap(x)
+    return Tensor._wrap(jax.lax.ppermute(x, group.mesh_axis, perm))
+
+
+def axis_index(group: Group):
+    """This rank's index along the group axis (in-trace only)."""
+    return Tensor._wrap(jax.lax.axis_index(group.mesh_axis))

@@ -1,0 +1,14 @@
+"""Fleet: hybrid-parallel orchestration facade.
+
+Reference: python/paddle/distributed/fleet/fleet.py (init:167,
+distributed_model, distributed_optimizer) + DistributedStrategy
+(fleet/base/distributed_strategy.py:175 over distributed_strategy.proto).
+"""
+from .fleet import (  # noqa: F401
+    init, get_hybrid_communicate_group, distributed_model,
+    distributed_optimizer, DistributedStrategy, Fleet, fleet,
+    worker_num, worker_index,
+)
+from ..topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+)

@@ -1,0 +1,157 @@
+"""Fleet facade (ref: python/paddle/distributed/fleet/fleet.py).
+
+fleet.init(strategy) builds the hybrid mesh (HybridCommunicateGroup);
+distributed_model / distributed_optimizer wrap by strategy the way
+fleet/model.py:141-160 and fleet.py:1307 do.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+
+from ...nn.layer import Layer
+from ..topology import (
+    CommunicateTopology, HybridCommunicateGroup,
+    set_hybrid_communicate_group, get_hybrid_communicate_group as _get_hcg,
+)
+
+
+class DistributedStrategy:
+    """Python mirror of distributed_strategy.proto (ref:
+    fleet/base/distributed_strategy.py:175; hybrid degrees proto:96-99).
+    Only the knobs with TPU meaning are modelled; the rest are accepted
+    and stored so user configs round-trip."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.fuse_all_reduce_ops = True
+        self.find_unused_parameters = False
+        self._extra = {}
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            # merge (paddle semantics: partial dict update)
+            merged = dict(self.hybrid_configs)
+            merged.update(v)
+            object.__setattr__(self, k, merged)
+        else:
+            object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class Fleet:
+    """ref: fleet/fleet.py Fleet (the singleton `fleet`)."""
+
+    def __init__(self):
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        strategy = strategy or DistributedStrategy()
+        self._strategy = strategy
+        hc = strategy.hybrid_configs
+        world = len(jax.devices())
+        degrees = {}
+        for k in ("dp_degree", "mp_degree", "pp_degree",
+                  "sharding_degree", "sep_degree"):
+            v = hc.get(k, 1)
+            degrees[k] = 1 if v in (None, -1) else max(1, int(v))
+        # dp_degree = -1 / unset absorbs the remaining devices
+        fixed = (degrees["mp_degree"] * degrees["pp_degree"] *
+                 degrees["sharding_degree"] * degrees["sep_degree"])
+        if hc.get("dp_degree") in (None, -1):
+            degrees["dp_degree"] = max(1, world // fixed)
+        self._hcg = HybridCommunicateGroup(
+            dp=degrees["dp_degree"], mp=degrees["mp_degree"],
+            pp=degrees["pp_degree"], sharding=degrees["sharding_degree"],
+            sep=degrees["sep_degree"])
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_num(self):
+        return len(jax.devices())
+
+    def worker_index(self):
+        return 0
+
+    def is_first_worker(self):
+        return True
+
+    def barrier_worker(self):
+        return None
+
+    def distributed_model(self, model: Layer):
+        """ref: fleet/model.py:32 — wrap by strategy degrees."""
+        assert self._hcg is not None, "call fleet.init first"
+        from ..meta_parallel import (
+            ShardingParallel, SegmentParallel, TensorParallel,
+            PipelineParallel, PipelineLayer,
+        )
+        hcg = self._hcg
+        if hcg.get_pipe_parallel_world_size() > 1:
+            assert isinstance(model, PipelineLayer), (
+                "pp_degree > 1 requires the model to be a PipelineLayer")
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            model = ShardingParallel(model, hcg, self._strategy)
+        if hcg.get_sep_parallel_world_size() > 1:
+            model = SegmentParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            model = TensorParallel(model, hcg, self._strategy)
+        if hcg.get_data_parallel_world_size() > 1 and not isinstance(
+                model, (TensorParallel, SegmentParallel, ShardingParallel)):
+            from ..parallel import DataParallel
+            model = DataParallel(model, group=hcg.get_data_parallel_group())
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """ref: fleet.py:1307 -> HybridParallelOptimizer."""
+        assert self._hcg is not None, "call fleet.init first"
+        from ..meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       strategy or self._strategy)
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+
+def worker_num():
+    return len(jax.devices())
+
+
+def worker_index():
+    return 0

@@ -1,0 +1,26 @@
+"""meta_parallel: hybrid-parallel layers and model wrappers
+(ref: python/paddle/distributed/fleet/meta_parallel/)."""
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .parallel_wrappers import (  # noqa: F401
+    MetaParallelBase, TensorParallel, ShardingParallel, SegmentParallel,
+)
+from .pp_layers import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer,
+)
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .hybrid_optimizer import (  # noqa: F401
+    HybridParallelOptimizer, HybridParallelGradScaler,
+)
+from .moe_layer import MoELayer  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    ScatterOp, GatherOp, AllGatherOp, ColumnSequenceParallelLinear,
+    RowSequenceParallelLinear, register_sequence_parallel_allreduce_hooks,
+    mark_as_sequence_parallel_parameter,
+)

@@ -1,0 +1,144 @@
+"""Tensor-parallel (mpu) layers.
+
+Reference: VocabParallelEmbedding / ColumnParallelLinear /
+RowParallelLinear / ParallelCrossEntropy
+(/root/reference/python/paddle/distributed/fleet/layers/mpu/mp_layers.py:
+47,333,540,741) and the identity/allreduce PyLayers in mp_ops.py.
+
+TPU-native rendering: the reference manually splits weights per rank and
+inserts c_identity/mp_allreduce collectives. Here each layer creates the
+FULL logical weight and commits it to the hybrid mesh with the
+tensor-parallel NamedSharding (column weights P(None,"mp"), row weights
+P("mp",None), vocab embedding P("mp",None)). JAX executes eager ops on
+committed-sharded arrays with GSPMD — the matching all-reduce /
+all-gather collectives are inserted by XLA both eagerly and under jit,
+so the forward code is just the dense math. This collapses the
+reference's 700-line PyLayer machinery into sharding annotations
+(SURVEY §7.1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ... import ops
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...nn.initializer import XavierUniform, Constant, Normal
+from ..topology import get_hybrid_communicate_group
+
+
+def _mesh():
+    hcg = get_hybrid_communicate_group()
+    assert hcg is not None, "fleet.init(...) must run before mpu layers"
+    return hcg.mesh
+
+
+def _commit(param: Tensor, spec: P):
+    param._data = jax.device_put(param._data, NamedSharding(_mesh(), spec))
+    param._dist_attr = spec
+    return param
+
+
+from ...ops.registry import register_op  # noqa: E402
+
+
+@register_op("dist_reshard")
+def _dist_reshard(x, dst_sharding=None):
+    """Differentiable resharding (device_put is a jax primitive with a
+    transpose rule, so grads flow and GSPMD inserts the collective)."""
+    return jax.device_put(x, dst_sharding)
+
+
+class VocabParallelEmbedding(Layer):
+    """ref: mp_layers.py:47 — embedding table sharded on the vocab dim."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim),
+            attr=weight_attr, default_initializer=XavierUniform())
+        _commit(self.weight, P("mp", None))
+
+    def forward(self, x):
+        return ops.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """ref: mp_layers.py:333 — weight sharded on the output dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        _commit(self.weight, P(None, "mp"))
+        if has_bias:
+            self.bias = self.create_parameter(
+                (out_features,), attr=None, is_bias=True)
+            _commit(self.bias, P("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = ops.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            # replicate the mp-sharded output (XLA all-gather)
+            y = _dist_reshard(y, dst_sharding=NamedSharding(_mesh(), P()))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """ref: mp_layers.py:540 — weight sharded on the input dim; output is
+    the partial-sum all-reduce (inserted by GSPMD)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        _commit(self.weight, P("mp", None))
+        if has_bias:
+            self.bias = self.create_parameter(
+                (out_features,), attr=None, is_bias=True)
+            _commit(self.bias, P())
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return ops.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """ref: mp_layers.py:741 — softmax-CE over vocab-sharded logits.
+    GSPMD computes the two reductions (max, sum-exp) with mp collectives
+    automatically; the code is the dense formula."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return ops.cross_entropy(input, label,
+                                 ignore_index=self.ignore_index,
+                                 reduction="none")
+
+
+__all__ = [
+    "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+    "ParallelCrossEntropy",
+]

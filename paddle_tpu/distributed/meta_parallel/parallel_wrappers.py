@@ -1,0 +1,69 @@
+"""Hybrid-parallel model wrappers.
+
+Reference: meta_parallel/{tensor_parallel.py:28, sharding_parallel.py,
+segment_parallel.py:26} + MetaParallelBase. Those wrappers broadcast
+parameters inside their comm group at init (per-process weights must
+agree). Single-controller GSPMD rendering: "broadcast" == commit every
+not-yet-committed parameter onto the hybrid mesh (replicated by default;
+mpu layers already committed their TP shardings), so the whole model
+lives on one mesh and every eager op runs SPMD.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn.layer import Layer
+from ...core.tensor import Tensor
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        mesh = self._hcg.mesh
+        for p in self._layers.parameters():
+            if p._dist_attr is None:
+                p._data = jax.device_put(
+                    p._data, NamedSharding(mesh, P()))
+                p._dist_attr = P()
+        for b in self._layers.buffers():
+            if isinstance(b, Tensor) and b._dist_attr is None:
+                b._data = jax.device_put(
+                    b._data, NamedSharding(mesh, P()))
+                b._dist_attr = P()
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    # surface the wrapped layer's API
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)
+
+    def named_parameters(self, *a, **kw):
+        return self._layers.named_parameters(*a, **kw)
+
+
+class TensorParallel(MetaParallelBase):
+    """ref: meta_parallel/tensor_parallel.py:28"""
+
+
+class ShardingParallel(MetaParallelBase):
+    """ref: meta_parallel/sharding_parallel.py"""
+
+
+class SegmentParallel(MetaParallelBase):
+    """ref: meta_parallel/segment_parallel.py:26 — the model itself uses
+    the sep group to shard the sequence dim; the wrapper commits params
+    and (via hybrid optimizer) syncs grads over dp x sep."""

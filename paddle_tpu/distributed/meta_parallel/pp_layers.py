@@ -1,0 +1,306 @@
+"""Pipeline model description + stage placement.
+
+Reference: PipelineLayer / LayerDesc / SharedLayerDesc / SegmentLayers
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py:237,56,76,92).
+
+TPU rendering: the single controller builds EVERY stage (the reference
+builds only the local rank's); each stage's parameters are committed to a
+per-stage SUB-MESH carved from the hybrid mesh's "pp" axis, so stage s
+physically lives on the pp==s devices. Activations cross stages through a
+differentiable transfer op (custom-vjp device_put) — the p2p
+send/recv analog whose backward transfers the cotangent back. Because XLA
+dispatch is async, enqueuing stage s+1 of micro-batch m while stage s
+computes micro-batch m+1 yields real pipeline overlap from a plain
+Python loop (the reference's host-driven 1F1B, SURVEY §7.3).
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...nn.layers.container import LayerList
+from ...ops.registry import OpDef, dispatch
+from ..topology import get_hybrid_communicate_group
+
+_STAGE_AXES = ("dp", "sharding", "sep", "mp")
+
+
+class LayerDesc:
+    """ref: pp_layers.py:56"""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """ref: pp_layers.py:76 — one layer instance shared by several
+    positions (e.g. tied embedding + lm-head)."""
+
+    def __init__(self, key, layer_func, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """ref: pp_layers.py:92 — split N layer descs into num_parts stages,
+    uniformly or on a layer-class boundary regex."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.descs)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":", 1)[1]
+            weights = [0] * n
+            for i, d in enumerate(self.descs):
+                name = (d.layer_func.__name__ if isinstance(d, LayerDesc)
+                        else type(d).__name__)
+                if re.search(cls_name, name):
+                    weights[i] = 1
+            total = sum(weights)
+            assert total % self.num_parts == 0 or total >= self.num_parts, (
+                f"{total} {cls_name} layers cannot fill {self.num_parts} "
+                "stages")
+            return self._segment_by_weight(weights)
+        raise ValueError(f"unknown seg method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(num_parts):
+            result[i + 1] = result[i] + part + (1 if i < extra else 0)
+        result[num_parts] = num_items
+        return result
+
+    def _segment_by_weight(self, weights) -> List[int]:
+        total = sum(weights)
+        per = total / self.num_parts
+        bounds = [0]
+        acc = 0.0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= per * len(bounds) and len(bounds) < self.num_parts:
+                bounds.append(i + 1)
+        bounds.append(len(weights))
+        return bounds
+
+
+def _make_xfer_op(dst_sharding, src_sharding, name):
+    """Differentiable cross-stage transfer (the send/recv pair)."""
+
+    @jax.custom_vjp
+    def xfer(x):
+        return jax.device_put(x, dst_sharding)
+
+    def fwd(x):
+        return xfer(x), None
+
+    def bwd(_, ct):
+        return (jax.device_put(ct, src_sharding),)
+
+    xfer.defvjp(fwd, bwd)
+    return OpDef(name, lambda x: xfer(x))
+
+
+class PipelineLayer(Layer):
+    """ref: pp_layers.py:237"""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = (hcg.get_pipe_parallel_world_size()
+                          if hcg is not None else 1)
+        self._num_stages = num_stages
+        self._descs = list(layers)
+        bounds = SegmentLayers(self._descs, num_stages,
+                               seg_method).do_segment()
+        self.segment_parts = bounds
+
+        # build every stage; shared descs build once (keyed)
+        self._shared: dict = {}
+        self._stage_of_layer: List[int] = []
+        stage_lists = []
+        for s in range(num_stages):
+            mods = []
+            for i in range(bounds[s], bounds[s + 1]):
+                d = self._descs[i]
+                if isinstance(d, SharedLayerDesc):
+                    first_use = d.layer_name not in self._shared
+                    if first_use:
+                        self._shared[d.layer_name] = (d.build_layer(), s)
+                    layer, home = self._shared[d.layer_name]
+                    mods.append(_SharedCall(layer, d.forward_func, home, s,
+                                            own_params=first_use,
+                                            pipe=self))
+                elif isinstance(d, LayerDesc):
+                    mods.append(d.build_layer())
+                elif isinstance(d, Layer):
+                    mods.append(d)
+                else:  # plain callable (e.g. a lambda reshaping)
+                    mods.append(_FnLayer(d))
+                self._stage_of_layer.append(s)
+            stage_lists.append(LayerList(mods))
+        self.stages = LayerList(stage_lists)
+
+        # per-stage sub-meshes + param placement
+        self._stage_meshes: List[Optional[Mesh]] = [None] * num_stages
+        self._xfer_cache = {}
+        if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
+            self._build_stage_meshes(hcg)
+
+    def _build_stage_meshes(self, hcg):
+        devs = hcg.mesh.devices  # (dp, pp, sharding, sep, mp)
+        for s in range(self._num_stages):
+            sub = devs[:, s]
+            self._stage_meshes[s] = Mesh(sub, _STAGE_AXES)
+        for s, stage in enumerate(self.stages):
+            mesh = self._stage_meshes[s]
+            for mod in stage:
+                if isinstance(mod, _SharedCall):
+                    # shared params live on their HOME stage's mesh
+                    mesh_home = self._stage_meshes[mod.home_stage]
+                    self._commit_layer(mod.layer, mesh_home)
+                else:
+                    self._commit_layer(mod, mesh)
+
+    @staticmethod
+    def _commit_layer(layer: Layer, mesh: Mesh):
+        for p in layer.parameters():
+            spec = p._dist_attr
+            if spec is None or any(ax not in mesh.axis_names
+                                   for ax in _spec_axes(spec)):
+                spec = P()
+            p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+            p._dist_attr = spec
+
+    # ---- stage-by-stage forward ----
+    def _transfer(self, x: Tensor, dst_stage: int) -> Tensor:
+        mesh = self._stage_meshes[dst_stage]
+        if mesh is None:
+            return x
+        src_sh = x._data.sharding
+        spec = P()
+        if isinstance(src_sh, NamedSharding) and all(
+                ax in mesh.axis_names for ax in _spec_axes(src_sh.spec)):
+            spec = src_sh.spec
+        dst = NamedSharding(mesh, spec)
+        key = (dst_stage, str(src_sh), str(spec), x._data.shape,
+               str(x._data.dtype))
+        op = self._xfer_cache.get(key)
+        if op is None:
+            op = _make_xfer_op(dst, src_sh, f"pp_xfer_{dst_stage}")
+            self._xfer_cache[key] = op
+        return dispatch(op, (x,), {})
+
+    def forward_stage(self, x, stage_id: int):
+        stage = self.stages[stage_id]
+        mods = list(stage)
+        i = 0
+        while i < len(mods):
+            if (self._recompute_interval > 0 and
+                    not isinstance(mods[i], _SharedCall)):
+                from .recompute import recompute_sequential
+                j = min(i + self._recompute_interval, len(mods))
+                chunk = [m for m in mods[i:j]
+                         if not isinstance(m, _SharedCall)]
+                if len(chunk) == j - i:
+                    x = recompute_sequential({"segments": 1}, chunk, x)
+                    i = j
+                    continue
+            x = mods[i](x)
+            i += 1
+        return x
+
+    def forward(self, x):
+        for s in range(self._num_stages):
+            if s > 0:
+                x = self._transfer(x, s) if not isinstance(x, tuple) else \
+                    tuple(self._transfer(t, s) for t in x)
+            x = self.forward_stage(x, s)
+        return x
+
+    def get_stage_params(self, stage_id):
+        return list(self.stages[stage_id].parameters())
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kw):
+        return self._fn(*args, **kw)
+
+
+class _SharedCall(Layer):
+    """A (possibly remote) call position of a shared layer. At non-home
+    stages the shared layer's parameters ride the differentiable transfer
+    so grads accumulate on the home copy (the reference allreduces shared
+    grads across the stage pair instead)."""
+
+    def __init__(self, layer: Layer, forward_func, home_stage: int,
+                 stage: int, own_params=False, pipe=None):
+        super().__init__()
+        if own_params:
+            self.layer = layer  # registers params (home position only)
+        else:
+            object.__setattr__(self, "layer", layer)
+        self.forward_func = forward_func
+        self.home_stage = home_stage
+        self.stage = stage
+        import weakref
+        self._pipe = weakref.ref(pipe) if pipe is not None else None
+
+    def forward(self, x):
+        pipe = self._pipe() if self._pipe is not None else None
+        if (pipe is not None and self.stage != self.home_stage and
+                pipe._stage_meshes[self.home_stage] is not None):
+            # compute on the devices that hold the shared weight
+            x = pipe._transfer(x, self.home_stage)
+        if self.forward_func is not None:
+            return self.forward_func(self.layer, x)
+        return self.layer(x)
+
+
+def _spec_axes(spec: P):
+    axes = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(entry)
+        else:
+            axes.append(entry)
+    return axes

@@ -1,0 +1,86 @@
+"""TP randomness discipline.
+
+Reference: RNGStatesTracker
+(/root/reference/python/paddle/distributed/fleet/layers/mpu/random.py:34)
+— TP-correct dropout needs "same seed inside an mp group for replicated
+activations, different seed across mp for sharded activations".
+
+TPU rendering (SURVEY §7.3 "per-mesh-axis PRNG key folding"): states are
+jax PRNG keys; `add` folds a named seed, and entering a tracker context
+swaps the framework generator's key so every random op drawn inside uses
+the tracked stream. In single-controller GSPMD, a dropout mask computed
+on a sharded activation is automatically consistent across the mp group
+(the mask array itself is sharded), so `get_states_tracker` is mostly
+API-parity + determinism control.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...core.generator import default_generator
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        gen = default_generator()
+        orig = gen.get_state()
+        gen.set_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = gen.get_state()
+            gen.set_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """ref: mpu/random.py model_parallel_random_seed — derive distinct
+    local/global streams from one base seed."""
+    import paddle_tpu
+    seed = seed if seed is not None else 1024
+    global_seed = seed
+    local_seed = seed + 1024 + 1  # distinct per-mp stream seed
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    paddle_tpu.seed(global_seed)
+    tracker.add(MODEL_PARALLEL_RNG, local_seed)
+
+
+def determinate_seed(name):
+    tracker = get_rng_state_tracker()
+    return tracker.states_.get(name)

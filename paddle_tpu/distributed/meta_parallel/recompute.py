@@ -1,0 +1,90 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference: RecomputeFunction
+(/root/reference/python/paddle/distributed/fleet/recompute/recompute.py:108)
+— drop a block's activations in forward, re-run it inside backward.
+
+TPU rendering: `jax.checkpoint` IS this feature. The block is
+functionalised (Layer params become explicit vjp inputs) and wrapped in
+jax.checkpoint, so the eager tape's vjp closure holds only the block
+inputs and re-runs the forward during backward; under jit the same code
+gives XLA rematerialisation.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.tensor import Tensor
+from ...core.generator import rng_scope, next_key
+from ...nn.layer import Layer
+from ...ops.registry import OpDef, dispatch
+from ...autograd import tape
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """ref: recompute.py recompute(function, *args). `function` may be a
+    Layer (its parameters join the differentiable inputs) or a pure
+    function of its tensor arguments."""
+    if isinstance(function, Layer):
+        layer = function
+        fn = function.forward
+    else:
+        layer = getattr(function, "__self__", None)
+        layer = layer if isinstance(layer, Layer) else None
+        fn = function
+
+    ptensors = list(layer.parameters()) if layer is not None else []
+
+    from ...jit import _functional_params
+
+    def raw(seed, params, inputs, kw):
+        def body(seed, params, inputs, kw):
+            with rng_scope(seed):
+                with _functional_params(ptensors, list(params)):
+                    with tape.no_grad():
+                        out = fn(*inputs, **kw)
+            flat, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            flat = [o._data if isinstance(o, Tensor) else o for o in flat]
+            raw._out_tree = treedef
+            return tuple(flat)
+
+        return jax.checkpoint(body)(seed, params, inputs, kw)
+
+    opdef = OpDef(f"recompute_{getattr(fn, '__name__', 'fn')}", raw)
+    seed = next_key() if preserve_rng_state else jax.random.PRNGKey(0)
+    out = dispatch(opdef, (seed, list(ptensors), list(args), dict(kwargs)),
+                   {})
+    flat, _ = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    return jax.tree_util.tree_unflatten(raw._out_tree, flat)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """ref: recompute_sequential — chunk a Sequential and recompute each
+    chunk."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    sublayers = list(functions) if isinstance(
+        functions, (list, tuple)) else list(functions.children())
+    n = len(sublayers)
+    per = max(1, n // segments)
+    x = args[0] if len(args) == 1 else args
+
+    class _Chunk(Layer):
+        def __init__(self, mods):
+            super().__init__()
+            from ...nn.layers.container import LayerList
+            self.mods = LayerList(mods)
+
+        def forward(self, inp):
+            for m in self.mods:
+                inp = m(inp)
+            return inp
+
+    i = 0
+    while i < n:
+        chunk = _Chunk(sublayers[i:i + per])
+        x = recompute(chunk, x, **kwargs)
+        i += per
+    return x

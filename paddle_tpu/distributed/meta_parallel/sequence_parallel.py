@@ -1,0 +1,103 @@
+"""Sequence-parallel utilities.
+
+Reference: fleet/utils/sequence_parallel_utils.py — ScatterOp:85/
+GatherOp:97/AllGatherOp:111/ReduceScatterOp:127,
+ColumnSequenceParallelLinear:230, RowSequenceParallelLinear:340.
+
+TPU rendering: sequence parallelism is a sharding choice, not a layer
+rewrite — activations carry P("dp", "mp", None) on [b, s, h] in the
+layernorm/dropout region and the boundary ops become differentiable
+reshards (GSPMD emits the all-gather before column-linear and the
+reduce-scatter after row-linear). The explicit op classes are kept for
+API parity and for manual control.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ..topology import get_hybrid_communicate_group
+from .mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, _dist_reshard, _mesh,
+)
+
+
+def _seq_spec(ndim, axis="mp"):
+    # [b, s, ...] with the sequence dim sharded
+    spec = [None] * ndim
+    spec[1] = axis
+    return P(*spec)
+
+
+def scatter(x, axis="mp"):
+    """Shard the sequence dim across the mp group (ScatterOp:85)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    return _dist_reshard(
+        t, dst_sharding=NamedSharding(_mesh(), _seq_spec(t.ndim, axis)))
+
+
+def all_gather(x, axis="mp"):
+    """Replicate the sequence dim (AllGatherOp:111)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    return _dist_reshard(t, dst_sharding=NamedSharding(_mesh(), P()))
+
+
+GatherOp = all_gather
+ScatterOp = scatter
+AllGatherOp = all_gather
+
+
+def reduce_scatter(x, axis="mp"):
+    """Partial-sum -> sequence-sharded (ReduceScatterOp:127). GSPMD: a
+    reshard to the seq-sharded spec after a row-parallel matmul lowers to
+    reduce-scatter."""
+    return scatter(x, axis)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """ref: sequence_parallel_utils.py:230 — input arrives seq-sharded;
+    all-gather (via reshard) before the column matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None,
+                 name=None):
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr, has_bias=has_bias,
+                         gather_output=gather_output, mp_group=mp_group,
+                         name=name)
+
+    def forward(self, x):
+        x = all_gather(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """ref: sequence_parallel_utils.py:340 — reduce-scatter the output
+    onto the sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr, has_bias=has_bias,
+                         input_is_parallel=input_is_parallel,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        y = super().forward(x)
+        return reduce_scatter(y)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               use_mp=True):
+    """ref: sequence_parallel_utils.py:192 — SP-region params (layernorm)
+    need allreduce over mp. GSPMD computes those grads globally already;
+    kept as a no-op for API parity."""
+    return None
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param._sequence_parallel = True
+    return param

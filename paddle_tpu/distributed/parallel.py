@@ -1,0 +1,114 @@
+"""Parallel environment bootstrap + dygraph DataParallel.
+
+Reference: python/paddle/distributed/parallel.py (init_parallel_env:943,
+DataParallel:202). The reference bootstraps per-process NCCL comms via a
+TCPStore; a TPU SPMD controller already owns all devices, so
+init_parallel_env just materialises the world group. DataParallel wraps a
+layer so grads are averaged over the dp group after backward — the
+reference's Reducer bucket/overlap machinery is unnecessary here because
+XLA schedules async all-reduces itself when the step is jitted
+(SURVEY §7.1 "Reducer-style DP fusion (or rely on XLA async collectives)").
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .communication import (
+    init_default_group, get_group, all_reduce, ReduceOp, Group,
+)
+
+
+class ParallelEnv:
+    """ref: parallel.py ParallelEnv"""
+
+    def __init__(self):
+        init_default_group()
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def world_size(self):
+        return len(jax.devices())
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+    local_rank = rank
+    nranks = world_size
+
+
+def init_parallel_env() -> Group:
+    """ref: parallel.py:943 — returns the world group."""
+    return init_default_group()
+
+
+def get_rank(group=None) -> int:
+    return 0
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return len(jax.devices())
+
+
+class DataParallel(Layer):
+    """ref: parallel.py:202. Wraps a layer; after `loss.backward()` call
+    `apply_collective_grads()` (or use fleet's optimizer which does it)
+    to average grads over the dp group."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group: Optional[Group] = None):
+        super().__init__()
+        self._layers = layers
+        self.group = group or init_default_group()
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    # paddle exposes the inner layer's API on the wrapper
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)
+
+    def named_parameters(self, *a, **kw):
+        return self._layers.named_parameters(*a, **kw)
+
+    def apply_collective_grads(self):
+        """No-op by design: with a single controller, grads of a mean loss
+        over the dp-sharded global batch are ALREADY the dp average (the
+        vjp psum is inserted by GSPMD). Rescaling here would shrink every
+        step nranks-fold. Kept for API parity with the reference's
+        explicit bucket-allreduce."""
+        return None
+
+    def scale_loss(self, loss):
+        return loss
+
+    @property
+    def _layers_attr(self):
+        return self._layers
+
+
+def spawn(func, args=(), nprocs=-1, **options):
+    """ref: spawn.py — multi-process spawn is a no-op single-controller:
+    run the function once (it sees every device)."""
+    return func(*args)

@@ -1,0 +1,175 @@
+"""Hybrid-parallel topology: the device mesh and per-axis comm groups.
+
+Reference: CommunicateTopology + HybridCommunicateGroup
+(/root/reference/python/paddle/distributed/fleet/base/topology.py:61,174)
+with the 5-D hybrid axis order ["data","pipe","sharding","sep","model"]
+(topology.py:64,184-246). TPU-native rendering: ONE jax.sharding.Mesh
+whose named axes are the hybrid axes; per-axis "comm groups" are Group
+objects backed by that mesh axis, so in-trace collectives bind the axis
+name and GSPMD shardings use the same mesh. `model` (mp) is the innermost
+axis -> mp collectives ride neighbouring ICI links.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .communication import Group, _new_group_obj
+
+# reference axis order (outermost -> innermost)
+_AXES = ("dp", "pp", "sharding", "sep", "mp")
+_REF_NAMES = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+              "sep": "sep", "model": "mp"}
+
+
+class CommunicateTopology:
+    """ref: fleet/base/topology.py:61"""
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        hybrid_group_names = hybrid_group_names or list(_AXES)
+        self._names = [_REF_NAMES.get(n, n) for n in hybrid_group_names]
+        self._dims = list(dims or [1] * len(self._names))
+        self._world = int(np.prod(self._dims))
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        # accept both reference names ("data") and normalised ("dp")
+        return self._dims[self._names.index(
+            _REF_NAMES.get(axis_name, axis_name))]
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kw):
+        kw = {_REF_NAMES.get(k, k): v for k, v in kw.items()}
+        coords = [kw[n] for n in self._names]
+        return int(np.ravel_multi_index(coords, self._dims))
+
+    def get_coord(self, rank):
+        return tuple(int(c) for c in np.unravel_index(rank, self._dims))
+
+
+class HybridCommunicateGroup:
+    """ref: fleet/base/topology.py:174. Builds the global Mesh and the
+    per-axis Groups."""
+
+    def __init__(self, topology: CommunicateTopology = None, dp=1, mp=1,
+                 pp=1, sharding=1, sep=1):
+        if topology is not None:
+            self._topo = topology
+            dims = dict(zip(topology.get_hybrid_group_names(),
+                            topology._dims))
+            dp = dims.get("dp", 1)
+            pp = dims.get("pp", 1)
+            sharding = dims.get("sharding", 1)
+            sep = dims.get("sep", 1)
+            mp = dims.get("mp", 1)
+        else:
+            self._topo = CommunicateTopology(
+                list(_AXES), [dp, pp, sharding, sep, mp])
+        self._degrees = {"dp": dp, "pp": pp, "sharding": sharding,
+                         "sep": sep, "mp": mp}
+        world = dp * pp * sharding * sep * mp
+        devices = jax.devices()
+        if world > len(devices):
+            raise ValueError(
+                f"hybrid topology needs {world} devices, have "
+                f"{len(devices)}")
+        arr = np.array(devices[:world]).reshape(dp, pp, sharding, sep, mp)
+        self.mesh = jax.sharding.Mesh(arr, _AXES)
+        self.nranks = world
+        self.global_rank = 0  # single controller
+        self._groups: Dict[str, Group] = {}
+        for name in _AXES:
+            self._groups[name] = _new_group_obj(
+                list(range(self._degrees[name])), mesh=self.mesh,
+                mesh_axis=name, axis_name=name)
+        # fused dp x sep group for grad sync
+        # (ref: topology.py:225-246 fused comm groups)
+        self._groups["dp_sep"] = _new_group_obj(
+            list(range(dp * sep)), mesh=self.mesh, mesh_axis=("dp", "sep"),
+            axis_name="dp_sep")
+
+    # ---- degrees ----
+    def get_data_parallel_world_size(self):
+        return self._degrees["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self._degrees["mp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._degrees["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._degrees["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self._degrees["sep"]
+
+    # ---- ranks (single controller: always coordinate 0) ----
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # ---- groups ----
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_dp_sep_parallel_group(self) -> Group:
+        return self._groups["dp_sep"]
+
+    def get_check_parallel_group(self, *a, **kw) -> Group:
+        return self._groups["dp_sep"]
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        # ref ParallelMode {DATA_PARALLEL, TENSOR_PARALLEL,
+        # PIPELINE_PARALLEL, SHARDING_PARALLEL}
+        if self._degrees["pp"] > 1:
+            return "pipeline"
+        if self._degrees["sharding"] > 1:
+            return "sharding"
+        if self._degrees["mp"] > 1 or self._degrees["sep"] > 1:
+            return "tensor"
+        return "data"
+
+
+_HCG: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _HCG
+    _HCG = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG
