@@ -21,5 +21,12 @@ from .topology import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
+    reshard, shard_layer, shard_optimizer, ShardingStage1, ShardingStage2,
+    ShardingStage3, DistModel, to_static,
+)
 
 get_world_size_by_group = get_world_size

@@ -1,0 +1,7 @@
+"""auto_parallel: semi-auto DistTensor API
+(ref: python/paddle/distributed/auto_parallel/)."""
+from .api import (  # noqa: F401
+    ProcessMesh, Placement, Shard, Replicate, Partial, DistAttr,
+    shard_tensor, dtensor_from_fn, reshard, shard_layer, shard_optimizer,
+    ShardingStage1, ShardingStage2, ShardingStage3, DistModel, to_static,
+)

@@ -1,0 +1,409 @@
+"""Semi-auto parallel API: DistTensor via GSPMD.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor:775,
+reshard:884, shard_layer:983, shard_optimizer:1303, to_static:641,
+DistModel:114), ProcessMesh (auto_parallel/process_mesh.py), placements
+(phi/core/distributed/auto_parallel/placement_types.h:68,108,132).
+
+TPU rendering (SURVEY §7.1): DistTensor == jax array committed with a
+NamedSharding; dist_attr == (ProcessMesh, placements) == PartitionSpec;
+the reference's per-op InferSpmd -> reshard -> local-kernel 12-step
+dispatch collapses into GSPMD sharding propagation — every existing eager
+op works on DistTensors unchanged. Partial placements map to
+PartitionSpec(unreduced={axis}).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ..meta_parallel.mp_layers import _dist_reshard
+
+
+# --------------------------------------------------------------------------
+# placements
+# --------------------------------------------------------------------------
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """ref: placement_types.h:108 — shard tensor dim `dim` along this
+    mesh dimension."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    """ref: placement_types.h:68"""
+
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """ref: placement_types.h:132 — pending-reduction values along this
+    mesh dim; maps to PartitionSpec(unreduced={axis})."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+# --------------------------------------------------------------------------
+# ProcessMesh
+# --------------------------------------------------------------------------
+class ProcessMesh:
+    """ref: auto_parallel/process_mesh.py — an N-D array of ranks with
+    named dims, realised as a jax.sharding.Mesh over the same devices."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        devices = np.asarray(jax.devices(), dtype=object)[arr]
+        self._jax_mesh = Mesh(devices, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._ids.flatten().tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and
+                self._dim_names == other._dim_names and
+                np.array_equal(self._ids, other._ids))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+class DistAttr:
+    """(ProcessMesh, placements) pair — the reference's TensorDistAttr
+    (phi/core/distributed/auto_parallel/dist_attr.h)."""
+
+    def __init__(self, process_mesh: ProcessMesh,
+                 placements: List[Placement]):
+        self.process_mesh = process_mesh
+        self.placements = list(placements)
+
+    def __repr__(self):
+        return f"DistAttr({self.process_mesh}, {self.placements})"
+
+
+def _to_partition_spec(mesh: ProcessMesh, placements, ndim: int):
+    """placements (one per mesh dim) -> PartitionSpec over tensor dims.
+
+    Partial maps to the replicated layout: on Auto-type mesh axes GSPMD
+    reduces pending-partial values at op boundaries (jax's `unreduced`
+    spec requires Explicit/Manual axes, which would change op semantics
+    framework-wide), so a Partial DistTensor holds the already-reduced
+    value and keeps `Partial` in its DistAttr for API parity."""
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        axis = mesh.dim_names[mesh_dim]
+        if isinstance(pl, Shard):
+            cur = entries[pl.dim]
+            if cur is None:
+                entries[pl.dim] = axis
+            elif isinstance(cur, tuple):
+                entries[pl.dim] = cur + (axis,)
+            else:
+                entries[pl.dim] = (cur, axis)
+        elif not isinstance(pl, (Replicate, Partial)):
+            raise TypeError(f"unknown placement {pl!r}")
+    return P(*entries)
+
+
+def _sharding_for(mesh: ProcessMesh, placements, ndim: int):
+    return NamedSharding(mesh.jax_mesh,
+                         _to_partition_spec(mesh, placements, ndim))
+
+
+# --------------------------------------------------------------------------
+# API
+# --------------------------------------------------------------------------
+def shard_tensor(data, mesh: ProcessMesh, placements,
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """ref: api.py:775 — make a DistTensor with the given placements."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    sh = _sharding_for(mesh, placements, t.ndim)
+    t._data = jax.device_put(t._data, sh)
+    t._dist_attr = DistAttr(mesh, placements)
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    return t
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args,
+                    **kwargs) -> Tensor:
+    """ref: api.py dtensor_from_fn"""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """ref: api.py:884 — differentiable placement change; GSPMD emits the
+    collective (allgather / reduce-scatter / all-to-all / ...)."""
+    sh = _sharding_for(mesh, placements, x.ndim)
+    out = _dist_reshard(x, dst_sharding=sh)
+    out._dist_attr = DistAttr(mesh, placements)
+    return out
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None) -> Layer:
+    """ref: api.py:983 — apply shard_fn(name, sublayer, mesh) to every
+    sublayer; default replicates parameters over the mesh."""
+
+    def _default(name, sub, mesh):
+        for pname, p in sub.named_parameters(include_sublayers=False):
+            shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+
+    shard_fn = shard_fn or _default
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """ref: api.py:1303 — returns an optimizer whose accumulators follow
+    each parameter's placements (or shard_fn's choice)."""
+    return _ShardOptimizer(optimizer, shard_fn)
+
+
+class _ShardOptimizer:
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner_opt = optimizer
+        self._shard_fn = shard_fn
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def _place_states(self):
+        for p in self._inner_opt._all_params():
+            if p.stop_gradient or p._grad is None:
+                continue
+            st = self._inner_opt._get_state(p)
+            sh = p._data.sharding
+            if self._shard_fn is None and not isinstance(sh, NamedSharding):
+                continue
+            for k, v in list(st.items()):
+                if getattr(v, "ndim", 0) == 0 or v.shape != p._data.shape:
+                    continue
+                if self._shard_fn is not None:
+                    v = self._shard_fn(k, p, v)
+                    v = v._data if isinstance(v, Tensor) else v
+                else:
+                    v = jax.device_put(v, sh)
+                st[k] = v
+
+    def step(self):
+        self._place_states()
+        saved = {id(p): (p._data.sharding, p._dist_attr)
+                 for p in self._inner_opt._all_params()
+                 if isinstance(p._data.sharding, NamedSharding)}
+        self._inner_opt.step()
+        for p in self._inner_opt._all_params():
+            ent = saved.get(id(p))
+            if ent is not None:
+                p._data = jax.device_put(p._data, ent[0])
+                p._dist_attr = ent[1]
+        # the update may have produced replicated moments (mixed-sharding
+        # arithmetic); re-place them so the ZeRO memory saving persists
+        # between steps
+        self._place_states()
+
+    def clear_grad(self, *a, **kw):
+        return self._inner_opt.clear_grad(*a, **kw)
+
+    clear_gradients = clear_grad
+
+
+class ShardingStage1:
+    """shard_fn for ZeRO-1: accumulators sharded on the mesh dim's
+    largest divisible tensor dim (ref: api.py ShardingStage1 semantics)."""
+
+    def __init__(self, mesh: ProcessMesh, axis_name: Optional[str] = None):
+        self.mesh = mesh
+        self.axis = axis_name or mesh.dim_names[0]
+
+    def __call__(self, key, param, value):
+        shape = value.shape
+        size = self.mesh.get_dim_size(self.axis)
+        for d in sorted(range(len(shape)), key=lambda i: -shape[i]):
+            if shape[d] % size == 0 and shape[d] >= size:
+                spec = [None] * len(shape)
+                spec[d] = self.axis
+                return jax.device_put(
+                    value, NamedSharding(self.mesh.jax_mesh, P(*spec)))
+        return value
+
+
+ShardingStage2 = ShardingStage1  # grads are transient here; same effect
+
+
+class ShardingStage3(ShardingStage1):
+    """ZeRO-3: also shard the PARAMETER itself (GSPMD all-gathers at
+    use — ref GroupShardedStage3 semantics)."""
+
+    def __call__(self, key, param, value):
+        out = super().__call__(key, param, value)
+        if isinstance(param, Tensor):
+            pl = [Replicate()] * self.mesh.ndim
+            shape = param.shape
+            size = self.mesh.get_dim_size(self.axis)
+            for d in sorted(range(len(shape)), key=lambda i: -shape[i]):
+                if shape[d] % size == 0 and shape[d] >= size:
+                    pl[self.mesh.dim_names.index(self.axis)] = Shard(d)
+                    break
+            shard_tensor(param, self.mesh, pl)
+        return out
+
+
+# --------------------------------------------------------------------------
+# DistModel / to_static
+# --------------------------------------------------------------------------
+class DistModel:
+    """ref: api.py:114 — jit-compiled sharded train/eval step around a
+    layer whose params carry placements. The TPU rendering reuses
+    jit.TrainStep (fused fwd+bwd+opt executable); shardings come from the
+    params' committed NamedShardings."""
+
+    def __init__(self, layer: Layer, loader=None, loss=None,
+                 optimizer=None, strategy=None, metrics=None):
+        if optimizer is not None and loss is None:
+            raise ValueError(
+                "DistModel/to_static: a loss function is required when an "
+                "optimizer is given (training mode)")
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train"
+        self._step = None
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "train" and self._optimizer is not None:
+            if self._step is None:
+                from ...jit import TrainStep
+
+                def loss_fn(model, *batch):
+                    *inputs, label = batch
+                    out = model(*inputs)
+                    return self._loss(out, label)
+
+                self._step = TrainStep(self.network, self._optimizer,
+                                       loss_fn)
+            return self._step(*args)
+        from ...autograd import no_grad
+        if self._step is not None:
+            # write the donated-buffer loop state back into the network
+            # before running it directly (else its tensors are deleted)
+            self._step.sync()
+        with no_grad():
+            out = self.network(*args[:-1] if self._loss else args)
+            if self._loss is not None:
+                return self._loss(out, args[-1])
+            return out
+
+    def state_dict(self, mode="all"):
+        sync = getattr(self, "_step", None)
+        if sync is not None:
+            sync.sync()
+        return self.network.state_dict()
+
+    def dist_main_program(self, mode=None):
+        return None  # PIR program inspection is N/A: XLA owns the graph
+
+
+def to_static(layer: Layer, loader=None, loss=None, optimizer=None,
+              strategy=None) -> DistModel:
+    """ref: api.py:641"""
+    return DistModel(layer, loader, loss, optimizer, strategy)

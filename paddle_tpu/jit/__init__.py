@@ -205,6 +205,32 @@ class TrainStep:
         # reshard collapse into sharding propagation) ---
         self.mesh = mesh
         self._data_sharding = None
+        if mesh is None:
+            # semi-auto path: params may already carry NamedShardings
+            # (shard_tensor / mpu layers). Adopt their mesh and replicate
+            # the uncommitted leftovers so the jitted step sees one mesh.
+            from jax.sharding import NamedSharding, PartitionSpec
+            committed = [p.sharding for p in self.params
+                         if isinstance(p.sharding, NamedSharding)]
+            if committed:
+                amesh = committed[0].mesh
+                repl = NamedSharding(amesh, PartitionSpec())
+
+                def _sh(arr):
+                    return arr.sharding if isinstance(
+                        arr.sharding, NamedSharding) else repl
+
+                self.params = [jax.device_put(p, _sh(p))
+                               for p in self.params]
+                self.opt_states = [
+                    {k: jax.device_put(
+                        v, _sh(p) if getattr(v, "shape", ()) == p.shape
+                        else repl)
+                     for k, v in st.items()}
+                    for p, st in zip(self.params, self.opt_states)]
+                self.buffers = [jax.device_put(b, repl)
+                                for b in self.buffers]
+                self.mesh = amesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             shard_param = shard_param or (lambda name, shape: PartitionSpec())
@@ -226,6 +252,7 @@ class TrainStep:
             self.buffers = [jax.device_put(b, repl) for b in self.buffers]
             if shard_data is not None:
                 self._data_sharding = NamedSharding(mesh, shard_data)
+        self._donate = donate
         self._step_fn = self._build(donate)
         self._rng = jax.random.PRNGKey(0)
         self._step_count = 0
@@ -300,13 +327,20 @@ class TrainStep:
             self.optimizer._lr.step()
         return Tensor._wrap(loss)
 
-    def sync(self):
-        """Write the compiled-loop state back into model/optimizer objects."""
+    def sync(self, copy=None):
+        """Write the compiled-loop state back into model/optimizer objects.
+
+        With donated buffers the loop state is invalidated on the next
+        step call, so by default the tensors receive COPIES — otherwise a
+        later step() would leave the model holding deleted arrays."""
+        if copy is None:
+            copy = self._donate
         for p, arr in zip(self._ptensors, self.params):
-            p._data = arr
+            p._data = jnp.copy(arr) if copy else arr
         for p, st in zip(self._ptensors, self.opt_states):
             if st:
-                self.optimizer._accumulators[id(p)] = st
+                self.optimizer._accumulators[id(p)] = (
+                    {k: jnp.copy(v) for k, v in st.items()} if copy else st)
         return self.model
 
 

@@ -1,0 +1,174 @@
+"""Hybrid-parallel GPT: TP (mpu layers) x PP (PipelineLayer) x DP/sharding.
+
+Capability target: BASELINE.json config 3 "GPT-3 1.3B/6.7B Fleet
+TP x PP x sharding-stage3"; mirrors the reference fixture
+(test/auto_parallel/get_gpt_model.py + PaddleNLP's GPTForCausalLMPipe
+pattern): VocabParallelEmbedding, Column/Row-parallel attention & MLP,
+pipeline stages cut on decoder-block boundaries, tied embedding head via
+SharedLayerDesc.
+
+Requires fleet.init(...) (the hybrid mesh) before construction.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import ops
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn.layers.common import Dropout
+from ..nn.layers.norm import LayerNorm
+from ..nn.initializer import Normal
+from .gpt import GPTConfig
+
+
+def _mpu():
+    from ..distributed.meta_parallel import (
+        VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+        ParallelCrossEntropy)
+    return (VocabParallelEmbedding, ColumnParallelLinear,
+            RowParallelLinear, ParallelCrossEntropy)
+
+
+class HybridGPTAttention(Layer):
+    """Megatron attention: column-parallel QKV, row-parallel output."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        _, Col, Row, _ = _mpu()
+        self.num_heads = config.num_heads
+        self.head_dim = config.head_dim
+        self.hidden_size = config.hidden_size
+        w = Normal(std=config.initializer_range)
+        ow = Normal(std=config.initializer_range /
+                    math.sqrt(2 * config.num_layers))
+        self.qkv_proj = Col(config.hidden_size, 3 * config.hidden_size,
+                            weight_attr=w, gather_output=False)
+        self.out_proj = Row(config.hidden_size, config.hidden_size,
+                            weight_attr=ow, input_is_parallel=True)
+        self.dropout_p = config.attention_dropout_prob
+
+    def forward(self, x):
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)  # [b, s, 3h] sharded on last dim over mp
+        qkv = ops.reshape(qkv, (b, s, 3, self.num_heads, self.head_dim))
+        q, k, v = ops.unbind(qkv, axis=2)
+        out = ops.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout_p,
+            training=self.training)
+        out = ops.reshape(out, (b, s, self.hidden_size))
+        return self.out_proj(out)
+
+
+class HybridGPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        _, Col, Row, _ = _mpu()
+        w = Normal(std=config.initializer_range)
+        ow = Normal(std=config.initializer_range /
+                    math.sqrt(2 * config.num_layers))
+        self.fc1 = Col(config.hidden_size, config.intermediate_size,
+                       weight_attr=w, gather_output=False)
+        self.fc2 = Row(config.intermediate_size, config.hidden_size,
+                       weight_attr=ow, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(ops.gelu(self.fc1(x), approximate=True))
+
+
+class HybridGPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln1 = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.attn = HybridGPTAttention(config)
+        self.ln2 = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.mlp = HybridGPTMLP(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return x
+
+
+class HybridGPTEmbedding(Layer):
+    """Vocab-parallel word embedding + replicated position embedding."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        Vocab, _, _, _ = _mpu()
+        from ..nn.layers.common import Embedding
+        w = Normal(std=config.initializer_range)
+        self.word_embeddings = Vocab(config.vocab_size, config.hidden_size,
+                                     weight_attr=w)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=w)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[-1]
+        pos = ops.arange(0, s, dtype="int32")
+        return self.dropout(self.word_embeddings(input_ids) +
+                            self.position_embeddings(pos))
+
+    def head(self, hidden):
+        """Tied LM head: logits sharded over vocab (mp)."""
+        return ops.matmul(hidden, self.word_embeddings.weight,
+                          transpose_y=True)
+
+
+class HybridGPTNorm(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.norm = LayerNorm(config.hidden_size, config.layer_norm_eps)
+
+    def forward(self, x):
+        return self.norm(x)
+
+
+class GPTForCausalLMHybrid(Layer):
+    """Non-pipelined hybrid GPT (TP + DP/sharding via fleet)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        from ..nn.layers.container import LayerList
+        self.config = config
+        self.embeddings = HybridGPTEmbedding(config)
+        self.layers = LayerList(
+            [HybridGPTBlock(config) for _ in range(config.num_layers)])
+        self.final_norm = HybridGPTNorm(config)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        for blk in self.layers:
+            x = blk(x)
+        x = self.final_norm(x)
+        return self.embeddings.head(x)
+
+
+def gpt_pipeline_model(config: GPTConfig, loss_fn=None,
+                       recompute_interval=0):
+    """Build the PipelineLayer description of the hybrid GPT (pp>=1).
+    Tied embeddings via SharedLayerDesc (ref: pp_layers.py SharedLayerDesc
+    usage in PaddleNLP GPT)."""
+    from ..distributed.meta_parallel import (
+        LayerDesc, SharedLayerDesc, PipelineLayer)
+
+    descs = [
+        SharedLayerDesc("embed", HybridGPTEmbedding, config),
+    ]
+    for _ in range(config.num_layers):
+        descs.append(LayerDesc(HybridGPTBlock, config))
+    descs.append(LayerDesc(HybridGPTNorm, config))
+    descs.append(SharedLayerDesc(
+        "embed", HybridGPTEmbedding, config,
+        forward_func=lambda layer, x: layer.head(x)))
+
+    if loss_fn is None:
+        def loss_fn(logits, labels):
+            return ops.mean(ops.cross_entropy(logits, labels,
+                                              reduction="none"))
+    return PipelineLayer(layers=descs, loss_fn=loss_fn,
+                         seg_method="layer:HybridGPTBlock",
+                         recompute_interval=recompute_interval)

@@ -1,0 +1,238 @@
+"""Semi-auto parallel (DistTensor) + distributed checkpoint tests.
+Mirrors the reference's test/auto_parallel reshard pairwise matrix +
+semi_auto_parallel e2e patterns on the 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import (
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, reshard,
+    shard_layer, shard_optimizer, ShardingStage1, ShardingStage3,
+)
+from jax.sharding import PartitionSpec as P
+
+
+@pytest.fixture
+def mesh2d():
+    return ProcessMesh(np.arange(8).reshape(4, 2), dim_names=["x", "y"])
+
+
+class TestShardTensor:
+    def test_shard_and_spec(self, mesh2d):
+        t = shard_tensor(np.arange(32, dtype=np.float32).reshape(8, 4),
+                         mesh2d, [Shard(0), Replicate()])
+        assert t._data.sharding.spec == P("x", None)
+        np.testing.assert_allclose(
+            t.numpy(), np.arange(32).reshape(8, 4))
+
+    def test_two_axes_one_dim(self, mesh2d):
+        t = shard_tensor(np.zeros((8, 4), np.float32), mesh2d,
+                         [Shard(0), Shard(0)])
+        assert t._data.sharding.spec == P(("x", "y"), None)
+
+    def test_ops_on_dist_tensors(self, mesh2d):
+        a = shard_tensor(np.random.randn(8, 16).astype(np.float32),
+                         mesh2d, [Shard(0), Replicate()])
+        b = shard_tensor(np.random.randn(16, 8).astype(np.float32),
+                         mesh2d, [Replicate(), Shard(1)])
+        c = pt.ops.matmul(a, b)  # GSPMD propagates
+        np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestReshard:
+    """Pairwise reshard matrix {r,s,p} x {r,s} (ref: test/auto_parallel/
+    reshard_p_to_r.py family)."""
+
+    def _roundtrip(self, mesh, src, dst):
+        x = np.random.randn(8, 8).astype(np.float32)
+        t = shard_tensor(x, mesh, src)
+        out = reshard(t, mesh, dst)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-6)
+
+    def test_r_to_s(self, mesh2d):
+        self._roundtrip(mesh2d, [Replicate(), Replicate()],
+                        [Shard(0), Replicate()])
+
+    def test_s_to_r(self, mesh2d):
+        self._roundtrip(mesh2d, [Shard(0), Replicate()],
+                        [Replicate(), Replicate()])
+
+    def test_s_to_s_transpose(self, mesh2d):
+        self._roundtrip(mesh2d, [Shard(0), Replicate()],
+                        [Shard(1), Replicate()])
+
+    def test_reshard_is_differentiable(self, mesh2d):
+        x = pt.to_tensor(np.random.randn(8, 8).astype(np.float32),
+                         stop_gradient=False)
+        t = reshard(x, mesh2d, [Shard(0), Replicate()])
+        loss = pt.ops.mean(t ** 2)
+        loss.backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   2 * x.numpy() / x.numpy().size,
+                                   rtol=1e-5)
+
+
+class TestShardLayerOptimizer:
+    def test_shard_layer_tp(self, mesh2d):
+        m = pt.nn.Linear(16, 32)
+
+        def tp(name, sub, mesh):
+            if hasattr(sub, "weight") and sub.weight is not None:
+                shard_tensor(sub.weight, mesh, [Replicate(), Shard(1)])
+            if getattr(sub, "bias", None) is not None:
+                shard_tensor(sub.bias, mesh, [Replicate(), Shard(0)])
+
+        shard_layer(m, mesh2d, tp)
+        assert m.weight._data.sharding.spec == P(None, "y")
+        x = pt.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        y = m(x)
+        assert y.shape == [4, 32]
+
+    def test_shard_optimizer_follows_params(self, mesh2d):
+        m = pt.nn.Linear(16, 32)
+        shard_layer(m, mesh2d, lambda n, s, mm: [
+            shard_tensor(p, mm, [Replicate(), Shard(1)])
+            for _, p in s.named_parameters(include_sublayers=False)
+            if p.ndim == 2])
+        opt = shard_optimizer(pt.optimizer.AdamW(
+            learning_rate=1e-3, parameters=m.parameters()))
+        x = pt.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        pt.ops.mean(m(x) ** 2).backward()
+        opt.step()
+        st = opt._inner_opt._accumulators[id(m.weight)]
+        m1 = [v for v in st.values()
+              if getattr(v, "shape", ()) == (16, 32)][0]
+        assert m1.sharding.spec == P(None, "y")
+        # param placement preserved through the step
+        assert m.weight._data.sharding.spec == P(None, "y")
+
+    def test_sharding_stage3_shards_params(self):
+        mesh = ProcessMesh(np.arange(8), dim_names=["dp"])
+        m = pt.nn.Linear(16, 32)
+        opt = shard_optimizer(
+            pt.optimizer.AdamW(learning_rate=1e-3,
+                               parameters=m.parameters()),
+            shard_fn=ShardingStage3(mesh))
+        x = pt.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        pt.ops.mean(m(x) ** 2).backward()
+        opt.step()
+        assert "dp" in str(m.weight._data.sharding.spec)
+
+    def test_train_convergence_semi_auto(self, mesh2d):
+        pt.seed(0)
+        m = pt.nn.Linear(8, 8)
+        shard_layer(m, mesh2d, lambda n, s, mm: [
+            shard_tensor(p, mm, [Replicate(), Shard(1)])
+            for _, p in s.named_parameters(include_sublayers=False)
+            if p.ndim == 2])
+        opt = shard_optimizer(pt.optimizer.SGD(
+            learning_rate=0.5, parameters=m.parameters()))
+        x = pt.to_tensor(np.random.randn(16, 8).astype(np.float32))
+        losses = []
+        for _ in range(10):
+            loss = pt.ops.mean((m(x) - x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestHybridGPT:
+    def test_tp_pp_dp_pipeline_training(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.topology import (
+            set_hybrid_communicate_group)
+        from paddle_tpu.models.gpt import gpt_tiny
+        from paddle_tpu.models.gpt_hybrid import gpt_pipeline_model
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        dist.fleet.init(strategy=strategy)
+        cfg = gpt_tiny(hidden_dropout_prob=0.0,
+                       attention_dropout_prob=0.0)
+        model = gpt_pipeline_model(cfg, recompute_interval=1)
+        pp = dist.fleet.distributed_model(model)
+        opt = dist.fleet.distributed_optimizer(pt.optimizer.AdamW(
+            learning_rate=1e-3, parameters=model.parameters()))
+        ids = np.random.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+        labels = np.roll(ids, -1, axis=1)
+        losses = [float(pp.train_batch(
+            [pt.to_tensor(ids), pt.to_tensor(labels)], opt).numpy())
+            for _ in range(4)]
+        assert losses[-1] < losses[0]
+        set_hybrid_communicate_group(None)
+
+    def test_hybrid_flat_model_matches_dense(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.topology import (
+            set_hybrid_communicate_group)
+        from paddle_tpu.models.gpt import gpt_tiny
+        from paddle_tpu.models.gpt_hybrid import GPTForCausalLMHybrid
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+        dist.fleet.init(strategy=strategy)
+        cfg = gpt_tiny(hidden_dropout_prob=0.0,
+                       attention_dropout_prob=0.0)
+        m = GPTForCausalLMHybrid(cfg)
+        m.eval()
+        ids = pt.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (2, 8)).astype(np.int32))
+        logits = m(ids)
+        assert logits.shape == [2, 8, cfg.vocab_size]
+        # TP logits equal a dense recomputation with the same weights
+        import jax.numpy as jnp
+        x = m.embeddings.word_embeddings.weight.numpy()[ids.numpy()] + \
+            m.embeddings.position_embeddings.weight.numpy()[
+                np.arange(8)][None]
+        ref_first = m.layers[0].ln1(pt.to_tensor(x))
+        qkv_ref = ref_first.numpy() @ \
+            m.layers[0].attn.qkv_proj.weight.numpy() + \
+            m.layers[0].attn.qkv_proj.bias.numpy()
+        qkv_tp = m.layers[0].attn.qkv_proj(ref_first).numpy()
+        np.testing.assert_allclose(qkv_tp, qkv_ref, rtol=2e-4, atol=2e-4)
+        set_hybrid_communicate_group(None)
+
+
+class TestDistCheckpoint:
+    def test_save_load_reshard(self, tmp_path, mesh2d):
+        x = np.random.randn(8, 16).astype(np.float32)
+        t = shard_tensor(x.copy(), mesh2d, [Shard(0), Replicate()])
+        dist.checkpoint.save_state_dict({"w": t}, str(tmp_path))
+        # load into a DIFFERENTLY-sharded destination
+        t2 = shard_tensor(np.zeros_like(x), mesh2d,
+                          [Replicate(), Shard(1)])
+        dist.checkpoint.load_state_dict({"w": t2}, str(tmp_path))
+        np.testing.assert_allclose(t2.numpy(), x, rtol=1e-6)
+        assert t2._data.sharding.spec == P(None, "y")
+
+    def test_shard_dedup_on_disk(self, tmp_path):
+        mesh = ProcessMesh(np.arange(8), dim_names=["dp"])
+        t = shard_tensor(np.random.randn(8, 4).astype(np.float32), mesh,
+                         [Shard(0)])
+        dist.checkpoint.save_state_dict({"w": t}, str(tmp_path))
+        import os
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".npy")]
+        assert len(files) == 8  # one unique shard per device
+        rep = shard_tensor(np.random.randn(8, 4).astype(np.float32), mesh,
+                           [Replicate()])
+        dist.checkpoint.save_state_dict({"r": rep}, str(tmp_path / "r"))
+        files = [f for f in os.listdir(tmp_path / "r")
+                 if f.endswith(".npy")]
+        assert len(files) == 1  # replicas deduped
+
+    def test_model_roundtrip(self, tmp_path, mesh2d):
+        from paddle_tpu.models import gpt_tiny, GPTForCausalLM
+        m = GPTForCausalLM(gpt_tiny())
+        sd = m.state_dict()
+        dist.checkpoint.save_state_dict(sd, str(tmp_path))
+        m2 = GPTForCausalLM(gpt_tiny())
+        sd2 = m2.state_dict()
+        dist.checkpoint.load_state_dict(sd2, str(tmp_path))
+        for k in sd:
+            np.testing.assert_allclose(sd2[k].numpy(), sd[k].numpy(),
+                                       rtol=1e-6)
