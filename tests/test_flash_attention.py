@@ -1,0 +1,144 @@
+"""Pallas flash-attention kernel conformance.
+
+Runs the real kernel logic on CPU via pallas interpret mode
+(pl.pallas_call(interpret=True)) so CI exercises the blockwise
+forward AND the FA2-style backward without TPU hardware; a TPU-gated
+test covers the compiled path. Mirrors the reference's
+test/legacy_test/test_flash_attention.py (composite-vs-fused check).
+"""
+import importlib
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+fa = importlib.import_module("paddle_tpu.kernels.pallas.flash_attention")
+
+
+def _make(b=2, s=256, h=2, d=64, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), dtype)
+    return q, k, v
+
+
+def _interp_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    qm, km, vm = map(fa._bshd_to_bhsd, (q, k, v))
+    o, lse = fa._flash_fwd_bhsd(qm, km, vm, sm_scale, causal,
+                                block_q=block_q, block_k=block_k,
+                                interpret=True)
+    return o, lse, (qm, km, vm)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block_q,block_k", [(128, 128), (128, 256)])
+def test_fwd_interpret_matches_composite(causal, block_q, block_k):
+    q, k, v = _make()
+    sc = 1.0 / np.sqrt(q.shape[-1])
+    o, _, _ = _interp_fwd(q, k, v, sc, causal, block_q, block_k)
+    o = fa._bhsd_to_bshd(o, q.shape[0], q.shape[2])
+    ref = fa._xla_attention(q, k, v, None, causal, sc)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_lse_matches_composite(causal):
+    q, k, v = _make()
+    sc = 1.0 / np.sqrt(q.shape[-1])
+    _, lse, (qm, km, _) = _interp_fwd(q, k, v, sc, causal, 128, 128)
+    s = jnp.einsum("zqd,zkd->zqk", qm.astype(jnp.float32),
+                   km.astype(jnp.float32)) * sc
+    if causal:
+        qpos = jnp.arange(s.shape[-2])[:, None]
+        kpos = jnp.arange(s.shape[-1])[None, :]
+        s = jnp.where(qpos >= kpos, s, fa._NEG_INF)
+    ref = jax.scipy.special.logsumexp(s, axis=-1)      # [bh, sq]
+    np.testing.assert_allclose(np.asarray(lse[:, 0, :]), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # replicated across the sublane tile
+    np.testing.assert_array_equal(np.asarray(lse[:, 0, :]),
+                                  np.asarray(lse[:, -1, :]))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s,block_q,block_k",
+                         [(256, 128, 128), (256, 128, 256),
+                          (384, 128, 256), (384, 256, 128)])
+def test_bwd_interpret_matches_composite(causal, s, block_q, block_k):
+    q, k, v = _make(s=s)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+    o, lse, (qm, km, vm) = _interp_fwd(q, k, v, sc, causal,
+                                       block_q, block_k)
+    rng = np.random.default_rng(1)
+    do = jnp.asarray(rng.standard_normal(o.shape), o.dtype)
+    dq, dk, dv = fa._flash_bwd_bhsd(qm, km, vm, o, lse, do, sc, causal,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=True)
+
+    def comp(qm, km, vm):
+        s = jnp.einsum("zqd,zkd->zqk", qm, km) * sc
+        if causal:
+            qpos = jnp.arange(s.shape[-2])[:, None]
+            kpos = jnp.arange(s.shape[-1])[None, :]
+            s = jnp.where(qpos >= kpos, s, fa._NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("zqk,zkd->zqd", p, vm)
+
+    _, vjp = jax.vjp(comp, qm, km, vm)
+    rq, rk, rv = vjp(do)
+    for got, ref in ((dq, rq), (dk, rk), (dv, rv)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_uneven_final_block_interpret():
+    # seq not a multiple of block_k exercises the padded tail path
+    q, k, v = _make(s=384)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+    o, _, _ = _interp_fwd(q, k, v, sc, True, 128, 256)
+    o = fa._bhsd_to_bshd(o, q.shape[0], q.shape[2])
+    ref = fa._xla_attention(q, k, v, None, True, sc)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_attention_path_gating():
+    # CPU backend -> xla; masked -> xla; odd shapes -> xla
+    assert fa.attention_path((2, 256, 4, 64), (2, 256, 4, 64)) == "xla"
+    assert fa.attention_path((2, 256, 4, 64), (2, 256, 4, 64),
+                             masked=True) == "xla"
+    assert fa.attention_path((2, 100, 4, 64), (2, 100, 4, 64)) == "xla"
+
+
+def test_flash_attention_dispatch_cpu_fallback():
+    # public entry must agree with the composite on CPU (xla path)
+    q, k, v = _make(s=128)
+    out = fa.flash_attention(q, k, v, causal=True)
+    ref = fa._xla_attention(q, k, v, None, True,
+                           1.0 / np.sqrt(q.shape[-1]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled Pallas path needs TPU")
+def test_fwd_bwd_tpu_compiled():
+    q, k, v = _make(s=512, dtype=jnp.bfloat16)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+
+    def f_p(q, k, v):
+        return (_ := fa._flash_core(q, k, v, True, sc, True)).astype(
+            jnp.float32).sum()
+
+    def f_x(q, k, v):
+        return fa._xla_attention(q, k, v, None, True, sc).astype(
+            jnp.float32).sum()
+
+    gp = jax.grad(f_p, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(f_x, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gx):
+        rel = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+        assert rel < 1e-2, rel
