@@ -166,11 +166,11 @@ class DistAttr:
 def _to_partition_spec(mesh: ProcessMesh, placements, ndim: int):
     """placements (one per mesh dim) -> PartitionSpec over tensor dims.
 
-    Partial maps to the replicated layout: on Auto-type mesh axes GSPMD
-    reduces pending-partial values at op boundaries (jax's `unreduced`
+    Partial maps to the replicated layout for STORAGE (jax's `unreduced`
     spec requires Explicit/Manual axes, which would change op semantics
-    framework-wide), so a Partial DistTensor holds the already-reduced
-    value and keeps `Partial` in its DistAttr for API parity."""
+    framework-wide); the pending reduction lives in the DistAttr and is
+    applied by `reshard` when the Partial placement is dropped
+    (see _pending_reduce_factor)."""
     entries: List = [None] * ndim
     for mesh_dim, pl in enumerate(placements):
         axis = mesh.dim_names[mesh_dim]
@@ -213,9 +213,47 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args,
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
 
 
+def _pending_reduce_factor(src_attr, mesh: ProcessMesh, placements):
+    """Scale factor realizing Partial transitions on reshard.
+
+    In single-controller mode every rank's local partial is the same
+    array (there is one process), so the reference's reshard_p_to_r
+    all-reduce-sum over n identical locals is exactly `n * x`
+    (ref: phi/core/distributed/auto_parallel/reshard/p_to_r_reshard_function.cc).
+    avg/max/min of identical locals are the identity. The inverse
+    (r -> p) divides by n so p -> r round-trips bit-faithfully in the
+    sum case."""
+    factor = 1.0
+    if src_attr is not None and src_attr.process_mesh == mesh:
+        for dim, (src_pl, dst_pl) in enumerate(
+                zip(src_attr.placements, placements)):
+            n = mesh.get_dim_size(mesh.dim_names[dim])
+            src_p = isinstance(src_pl, Partial)
+            dst_p = isinstance(dst_pl, Partial)
+            if src_p and dst_p and src_pl.reduce_type != dst_pl.reduce_type:
+                raise NotImplementedError(
+                    f"reshard between Partial({src_pl.reduce_type}) and "
+                    f"Partial({dst_pl.reduce_type})")
+            if src_p and not dst_p and src_pl.reduce_type == "sum":
+                factor *= n      # apply the pending sum
+            elif dst_p and not src_p and dst_pl.reduce_type == "sum":
+                factor /= n      # split into n identical partials
+    elif src_attr is not None and any(
+            isinstance(p, Partial) for p in src_attr.placements):
+        raise NotImplementedError(
+            "reshard of a Partial tensor onto a different mesh")
+    return factor
+
+
 def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
     """ref: api.py:884 — differentiable placement change; GSPMD emits the
-    collective (allgather / reduce-scatter / all-to-all / ...)."""
+    collective (allgather / reduce-scatter / all-to-all / ...). Partial
+    sources have their pending reduction applied (reshard_p_to_r/p_to_s
+    family)."""
+    factor = _pending_reduce_factor(getattr(x, "_dist_attr", None), mesh,
+                                    placements)
+    if factor != 1.0:
+        x = x * factor
     sh = _sharding_for(mesh, placements, x.ndim)
     out = _dist_reshard(x, dst_sharding=sh)
     out._dist_attr = DistAttr(mesh, placements)

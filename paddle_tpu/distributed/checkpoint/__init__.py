@@ -45,12 +45,6 @@ def _to_storable(arr: np.ndarray):
     return arr
 
 
-def _from_storable(data: np.ndarray, dtype: np.dtype, shape):
-    if data.dtype == np.uint8 and data.ndim == len(shape) + 1:
-        return data.reshape(-1).view(dtype).reshape(shape)
-    return data
-
-
 def _tensor_items(state_dict):
     for k, v in state_dict.items():
         if isinstance(v, Tensor):
@@ -100,27 +94,83 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         json.dump(meta, f)
 
 
+def _read_region(path, entry, starts, stops, dtype):
+    """Assemble one global-coordinate region [starts, stops) from the
+    saved shard files — the reference's compute_overlap
+    (load_state_dict.py:229): intersect the request box with each saved
+    shard box and copy only the overlaps. Shard files are memory-mapped,
+    so only the overlapping bytes are read."""
+    out = np.zeros([b - a for a, b in zip(starts, stops)], dtype=dtype)
+    for sh in entry["shards"]:
+        lo = [max(a, o) for a, o in zip(starts, sh["offsets"])]
+        hi = [min(b, o + n)
+              for b, o, n in zip(stops, sh["offsets"], sh["shape"])]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        data = np.load(os.path.join(path, sh["file"]), mmap_mode="r")
+        src = tuple(slice(l - o, h - o)
+                    for l, o, h in zip(lo, sh["offsets"], hi))
+        if data.dtype == np.uint8 and data.ndim == len(sh["shape"]) + 1:
+            piece = np.ascontiguousarray(data[src]) \
+                .reshape(-1).view(dtype) \
+                .reshape([h - l for l, h in zip(lo, hi)])
+        else:
+            piece = data[src]
+        dst = tuple(slice(l - a, h - a) for l, a, h in zip(lo, starts, hi))
+        out[dst] = piece
+    return out
+
+
 def load_state_dict(state_dict: Dict, path: str, process_group=None,
                     offload: bool = False) -> None:
     """ref: load_state_dict.py — fills the given state_dict's tensors
     in-place, resharding saved shards onto each tensor's CURRENT
-    placement."""
+    placement.
+
+    Each destination device's slice is assembled independently and
+    placed directly (jax.make_array_from_callback) — the full global
+    array is never materialized in host RAM, which matters at the
+    6.7B/13B scale. Saved values are cast to the destination tensor's
+    dtype when they differ.
+
+    Format note: the on-disk layout (npy shard files + metadata.json) is
+    intentionally NOT interoperable with the reference's .distcp files —
+    the metadata schema there is tied to its Program/DistTensor
+    serialization."""
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
     for name, t in list(state_dict.items()):
         if name not in meta:
             continue
         entry = meta[name]
-        dtype = _np_dtype(entry["dtype"])
-        full = np.zeros(tuple(entry["global_shape"]), dtype=dtype)
-        for sh in entry["shards"]:
-            data = np.load(os.path.join(path, sh["file"]))
-            idx = tuple(slice(o, o + s)
-                        for o, s in zip(sh["offsets"], sh["shape"]))
-            full[idx] = _from_storable(data, dtype, sh["shape"])
+        saved_dtype = _np_dtype(entry["dtype"])
+        gshape = tuple(entry["global_shape"])
         if isinstance(t, Tensor):
-            t._data = jax.device_put(full, t._data.sharding)
+            dst = t._data
+            dst_dtype = np.dtype(dst.dtype)
+            if tuple(dst.shape) != gshape:
+                raise ValueError(
+                    f"{name}: saved shape {gshape} != destination "
+                    f"{tuple(dst.shape)}")
+            memo = {}
+
+            def _cb(index, entry=entry, gshape=gshape,
+                    saved=saved_dtype, want=dst_dtype, memo=memo):
+                starts = tuple(sl.start or 0 for sl in index)
+                stops = tuple(sl.stop if sl.stop is not None else g
+                              for sl, g in zip(index, gshape))
+                key = (starts, stops)
+                if key not in memo:
+                    region = _read_region(path, entry, starts, stops,
+                                          saved)
+                    memo[key] = region.astype(want, copy=False)
+                return memo[key]
+
+            t._data = jax.make_array_from_callback(
+                gshape, dst.sharding, _cb)
         else:
+            full = _read_region(path, entry, (0,) * len(gshape), gshape,
+                                saved_dtype)
             state_dict[name] = Tensor(full)
 
 

@@ -379,28 +379,72 @@ def barrier(group=None):
 
 
 # ---- p2p: single-controller renderings of send/recv ----------------------
-# The controller runs BOTH sides of every send/recv pair, so messages form
-# a strict FIFO per group: recv pops the oldest outstanding send. This is
-# exact for the pipeline/pairwise-group patterns the reference tests use;
-# rank-addressed p2p inside a traced region should use `ppermute` instead.
+# The controller runs BOTH sides of every send/recv pair. Each send
+# records its destination rank; recv pops the oldest outstanding send
+# addressed to THIS receiver. The receiver's identity is recoverable
+# exactly when the group has two ranks (the peer of `src`) — the
+# pipeline/pairwise-group pattern the reference tests use. For larger
+# groups recv falls back to FIFO order but refuses to guess silently
+# when sends to different destinations are interleaved. Rank-addressed
+# p2p inside a traced region should use `ppermute` instead.
 import collections as _collections  # noqa: E402
+import warnings as _warnings  # noqa: E402
 
 _P2P_BUF = {}
+
+
+def _global_rank(group, rank):
+    """Normalize a rank argument to a GLOBAL rank: values that are
+    members of the group are taken as global ranks (paddle's send/recv
+    convention); otherwise the value is treated as a group-local index.
+    Normalizing once at the boundary avoids dual-convention matching
+    ambiguity (a group-local index can collide with another member's
+    global rank)."""
+    if rank in group.ranks:
+        return rank
+    if 0 <= rank < group.nranks:
+        return group.ranks[rank]
+    raise ValueError(f"rank {rank} not in group {group.ranks}")
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
     group = _resolve_group(group)
     _P2P_BUF.setdefault(group.id, _collections.deque()).append(
-        (dst, _unwrap(tensor)))
+        (_global_rank(group, dst), _unwrap(tensor)))
     return _Task() if not sync_op else None
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     group = _resolve_group(group)
     buf = _P2P_BUF.get(group.id)
-    if buf:
-        _, v = buf.popleft()
-        tensor._set_data(v)
+    if not buf:
+        raise RuntimeError(
+            f"recv(src={src}) on group {group.id}: no outstanding send — "
+            "a matching send() must be issued first in single-controller "
+            "mode")
+    me = None
+    if group.nranks == 2:
+        src_g = _global_rank(group, src)
+        (a, b) = group.ranks
+        me = b if src_g == a else a
+    if me is not None:
+        for i, (dst, v) in enumerate(buf):
+            if dst == me:
+                del buf[i]
+                tensor._set_data(v)
+                return _Task(tensor) if not sync_op else tensor
+        raise RuntimeError(
+            f"recv(src={src}) on group {group.id}: no outstanding send "
+            f"addressed to rank {me}; pending destinations: "
+            f"{[d for d, _ in buf]}")
+    if len({d for d, _ in buf}) > 1:
+        _warnings.warn(
+            f"recv(src={src}) on group {group.id}: sends to multiple "
+            "destinations are outstanding and the receiver rank is "
+            "ambiguous in single-controller mode — delivering FIFO order",
+            RuntimeWarning, stacklevel=2)
+    _, v = buf.popleft()
+    tensor._set_data(v)
     return _Task(tensor) if not sync_op else tensor
 
 

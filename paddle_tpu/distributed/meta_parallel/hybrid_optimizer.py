@@ -49,10 +49,19 @@ class HybridParallelOptimizer:
         return getattr(self._inner_opt, item)
 
     def _commit_states(self):
-        mesh = self._hcg.mesh
+        # Accumulators must live on each parameter's OWN mesh: with
+        # pp_degree>1 params sit on per-stage sub-meshes (4 of 8 devices),
+        # and committing their moments to the full hybrid mesh would mix
+        # incompatible device sets inside opt.step().
+        default_mesh = self._hcg.mesh
         for p in self._inner_opt._all_params():
             st = self._inner_opt._accumulators.get(id(p))
             if not st:
+                continue
+            psh = p._data.sharding
+            mesh = psh.mesh if isinstance(psh, NamedSharding) \
+                else default_mesh
+            if self._sharding_axis not in mesh.shape:
                 continue
             for k, v in list(st.items()):
                 if getattr(v, "ndim", 0) == 0:

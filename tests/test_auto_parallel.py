@@ -236,3 +236,108 @@ class TestDistCheckpoint:
         for k in sd:
             np.testing.assert_allclose(sd2[k].numpy(), sd[k].numpy(),
                                        rtol=1e-6)
+
+
+class TestPartialReshard:
+    """reshard_p_to_r / p_to_s family (ADVICE r1 medium): in
+    single-controller mode each rank's local partial is the same array,
+    so the pending sum realizes as n * x (matches the reference's
+    all-reduce over n identical locals)."""
+
+    def _mesh(self):
+        return dist.ProcessMesh(np.arange(8).reshape(4, 2),
+                                dim_names=["x", "y"])
+
+    def test_p_to_r_applies_pending_sum(self):
+        mesh = self._mesh()
+        x = np.random.randn(8, 6).astype(np.float32)
+        t = dist.shard_tensor(x, mesh, [dist.Partial(), dist.Replicate()])
+        out = dist.reshard(t, mesh, [dist.Replicate(), dist.Replicate()])
+        np.testing.assert_allclose(out.numpy(), 4.0 * x, rtol=1e-6)
+
+    def test_p_to_s_reduce_scatter(self):
+        mesh = self._mesh()
+        x = np.random.randn(8, 6).astype(np.float32)
+        t = dist.shard_tensor(x, mesh, [dist.Partial(), dist.Replicate()])
+        out = dist.reshard(t, mesh, [dist.Shard(0), dist.Replicate()])
+        np.testing.assert_allclose(out.numpy(), 4.0 * x, rtol=1e-6)
+        spec = out._data.sharding.spec
+        assert "x" in str(spec)
+
+    def test_r_to_p_roundtrip(self):
+        mesh = self._mesh()
+        x = np.random.randn(4, 4).astype(np.float32)
+        t = dist.shard_tensor(x, mesh, [dist.Replicate(), dist.Replicate()])
+        p = dist.reshard(t, mesh, [dist.Partial(), dist.Replicate()])
+        np.testing.assert_allclose(p.numpy(), x / 4.0, rtol=1e-6)
+        r = dist.reshard(p, mesh, [dist.Replicate(), dist.Replicate()])
+        np.testing.assert_allclose(r.numpy(), x, rtol=1e-6)
+
+    def test_partial_avg_identity(self):
+        mesh = self._mesh()
+        x = np.random.randn(4, 4).astype(np.float32)
+        t = dist.shard_tensor(x, mesh,
+                              [dist.Partial("avg"), dist.Replicate()])
+        out = dist.reshard(t, mesh, [dist.Replicate(), dist.Replicate()])
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-6)
+
+
+class TestDistCheckpointMeshChange:
+    """Save on one mesh layout, load on another (VERDICT r1 item 8):
+    shard-wise placement with no full-host assembly, plus dtype cast."""
+
+    @pytest.mark.parametrize("src_pl,dst_pl", [
+        ([Shard(0), Replicate()], [Replicate(), Shard(0)]),
+        ([Shard(0), Shard(1)], [Shard(1), Shard(0)]),
+        ([Replicate(), Replicate()], [Shard(0), Shard(1)]),
+        ([Shard(1), Replicate()], [Replicate(), Replicate()]),
+    ])
+    def test_mesh_layout_matrix(self, tmp_path, src_pl, dst_pl):
+        # save on a 4x2 mesh, load on a 2x4 mesh
+        src_mesh = ProcessMesh(np.arange(8).reshape(4, 2),
+                               dim_names=["x", "y"])
+        dst_mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                               dim_names=["x", "y"])
+        x = np.random.randn(8, 16).astype(np.float32)
+        t = shard_tensor(x.copy(), src_mesh, src_pl)
+        dist.checkpoint.save_state_dict({"w": t}, str(tmp_path))
+        t2 = shard_tensor(np.zeros_like(x), dst_mesh, dst_pl)
+        dist.checkpoint.load_state_dict({"w": t2}, str(tmp_path))
+        np.testing.assert_allclose(t2.numpy(), x, rtol=1e-6)
+
+    def test_dtype_cast_on_load(self, tmp_path, mesh2d):
+        x = np.random.randn(8, 16).astype(np.float32)
+        t = shard_tensor(x.copy(), mesh2d, [Shard(0), Replicate()])
+        dist.checkpoint.save_state_dict({"w": t}, str(tmp_path))
+        import jax.numpy as jnp
+        t2 = shard_tensor(np.zeros((8, 16), np.float32), mesh2d,
+                          [Replicate(), Shard(1)])
+        t2._data = t2._data.astype(jnp.bfloat16)
+        dist.checkpoint.load_state_dict({"w": t2}, str(tmp_path))
+        assert str(t2._data.dtype) == "bfloat16"
+        np.testing.assert_allclose(t2.astype("float32").numpy(), x,
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_bf16_saved_shards_roundtrip(self, tmp_path, mesh2d):
+        import jax.numpy as jnp
+        x = np.random.randn(8, 16).astype(np.float32)
+        t = shard_tensor(x.copy(), mesh2d, [Shard(0), Shard(1)])
+        t._data = t._data.astype(jnp.bfloat16)
+        dist.checkpoint.save_state_dict({"w": t}, str(tmp_path))
+        t2 = shard_tensor(np.zeros((8, 16), np.float32), mesh2d,
+                          [Shard(1), Shard(0)])
+        t2._data = t2._data.astype(jnp.bfloat16)
+        dist.checkpoint.load_state_dict({"w": t2}, str(tmp_path))
+        np.testing.assert_allclose(
+            t2.astype("float32").numpy(),
+            np.asarray(jnp.asarray(x).astype(jnp.bfloat16)
+                       .astype(jnp.float32)))
+
+    def test_shape_mismatch_raises(self, tmp_path, mesh2d):
+        x = np.random.randn(8, 16).astype(np.float32)
+        t = shard_tensor(x.copy(), mesh2d, [Shard(0), Replicate()])
+        dist.checkpoint.save_state_dict({"w": t}, str(tmp_path))
+        t2 = shard_tensor(np.zeros((4, 16), np.float32), mesh2d,
+                          [Replicate(), Replicate()])
+        with pytest.raises(ValueError, match="saved shape"):
+            dist.checkpoint.load_state_dict({"w": t2}, str(tmp_path))
