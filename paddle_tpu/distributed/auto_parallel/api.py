@@ -224,7 +224,12 @@ def _pending_reduce_factor(src_attr, mesh: ProcessMesh, placements):
     (r -> p) divides by n so p -> r round-trips bit-faithfully in the
     sum case."""
     factor = 1.0
-    if src_attr is not None and src_attr.process_mesh == mesh:
+    if src_attr is None:
+        # Untagged tensors (fresh Tensor / op results) are global,
+        # fully-reduced values — treat as Replicate on every mesh dim so
+        # r -> p -> r round-trips instead of silently inflating by n.
+        src_attr = DistAttr(mesh, [Replicate()] * mesh.ndim)
+    if src_attr.process_mesh == mesh:
         for dim, (src_pl, dst_pl) in enumerate(
                 zip(src_attr.placements, placements)):
             n = mesh.get_dim_size(mesh.dim_names[dim])
@@ -238,8 +243,7 @@ def _pending_reduce_factor(src_attr, mesh: ProcessMesh, placements):
                 factor *= n      # apply the pending sum
             elif dst_p and not src_p and dst_pl.reduce_type == "sum":
                 factor /= n      # split into n identical partials
-    elif src_attr is not None and any(
-            isinstance(p, Partial) for p in src_attr.placements):
+    elif any(isinstance(p, Partial) for p in src_attr.placements):
         raise NotImplementedError(
             "reshard of a Partial tensor onto a different mesh")
     return factor

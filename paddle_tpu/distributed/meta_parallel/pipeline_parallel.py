@@ -32,14 +32,20 @@ class PipelineParallel(MetaParallelBase):
                 "PipelineParallel requires a PipelineLayer model")
         self.accumulate_steps = 1
         self.micro_batch_size = None
+        self.schedule_mode = None   # None -> legacy per-micro loop
         if strategy is not None:
             cfg = getattr(strategy, "pipeline_configs", {}) or {}
             self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
             self.micro_batch_size = cfg.get("micro_batch_size")
+            self.schedule_mode = cfg.get("schedule_mode")
+        if self.schedule_mode is None and layers.num_chunks > 1:
+            self.schedule_mode = "Interleaved1F1B"
         super().__init__(layers, hcg, strategy)
         self.num_stages = hcg.get_pipe_parallel_world_size()
         self.stage_id = 0
         self.total_loss = None
+        self.last_schedule = None   # Unit list of the last run (tests)
+        self.last_executed = None   # (kind, part, micro) execution log
 
     def _prepare_for_model(self):
         # PipelineLayer already committed per-stage placement; the base
@@ -63,8 +69,40 @@ class PipelineParallel(MetaParallelBase):
         mb = b // n
         return [t[i * mb:(i + 1) * mb] for i in range(n)]
 
+    def _scheduled_forward_backward(self, data, scaler=None,
+                                    forward_only=False):
+        """Explicit schedule path (1F1B / Interleaved1F1B / FThenB):
+        ref pipeline_parallel.py:431 (1F1B), :1091 (VPP), :1473."""
+        from .pipeline_schedules import build_schedule, ScheduleExecutor
+
+        micros = self._split_micro(data)
+        n = len(micros)
+        xs, labels = [], []
+        for m in micros:
+            if isinstance(m, (tuple, list)) and len(m) == 2:
+                xs.append(m[0])
+                labels.append(m[1])
+            else:
+                xs.append(m)
+                labels.append(None)
+        # stage count comes from the LAYER (its parts are what execute);
+        # hcg's pp size only governs mesh carving and may differ when a
+        # PipelineLayer was built with an explicit num_stages
+        order = build_schedule(self.schedule_mode,
+                               self._layers._num_stages, n,
+                               self._layers.num_chunks)
+        ex = ScheduleExecutor(self._layers, self._layers._loss_fn, scaler)
+        total = ex.run(order, xs, labels, forward_only=forward_only)
+        self.last_schedule = order
+        self.last_executed = ex.executed
+        self.total_loss = total
+        return total
+
     def forward_backward_pipeline(self, data, scaler=None,
                                   forward_only=False):
+        if self.schedule_mode is not None:
+            return self._scheduled_forward_backward(
+                data, scaler, forward_only=forward_only)
         micros = self._split_micro(data)
         n = len(micros)
         total = None

@@ -1,0 +1,225 @@
+"""Explicit pipeline schedules: F-then-B, 1F1B, interleaved (VPP).
+
+Reference: PipelineParallel.forward_backward_pipeline (1F1B,
+python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:431),
+interleaved VPP (:1091) and FThenB (:1473).
+
+TPU rendering: the reference's per-rank loops exchange activations with
+p2p send/recv; here one controller owns all stages, so a schedule is a
+LINEARIZATION of the same unit DAG — F(part, micro) and B(part, micro)
+units with the reference's dependency structure — enqueued to XLA in
+timeline order. Units touching different stage sub-meshes have disjoint
+device sets, so units that share a simulated clock cycle genuinely
+overlap under async dispatch. The schedule's value on TPU is the same
+memory control the reference gets: 1F1B caps in-flight activations per
+stage at its warmup depth + 1, F-then-B holds all micro-batches.
+
+The backward of each unit is cut at the stage boundary: the stage input
+is a detached leaf, so `run_backward(out, cotangent)` accumulates THIS
+stage's parameter grads and deposits the input cotangent for the
+previous stage — the reference's send/recv of grads becomes a
+device_put of the cotangent onto the upstream sub-mesh.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Unit:
+    kind: str        # "F" | "B"
+    part: int        # model chunk index (== stage when v == 1)
+    micro: int
+    stage: int       # owning pipeline stage = part % num_stages
+    cycle: int       # simulated clock cycle (units sharing a cycle
+                     # run on disjoint stage meshes -> overlap)
+
+
+def _simulate(num_stages: int, num_micro: int, num_chunks: int,
+              warmup: List[int], prefer_depth_first: bool) -> List[Unit]:
+    """Event-driven linearization of the pipeline unit DAG.
+
+    Per cycle each stage executes at most one ready unit; a stage
+    prefers F while it has executed fewer forwards than its warmup
+    quota, then alternates B-first (the 1F1B steady state). With
+    warmup == all forwards this degenerates to F-then-B.
+    """
+    p, n, v = num_stages, num_micro, num_chunks
+    parts = p * v
+    f_done = [[False] * n for _ in range(parts)]
+    b_done = [[False] * n for _ in range(parts)]
+    stage_parts = {s: [c * p + s for c in range(v)] for s in range(p)}
+    f_count = [0] * p
+    b_count = [0] * p
+    total_f = n * v
+    order: List[Unit] = []
+    cycle = 0
+    while any(f_count[s] < total_f or b_count[s] < total_f
+              for s in range(p)):
+        progressed = False
+        for s in range(p):
+            unit = None
+            # ready F units owned by this stage, chunk-major then micro
+            ready_f = [(part, m) for part in stage_parts[s]
+                       for m in range(n)
+                       if not f_done[part][m]
+                       and (part == 0 or f_done[part - 1][m])]
+            ready_b = [(part, m) for part in reversed(stage_parts[s])
+                       for m in range(n)
+                       if not b_done[part][m] and f_done[part][m]
+                       and (part == parts - 1 or b_done[part + 1][m])]
+            if prefer_depth_first:
+                # micro-major F order: finish micro m through this
+                # stage's chunks before starting m+1 (interleave style
+                # groups handled by the warmup quota)
+                ready_f.sort(key=lambda pm: (pm[1] // p, pm[0], pm[1]))
+            if f_count[s] < warmup[s] and ready_f:
+                unit = ("F",) + ready_f[0]
+            elif ready_b:
+                unit = ("B",) + ready_b[0]
+            elif (ready_f and f_count[s] < total_f
+                  and f_count[s] - b_count[s] <= warmup[s]):
+                # steady state: one F per completed B — keeps in-flight
+                # activations capped at warmup + 1 (the 1F1B invariant);
+                # without the cap a stage would run ahead through the
+                # bubble and hold every micro-batch like F-then-B
+                unit = ("F",) + ready_f[0]
+            if unit is None:
+                continue
+            kind, part, m = unit
+            if kind == "F":
+                f_done[part][m] = True
+                f_count[s] += 1
+            else:
+                b_done[part][m] = True
+                b_count[s] += 1
+            order.append(Unit(kind, part, m, s, cycle))
+            progressed = True
+        cycle += 1
+        if not progressed and cycle > 4 * parts * n + 16:
+            raise RuntimeError("pipeline schedule deadlocked")
+    return order
+
+
+@functools.lru_cache(maxsize=64)
+def build_schedule(mode: str, num_stages: int, num_micro: int,
+                   num_chunks: int = 1) -> List[Unit]:
+    """mode: 'FThenB' | '1F1B' | 'Interleaved1F1B' (needs num_chunks>1).
+
+    Warmup quotas match the reference:
+      1F1B: p - s - 1            (pipeline_parallel.py:431)
+      VPP:  (p - s - 1) * 2 + (v - 1) * p   (:1091, Megatron layout)
+
+    The simulation is pure in its arguments, so the unit list is
+    memoized — a training loop pays it once, not per step.
+    """
+    p, n, v = num_stages, num_micro, num_chunks
+    total_f = n * v
+    if mode == "FThenB":
+        warmup = [total_f] * p
+        return _simulate(p, n, v, warmup, prefer_depth_first=False)
+    if mode == "1F1B":
+        if v != 1:
+            raise ValueError("1F1B uses one chunk; use Interleaved1F1B")
+        warmup = [min(p - s - 1, total_f) for s in range(p)]
+        return _simulate(p, n, 1, warmup, prefer_depth_first=False)
+    if mode == "Interleaved1F1B":
+        if v < 2:
+            raise ValueError(
+                "Interleaved1F1B needs num_virtual_pipeline_stages >= 2")
+        warmup = [min((p - s - 1) * 2 + (v - 1) * p, total_f)
+                  for s in range(p)]
+        return _simulate(p, n, v, warmup, prefer_depth_first=True)
+    raise ValueError(f"unknown pipeline schedule mode {mode!r}")
+
+
+def max_in_flight(order: List[Unit], num_stages: int) -> List[int]:
+    """Peak (#F executed - #B executed) per stage — the activation
+    memory high-water mark the schedule implies."""
+    peak = [0] * num_stages
+    live = [0] * num_stages
+    for u in order:
+        live[u.stage] += 1 if u.kind == "F" else -1
+        peak[u.stage] = max(peak[u.stage], live[u.stage])
+    return peak
+
+
+class ScheduleExecutor:
+    """Runs a unit order against a PipelineLayer, cutting autograd at
+    part boundaries so each B unit touches only its part's params."""
+
+    def __init__(self, pipe, loss_fn, scaler=None):
+        self._pipe = pipe
+        self._loss_fn = loss_fn
+        self._scaler = scaler
+        self._cotangent = {}
+        self.executed: List[Tuple[str, int, int]] = []  # (kind, part, m)
+
+    def run(self, order: List[Unit], micro_inputs, micro_labels,
+            forward_only=False):
+        from ...core.tensor import Tensor
+        from ...autograd.tape import run_backward
+
+        pipe = self._pipe
+        n_parts = pipe.num_parts
+        n = len(micro_inputs)
+        # saved[(part, m)] = (input_leaf, output)
+        saved = {}
+        total = None
+        for u in order:
+            if u.kind == "F":
+                if u.part == 0:
+                    x = micro_inputs[u.micro]
+                else:
+                    key = (u.part - 1, u.micro)
+                    prev_out = saved[key][1]
+                    if forward_only:
+                        # no B unit will pop it — release now, or eval
+                        # holds every micro-batch at every part
+                        del saved[key]
+                    x = pipe.transfer_to_part(prev_out, u.part)
+                if not isinstance(x, Tensor):
+                    raise TypeError(
+                        "scheduled pipeline needs single-Tensor "
+                        f"stage activations, got {type(x)}")
+                if not forward_only:
+                    x = x.detach()
+                    x.stop_gradient = False
+                out = pipe.forward_part(x, u.part)
+                if u.part == n_parts - 1:
+                    loss = out
+                    if self._loss_fn is not None and \
+                            micro_labels[u.micro] is not None:
+                        loss = self._loss_fn(out, micro_labels[u.micro])
+                    loss = loss / n
+                    if self._scaler is not None:
+                        out = self._scaler.scale(loss)
+                    else:
+                        out = loss
+                    d = loss.detach()
+                    total = d if total is None else total + d
+                if not (forward_only and u.part == n_parts - 1):
+                    saved[(u.part, u.micro)] = (x, out)
+                self.executed.append(("F", u.part, u.micro))
+            else:
+                if forward_only:
+                    continue
+                x, out = saved.pop((u.part, u.micro))
+                if u.part == n_parts - 1:
+                    if out.ndim != 0 and out.size != 1:
+                        raise RuntimeError(
+                            "scheduled train_batch needs a scalar loss "
+                            "(set loss_fn on the PipelineLayer)")
+                    run_backward([out], [None])
+                else:
+                    g = self._cotangent.pop((u.part, u.micro))
+                    run_backward([out], [g])
+                if u.part > 0:
+                    ct = x.grad
+                    x._grad = None
+                    ct = pipe.transfer_cotangent(ct, u.part - 1)
+                    self._cotangent[(u.part - 1, u.micro)] = ct
+                self.executed.append(("B", u.part, u.micro))
+        return total

@@ -143,18 +143,24 @@ class PipelineLayer(Layer):
             num_stages = (hcg.get_pipe_parallel_world_size()
                           if hcg is not None else 1)
         self._num_stages = num_stages
+        # Interleaved VPP (ref pp_layers.py get_num_virtual_stages): the
+        # model splits into num_stages * v parts; part j lives on stage
+        # j % num_stages (Megatron round-robin chunk layout).
+        self._num_chunks = int(num_virtual_pipeline_stages or 1)
+        num_parts = num_stages * self._num_chunks
         self._descs = list(layers)
-        bounds = SegmentLayers(self._descs, num_stages,
+        bounds = SegmentLayers(self._descs, num_parts,
                                seg_method).do_segment()
         self.segment_parts = bounds
 
-        # build every stage; shared descs build once (keyed)
+        # build every part; shared descs build once (keyed)
         self._shared: dict = {}
         self._stage_of_layer: List[int] = []
-        stage_lists = []
-        for s in range(num_stages):
+        part_lists = []
+        for part in range(num_parts):
+            s = self.stage_of_part(part)
             mods = []
-            for i in range(bounds[s], bounds[s + 1]):
+            for i in range(bounds[part], bounds[part + 1]):
                 d = self._descs[i]
                 if isinstance(d, SharedLayerDesc):
                     first_use = d.layer_name not in self._shared
@@ -171,8 +177,8 @@ class PipelineLayer(Layer):
                 else:  # plain callable (e.g. a lambda reshaping)
                     mods.append(_FnLayer(d))
                 self._stage_of_layer.append(s)
-            stage_lists.append(LayerList(mods))
-        self.stages = LayerList(stage_lists)
+            part_lists.append(LayerList(mods))
+        self.stages = LayerList(part_lists)  # parts == stages when v == 1
 
         # per-stage sub-meshes + param placement
         self._stage_meshes: List[Optional[Mesh]] = [None] * num_stages
@@ -185,15 +191,27 @@ class PipelineLayer(Layer):
         for s in range(self._num_stages):
             sub = devs[:, s]
             self._stage_meshes[s] = Mesh(sub, _STAGE_AXES)
-        for s, stage in enumerate(self.stages):
-            mesh = self._stage_meshes[s]
-            for mod in stage:
+        for part, mods in enumerate(self.stages):
+            mesh = self._stage_meshes[self.stage_of_part(part)]
+            for mod in mods:
                 if isinstance(mod, _SharedCall):
                     # shared params live on their HOME stage's mesh
                     mesh_home = self._stage_meshes[mod.home_stage]
                     self._commit_layer(mod.layer, mesh_home)
                 else:
                     self._commit_layer(mod, mesh)
+
+    # ---- part topology ----
+    @property
+    def num_parts(self) -> int:
+        return len(self.stages)
+
+    @property
+    def num_chunks(self) -> int:
+        return self._num_chunks
+
+    def stage_of_part(self, part: int) -> int:
+        return part % self._num_stages
 
     @staticmethod
     def _commit_layer(layer: Layer, mesh: Mesh):
@@ -224,9 +242,37 @@ class PipelineLayer(Layer):
             self._xfer_cache[key] = op
         return dispatch(op, (x,), {})
 
+    def transfer_to_part(self, x: Tensor, part: int) -> Tensor:
+        """Differentiable move of an activation onto `part`'s stage
+        mesh (the scheduled F unit's recv)."""
+        return self._transfer(x, self.stage_of_part(part))
+
+    def transfer_cotangent(self, ct, dst_part: int):
+        """Eager (non-recorded) move of a cotangent onto the upstream
+        part's mesh — the scheduled B unit's grad send."""
+        mesh = self._stage_meshes[self.stage_of_part(dst_part)]
+        if mesh is None or ct is None:
+            return ct
+        data = ct._data if isinstance(ct, Tensor) else ct
+        spec = P()
+        sh = data.sharding
+        if isinstance(sh, NamedSharding) and all(
+                ax in mesh.axis_names for ax in _spec_axes(sh.spec)):
+            spec = sh.spec
+        out = Tensor._wrap(jax.device_put(data, NamedSharding(mesh, spec)))
+        out.stop_gradient = True
+        return out
+
     def forward_stage(self, x, stage_id: int):
-        stage = self.stages[stage_id]
-        mods = list(stage)
+        """Stage-indexed forward — only meaningful without virtual
+        chunks (with VPP a stage holds several non-contiguous parts)."""
+        assert self._num_chunks == 1, (
+            "forward_stage is stage-indexed; with "
+            "num_virtual_pipeline_stages > 1 use forward_part")
+        return self.forward_part(x, stage_id)
+
+    def forward_part(self, x, part: int):
+        mods = list(self.stages[part])
         i = 0
         while i < len(mods):
             if (self._recompute_interval > 0 and
@@ -244,15 +290,22 @@ class PipelineLayer(Layer):
         return x
 
     def forward(self, x):
-        for s in range(self._num_stages):
-            if s > 0:
+        for part in range(self.num_parts):
+            s = self.stage_of_part(part)
+            if part > 0:
                 x = self._transfer(x, s) if not isinstance(x, tuple) else \
                     tuple(self._transfer(t, s) for t in x)
-            x = self.forward_stage(x, s)
+            x = self.forward_part(x, part)
         return x
 
     def get_stage_params(self, stage_id):
-        return list(self.stages[stage_id].parameters())
+        """Parameters living on pipeline stage `stage_id` — with VPP
+        this spans every chunk the stage owns (parts stage_id,
+        stage_id + p, ...)."""
+        out = []
+        for part in range(stage_id, self.num_parts, self._num_stages):
+            out.extend(self.stages[part].parameters())
+        return out
 
 
 class _FnLayer(Layer):

@@ -1,0 +1,217 @@
+"""Explicit pipeline schedule tests (VERDICT r1 item 4).
+
+Covers: unit-order generation (warmup/steady/drain), dependency
+validity, the 1F1B memory cap vs F-then-B, gradient equivalence of the
+scheduled paths against the legacy per-micro loop, and interleaved VPP.
+Reference semantics: fleet/meta_parallel/pipeline_parallel.py:431 (1F1B),
+:1091 (interleave), :1473 (FThenB).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.meta_parallel.pipeline_schedules import (
+    build_schedule, max_in_flight)
+
+
+@pytest.fixture(autouse=True)
+def _reset_groups():
+    dist.destroy_process_group()
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    set_hybrid_communicate_group(None)
+    yield
+    dist.destroy_process_group()
+    set_hybrid_communicate_group(None)
+
+
+def _check_dependencies(order, num_parts):
+    done_f, done_b = set(), set()
+    for u in order:
+        if u.kind == "F":
+            if u.part > 0:
+                assert (u.part - 1, u.micro) in done_f, u
+            done_f.add((u.part, u.micro))
+        else:
+            assert (u.part, u.micro) in done_f, u
+            if u.part < num_parts - 1:
+                assert (u.part + 1, u.micro) in done_b, u
+            done_b.add((u.part, u.micro))
+    return done_f, done_b
+
+
+class TestScheduleGeneration:
+    def test_1f1b_warmup_steady_drain(self):
+        p, n = 4, 8
+        order = build_schedule("1F1B", p, n)
+        done_f, done_b = _check_dependencies(order, p)
+        assert len(done_f) == len(done_b) == p * n
+        # last stage pipelines immediately: its first B directly follows
+        # its first F (warmup 0)
+        last = [u for u in order if u.stage == p - 1]
+        assert [u.kind for u in last[:4]] == ["F", "B", "F", "B"]
+        # stage 0 warms up p-1 forwards, then the steady state's leading
+        # F — its first backward is unit index p (Megatron 1F1B timeline)
+        s0 = [u for u in order if u.stage == 0]
+        first_b = next(i for i, u in enumerate(s0) if u.kind == "B")
+        assert first_b == p
+        # memory cap: stage s keeps at most p - s micro-batches in flight
+        peaks = max_in_flight(order, p)
+        assert peaks == [p - s for s in range(p)]
+
+    def test_fthenb_holds_everything(self):
+        p, n = 4, 8
+        order = build_schedule("FThenB", p, n)
+        _check_dependencies(order, p)
+        peaks = max_in_flight(order, p)
+        assert peaks == [n] * p   # every micro-batch's activations live
+
+    def test_1f1b_beats_fthenb_on_memory(self):
+        p, n = 4, 16
+        f = max_in_flight(build_schedule("FThenB", p, n), p)
+        o = max_in_flight(build_schedule("1F1B", p, n), p)
+        assert max(o) < max(f)
+
+    def test_interleaved_dependencies_and_warmup(self):
+        p, n, v = 2, 4, 2
+        order = build_schedule("Interleaved1F1B", p, n, v)
+        done_f, done_b = _check_dependencies(order, p * v)
+        assert len(done_f) == len(done_b) == p * v * n
+        # chunks round-robin: part j on stage j % p
+        for u in order:
+            assert u.stage == u.part % p
+        # interleaving really happens: some B precedes the last F
+        kinds = [u.kind for u in order]
+        assert "B" in kinds[:kinds[::-1].index("F") * -1 or len(kinds)]
+
+    def test_overlap_cycles_use_disjoint_stages(self):
+        order = build_schedule("1F1B", 4, 8)
+        by_cycle = {}
+        for u in order:
+            by_cycle.setdefault(u.cycle, []).append(u.stage)
+        # within a simulated cycle every unit is on a different stage
+        # sub-mesh -> genuinely overlappable under async dispatch
+        for c, stages in by_cycle.items():
+            assert len(stages) == len(set(stages)), (c, stages)
+
+    def test_bad_modes_raise(self):
+        with pytest.raises(ValueError):
+            build_schedule("zigzag", 2, 4)
+        with pytest.raises(ValueError):
+            build_schedule("Interleaved1F1B", 2, 4, 1)
+        with pytest.raises(ValueError):
+            build_schedule("1F1B", 2, 4, 2)
+
+
+def _build_pipe(schedule_mode, accumulate_steps=4, v=1, seed=7):
+    from paddle_tpu.distributed.fleet import fleet
+    from paddle_tpu.distributed.meta_parallel import (
+        PipelineLayer, LayerDesc)
+
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 2}
+    cfg = {"accumulate_steps": accumulate_steps}
+    if schedule_mode is not None:
+        cfg["schedule_mode"] = schedule_mode
+    strategy.pipeline_configs = cfg
+    dist.fleet.init(strategy=strategy)
+    pt.seed(seed)
+    descs = [
+        LayerDesc(pt.nn.Linear, 16, 32),
+        LayerDesc(pt.nn.Linear, 32, 32),
+        LayerDesc(pt.nn.Linear, 32, 16),
+        LayerDesc(pt.nn.Linear, 16, 8),
+    ]
+    model = PipelineLayer(
+        layers=descs,
+        loss_fn=lambda out, lbl: pt.ops.mean((out - lbl) ** 2),
+        num_virtual_pipeline_stages=v if v > 1 else None)
+    pipe = fleet.distributed_model(model)
+    return pipe, model
+
+
+def _grads(model):
+    return {n: p.grad.numpy().copy() for n, p in model.named_parameters()
+            if p.grad is not None}
+
+
+class TestScheduledExecution:
+    def _data(self):
+        rng = np.random.default_rng(0)
+        x = pt.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+        y = pt.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+        return x, y
+
+    def test_1f1b_matches_legacy_loop(self):
+        x, y = self._data()
+        pipe, model = _build_pipe(None)
+        loss_ref = pipe.forward_backward_pipeline([x, y])
+        g_ref = _grads(model)
+        assert g_ref
+
+        pipe2, model2 = _build_pipe("1F1B")
+        loss = pipe2.forward_backward_pipeline([x, y])
+        g = _grads(model2)
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(loss_ref.numpy()), rtol=1e-5)
+        assert g.keys() == g_ref.keys()
+        for k in g_ref:
+            np.testing.assert_allclose(g[k], g_ref[k], rtol=1e-4,
+                                       atol=1e-5, err_msg=k)
+        # execution log follows the declared schedule order
+        assert pipe2.last_executed == [
+            (u.kind, u.part, u.micro) for u in pipe2.last_schedule]
+        assert any(k == "B" for k, _, _ in pipe2.last_executed[:-5])
+
+    def test_fthenb_matches_legacy_loop(self):
+        x, y = self._data()
+        pipe, model = _build_pipe(None)
+        pipe.forward_backward_pipeline([x, y])
+        g_ref = _grads(model)
+
+        pipe2, model2 = _build_pipe("FThenB")
+        pipe2.forward_backward_pipeline([x, y])
+        g = _grads(model2)
+        for k in g_ref:
+            np.testing.assert_allclose(g[k], g_ref[k], rtol=1e-4,
+                                       atol=1e-5, err_msg=k)
+        # all forwards precede all backwards
+        kinds = [k for k, _, _ in pipe2.last_executed]
+        assert kinds.index("B") == kinds.count("F")
+
+    def test_interleaved_vpp_runs_and_matches(self):
+        x, y = self._data()
+        pipe, model = _build_pipe(None)
+        pipe.forward_backward_pipeline([x, y])
+        g_ref = _grads(model)
+
+        pipe2, model2 = _build_pipe("Interleaved1F1B", v=2)
+        assert model2.num_parts == 4 and model2.num_chunks == 2
+        loss = pipe2.forward_backward_pipeline([x, y])
+        assert np.isfinite(float(loss.numpy()))
+        g = _grads(model2)
+        # same underlying 4 Linear layers, same math
+        for (k1, v1), (k2, v2) in zip(sorted(g_ref.items()),
+                                      sorted(g.items())):
+            np.testing.assert_allclose(v2, v1, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"{k1} vs {k2}")
+
+    def test_train_batch_with_optimizer_1f1b(self):
+        from paddle_tpu.distributed.fleet import fleet
+        x, y = self._data()
+        pipe, model = _build_pipe("1F1B")
+        opt = fleet.distributed_optimizer(
+            pt.optimizer.AdamW(learning_rate=1e-3,
+                               parameters=model.parameters()))
+        l1 = pipe.train_batch([x, y], opt)
+        l2 = pipe.train_batch([x, y], opt)
+        assert np.isfinite(float(l2.numpy()))
+        assert float(l2.numpy()) < float(l1.numpy())
+
+    def test_eval_batch_forward_only(self):
+        x, y = self._data()
+        pipe, _ = _build_pipe("1F1B")
+        loss = pipe.eval_batch([x, y])
+        assert np.isfinite(float(loss.numpy()))
+        assert all(k == "F" for k, _, _ in pipe.last_executed)
