@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import jax
 
+from . import memory  # noqa: F401
 from ..core.device import (  # noqa: F401
     set_device, get_device, get_place, Place, CPUPlace, TPUPlace, CUDAPlace,
     device_count, is_compiled_with_cuda, is_compiled_with_tpu,
@@ -79,41 +80,27 @@ class stream_guard:
 
 
 class cuda:  # namespace shim: paddle.device.cuda.*
-    Stream = Stream
-    Event = Event
-
-    @staticmethod
-    def device_count():
-        return device_count()
+    """ref: python/paddle/device/cuda/__init__.py — on TPU the stats
+    come from PJRT via paddle_tpu.device.memory."""
 
     @staticmethod
     def synchronize(device=None):
         synchronize()
 
-    @staticmethod
-    def max_memory_allocated(device=None):
-        try:
-            stats = jax.devices()[0].memory_stats()
-            return stats.get("peak_bytes_in_use", 0)
-        except Exception:
-            return 0
+    max_memory_allocated = staticmethod(memory.max_memory_allocated)
+    memory_allocated = staticmethod(memory.memory_allocated)
+    memory_reserved = staticmethod(memory.memory_reserved)
+    max_memory_reserved = staticmethod(memory.max_memory_reserved)
+    reset_max_memory_allocated = staticmethod(
+        memory.reset_max_memory_allocated)
+    reset_peak_memory_stats = staticmethod(memory.reset_peak_memory_stats)
+    empty_cache = staticmethod(memory.empty_cache)
+    memory_stats = staticmethod(memory.memory_stats)
 
     @staticmethod
-    def memory_allocated(device=None):
-        try:
-            stats = jax.devices()[0].memory_stats()
-            return stats.get("bytes_in_use", 0)
-        except Exception:
-            return 0
+    def device_count():
+        return device_count()
 
-    @staticmethod
-    def max_memory_reserved(device=None):
-        try:
-            stats = jax.devices()[0].memory_stats()
-            return stats.get("peak_bytes_in_use", 0)
-        except Exception:
-            return 0
 
-    @staticmethod
-    def empty_cache():
-        pass
+cuda.Stream = Stream
+cuda.Event = Event

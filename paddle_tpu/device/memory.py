@@ -1,0 +1,103 @@
+"""Device memory telemetry over PJRT.
+
+Reference: paddle.device.cuda memory stats (python/paddle/device/cuda/
+__init__.py: max_memory_allocated:110, memory_allocated:170,
+memory_reserved) backed by paddle/fluid/memory/stats.cc
+(HostMemoryStat/DeviceMemoryStat peaks).
+
+TPU rendering: PJRT owns the allocator, so the numbers come from
+`device.memory_stats()` (bytes_in_use / peak_bytes_in_use /
+bytes_limit, populated on TPU; CPU PJRT may return nothing — callers
+get zeros there). `reset_max_memory_allocated` is best-effort: PJRT
+peaks are monotone, so after a reset the reported peak is the high
+water mark relative to the reset point, re-derived from bytes_in_use
+observations at call time.
+
+`state_bytes_per_device` gives EXACT per-device accounting for a set of
+arrays (each device's resident shard bytes) — the measurable criterion
+for the ZeRO-3 "memory actually drops" proof, and works on every
+backend including the CPU test mesh.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+import jax
+
+_peak_baseline: Dict[int, int] = {}
+
+
+def _device(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    return device
+
+
+def memory_stats(device=None) -> dict:
+    d = _device(device)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    d = _device(device)
+    stats = memory_stats(d)
+    peak = int(stats.get("peak_bytes_in_use", 0))
+    base = _peak_baseline.get(d.id)
+    if base is None:
+        return peak
+    # PJRT peaks are monotone: a peak above the reset-time snapshot
+    # means a NEW high-water mark happened after the reset — report it
+    # absolutely; otherwise nothing exceeded the baseline yet and the
+    # best observable answer is the current usage.
+    if peak > base:
+        return peak
+    return int(stats.get("bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    return int(memory_stats(device).get("bytes_limit", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    # PJRT has no reservation/usage split; peak usage is the closest
+    # analogue of the reference's peak-reserved metric
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def reset_max_memory_allocated(device=None) -> None:
+    d = _device(device)
+    _peak_baseline[d.id] = int(
+        memory_stats(d).get("peak_bytes_in_use", 0))
+
+
+reset_peak_memory_stats = reset_max_memory_allocated
+
+
+def empty_cache() -> None:
+    """PJRT owns caching; parity no-op (ref cuda.empty_cache)."""
+
+
+def state_bytes_per_device(arrays: Iterable) -> Dict[int, int]:
+    """Exact bytes each device holds for `arrays` (Tensors or
+    jax.Arrays): sum of resident shard sizes, counting replicas on
+    every device that stores one."""
+    per: Dict[int, int] = {}
+    for a in arrays:
+        data = getattr(a, "_data", a)
+        shards = getattr(data, "addressable_shards", None)
+        if shards is None:
+            d = jax.devices()[0].id
+            per[d] = per.get(d, 0) + data.size * data.dtype.itemsize
+            continue
+        for sh in shards:
+            per[sh.device.id] = per.get(sh.device.id, 0) + sh.data.nbytes
+    return per

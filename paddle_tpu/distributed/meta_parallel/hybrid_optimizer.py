@@ -37,12 +37,89 @@ def _fsdp_spec(shape, axis: str, mesh) -> P:
 
 
 class HybridParallelOptimizer:
-    def __init__(self, optimizer, hcg, strategy=None):
+    """sharding_configs["stage"] selects the ZeRO level (ref
+    group_sharded_stage2.py / group_sharded_stage3.py:85):
+      1: optimizer states sharded (accumulators committed to the
+         sharding axis; params re-gathered after step)
+      2: + gradients reduce-scattered onto the sharding axis before the
+         update (the full grad is freed once its shard is committed)
+      3: + parameters THEMSELVES stored sharded; consumers all-gather
+         on use and XLA frees the gathered copy after the consuming op
+         (the reference's pre-forward allgather / post-use release
+         schedule, emitted by GSPMD instead of hooks)
+    """
+
+    def __init__(self, optimizer, hcg, strategy=None, stage=None):
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
         self._shard_states = hcg.get_sharding_parallel_world_size() > 1
         self._sharding_axis = "sharding"
+        if stage is None:
+            cfg = getattr(strategy, "sharding_configs", None) or {}
+            stage = int(cfg.get("stage", 1))
+        if stage not in (1, 2, 3):
+            raise ValueError(f"sharding stage must be 1, 2 or 3: {stage}")
+        self.sharding_stage = stage
+        if self._shard_states and stage >= 2:
+            self._install_grad_shard_hooks()
+        if self._shard_states and stage == 3:
+            self._commit_params_sharded()
+
+    def _param_mesh(self, p):
+        psh = p._data.sharding
+        if isinstance(psh, NamedSharding):
+            return psh.mesh
+        return self._hcg.mesh
+
+    def _commit_params_sharded(self):
+        """Stage 3: persistent param storage is the shard itself."""
+        for p in self._inner_opt._all_params():
+            mesh = self._param_mesh(p)
+            if self._sharding_axis not in mesh.shape:
+                continue
+            spec = _fsdp_spec(p._data.shape, self._sharding_axis, mesh)
+            p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+
+    def _install_grad_shard_hooks(self):
+        """Stage >= 2: the reduce-scatter, applied AT GRAD PRODUCTION.
+
+        The reference hooks each parameter's grad and reduce-scatters
+        it bucket-wise during backward (group_sharded_stage2.py) so the
+        full gradient of the whole model is never resident at once.
+        Here a tape hook commits each cotangent to the sharding-axis
+        spec the moment the tape deposits it; the full per-param grad
+        is a transient and XLA frees it after the device_put. Cotangent
+        accumulation across micro-batches stays sharded (sharded +
+        sharded adds in place)."""
+        for p in self._inner_opt._all_params():
+            if p.stop_gradient:
+                continue
+            mesh = self._param_mesh(p)
+            if self._sharding_axis not in mesh.shape:
+                continue
+            spec = _fsdp_spec(p._data.shape, self._sharding_axis, mesh)
+            sh = NamedSharding(mesh, spec)
+
+            def _shard_grad(g, _sh=sh):
+                out = Tensor._wrap(jax.device_put(g._data, _sh))
+                out.stop_gradient = True
+                return out
+
+            p.register_hook(_shard_grad)
+
+    def _commit_grads_sharded(self):
+        """Safety net for grads that arrived outside the tape (e.g.
+        manually assigned): same commit as the production-time hook."""
+        for p in self._inner_opt._all_params():
+            g = p._grad
+            if g is None:
+                continue
+            mesh = self._param_mesh(p)
+            if self._sharding_axis not in mesh.shape:
+                continue
+            spec = _fsdp_spec(g._data.shape, self._sharding_axis, mesh)
+            g._data = jax.device_put(g._data, NamedSharding(mesh, spec))
 
     # ---- delegation ----
     def __getattr__(self, item):
@@ -72,6 +149,8 @@ class HybridParallelOptimizer:
     def step(self):
         # materialise accumulators, then shard them (stage 1)
         if self._shard_states:
+            if self.sharding_stage >= 2:
+                self._commit_grads_sharded()
             for p in self._inner_opt._all_params():
                 if not p.stop_gradient and p._grad is not None:
                     self._inner_opt._get_state(p)

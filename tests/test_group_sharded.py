@@ -1,0 +1,161 @@
+"""ZeRO stages 1/2/3 (group-sharded) + memory accounting (VERDICT r1
+item 5, C20).
+
+The "memory actually drops" criterion uses exact per-device resident
+bytes (device.memory.state_bytes_per_device) rather than allocator
+telemetry, so it holds on the CPU test mesh too.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.device import memory
+
+
+@pytest.fixture(autouse=True)
+def _reset_groups():
+    dist.destroy_process_group()
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    set_hybrid_communicate_group(None)
+    yield
+    dist.destroy_process_group()
+    set_hybrid_communicate_group(None)
+
+
+def _build(level, seed=11):
+    pt.seed(seed)
+    model = pt.nn.Sequential(
+        pt.nn.Linear(64, 256), pt.nn.ReLU(), pt.nn.Linear(256, 64))
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    model, opt = dist.sharding.group_sharded_parallel(model, opt, level)
+    return model, opt
+
+
+def _step(model, opt, seed=0):
+    rng = np.random.default_rng(seed)
+    x = pt.to_tensor(rng.standard_normal((16, 64)).astype(np.float32))
+    inner = getattr(model, "_layers", model)
+    loss = pt.ops.mean(inner(x) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+class TestGroupShardedLevels:
+    @pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+    def test_trains_finite(self, level):
+        model, opt = _build(level)
+        l1 = _step(model, opt)
+        l2 = _step(model, opt)
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert l2 < l1
+
+    def test_levels_agree_numerically(self):
+        results = {}
+        for level in ["os", "os_g", "p_g_os"]:
+            model, opt = _build(level)
+            _step(model, opt, seed=3)
+            inner = getattr(model, "_layers", model)
+            results[level] = {
+                n: np.asarray(
+                    p._data.astype("float32").numpy()
+                    if hasattr(p._data, "numpy") else p.numpy())
+                for n, p in inner.named_parameters()}
+        base = results["os"]
+        for level in ["os_g", "p_g_os"]:
+            for k in base:
+                got = results[level][k]
+                # sharded matmuls change fp reduction order; Adam's
+                # g/sqrt(g^2) amplifies that near zero — 1e-4 still
+                # catches any semantic error (wrong n-factor etc.)
+                np.testing.assert_allclose(got, base[k], rtol=1e-3,
+                                           atol=1e-4,
+                                           err_msg=f"{level}:{k}")
+
+    def test_bad_level_raises(self):
+        model, opt = None, None
+        with pytest.raises(ValueError):
+            dist.sharding.group_sharded_parallel(model, opt, "zz")
+
+    def test_offload_unsupported(self):
+        with pytest.raises(NotImplementedError):
+            dist.sharding.group_sharded_parallel(None, None, "p_g_os",
+                                                 offload=True)
+
+
+class TestZeroMemoryProof:
+    def test_stage3_per_device_state_drops(self):
+        # Replicated baseline: every device stores params + 2 moments.
+        pt.seed(5)
+        m0 = pt.nn.Linear(256, 256)
+        o0 = pt.optimizer.AdamW(learning_rate=1e-3,
+                                parameters=m0.parameters())
+        rng = np.random.default_rng(0)
+        x = pt.to_tensor(rng.standard_normal((8, 256)).astype(np.float32))
+        loss = pt.ops.mean(m0(x) ** 2)
+        loss.backward()
+        o0.step()
+        state0 = list(m0.parameters()) + [
+            v for st in o0._accumulators.values() for v in st.values()
+            if getattr(v, "ndim", 0) > 0]
+        base = memory.state_bytes_per_device(state0)
+
+        # Stage 3 on the 8-way sharding mesh.
+        m3, o3 = _build("p_g_os")
+        _step(m3, o3)
+        inner = getattr(m3, "_layers", m3)
+        opt = o3._inner_opt
+        state3 = list(inner.parameters()) + [
+            v for st in opt._accumulators.values() for v in st.values()
+            if getattr(v, "ndim", 0) > 0]
+        sharded = memory.state_bytes_per_device(state3)
+
+        # per-parameter-byte comparison: bytes per device per model byte
+        def density(per_dev, params_bytes):
+            return max(per_dev.values()) / params_bytes
+
+        b0 = sum(p._data.size * p._data.dtype.itemsize
+                 for p in m0.parameters())
+        b3 = sum(p._data.size * p._data.dtype.itemsize
+                 for p in inner.parameters())
+        d0 = density(base, b0)
+        d3 = density(sharded, b3)
+        # 8-way sharding: expect ~1/8 of the replicated density; require
+        # at least the VERDICT's 0.5x criterion with margin
+        assert d3 < 0.5 * d0, (d0, d3)
+        assert d3 < 0.2 * d0, (d0, d3)  # actual arithmetic ~0.125
+
+    def test_stage2_grads_sharded_stage1_not(self):
+        m1, o1 = _build("os")
+        m2, o2 = _build("os_g")
+        for model, opt, expect_sharded in ((m1, o1, False),
+                                           (m2, o2, True)):
+            rng = np.random.default_rng(0)
+            x = pt.to_tensor(rng.standard_normal((16, 64))
+                             .astype(np.float32))
+            inner = getattr(model, "_layers", model)
+            loss = pt.ops.mean(inner(x) ** 2)
+            loss.backward()
+            opt.step()  # stage>=2 commits grads before the update
+            g = next(p for p in inner.parameters()
+                     if p.grad is not None and p.ndim > 1).grad
+            spec = getattr(g._data.sharding, "spec", None)
+            if expect_sharded:
+                assert "sharding" in str(spec), spec
+            else:
+                assert "sharding" not in str(spec), spec
+            opt.clear_grad()
+
+    def test_memory_stats_api_shape(self):
+        # PJRT may not populate stats on every backend; the API must
+        # still return well-typed values
+        assert isinstance(memory.memory_stats(), dict)
+        assert isinstance(memory.memory_allocated(), int)
+        assert isinstance(memory.max_memory_allocated(), int)
+        memory.reset_max_memory_allocated()
+        assert isinstance(memory.max_memory_allocated(), int)
+        from paddle_tpu.device import cuda
+        assert isinstance(cuda.memory_stats(), dict)
