@@ -23,7 +23,10 @@ class Generator:
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
             self._offset = 0
-            self._root = jax.random.PRNGKey(int(seed))
+            # lazy: PRNGKey initializes the XLA backend, and module
+            # import must stay backend-free so jax.distributed can
+            # bootstrap first in multi-process jobs
+            self._root = None
         return self
 
     def seed(self):
@@ -34,14 +37,19 @@ class Generator:
         with self._lock:
             off = self._offset
             self._offset += 1
-        return jax.random.fold_in(self._root, off)
+            if self._root is None:
+                self._root = jax.random.PRNGKey(self._seed)
+            root = self._root  # bind under the lock: a concurrent
+            # manual_seed/set_state may null the attribute
+        return jax.random.fold_in(root, off)
 
     def get_state(self):
         return (self._seed, self._offset)
 
     def set_state(self, state):
-        self._seed, self._offset = state
-        self._root = jax.random.PRNGKey(int(self._seed))
+        with self._lock:
+            self._seed, self._offset = state
+            self._root = None
         return self
 
 

@@ -28,10 +28,18 @@ _peak_baseline: Dict[int, int] = {}
 
 
 def _device(device=None):
+    """Accept the paddle-parity device forms: None, int ordinal,
+    'xpu:N' strings, Place objects (jax_device()), or a jax Device."""
     if device is None:
         return jax.devices()[0]
     if isinstance(device, int):
         return jax.devices()[device]
+    if isinstance(device, str):
+        idx = device.rsplit(":", 1)[-1]
+        return jax.devices()[int(idx) if idx.isdigit() else 0]
+    jd = getattr(device, "jax_device", None)
+    if callable(jd):
+        return jd()
     return device
 
 

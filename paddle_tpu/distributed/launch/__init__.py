@@ -1,0 +1,16 @@
+"""paddle_tpu.distributed.launch — multi-process / multi-host launcher.
+
+Reference: python/paddle/distributed/launch/main.py:20 (arg surface) and
+launch/controllers/collective.py:270 (per-rank process spawn, env
+injection, watch loop with failure propagation).
+
+TPU rendering: one process per HOST (the jax multi-controller model —
+each process owns its host's chips and all processes run the same SPMD
+program), bootstrapped by `jax.distributed.initialize` against the
+coordinator instead of the reference's TCPStore + NCCL comm init. For
+hardware-free testing, `--backend cpu --devices-per-proc N` gives every
+process N virtual CPU devices (2 procs x 4 devices == an 8-chip pod in
+miniature) — collectives run over Gloo exactly like a DCN-connected
+multi-host job.
+"""
+from .main import launch, main  # noqa: F401

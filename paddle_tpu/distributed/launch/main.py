@@ -1,0 +1,138 @@
+"""Launcher process controller (ref launch/main.py:20,
+controllers/collective.py:270)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a multi-process paddle_tpu job "
+                    "(ref: paddle.distributed.launch)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts in the job")
+    p.add_argument("--node_rank", type=int, default=0,
+                   help="this host's index (0-based)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes to spawn on this host (1 per host is "
+                        "the TPU norm: each process owns the host's chips)")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator ip:port (default: local free port, "
+                        "single-node only)")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="per-rank stdout/stderr capture directory")
+    p.add_argument("--backend", type=str, default=None,
+                   choices=[None, "tpu", "cpu"],
+                   help="cpu = hardware-free mode with virtual devices")
+    p.add_argument("--devices-per-proc", dest="devices_per_proc",
+                   type=int, default=None,
+                   help="(cpu backend) virtual device count per process")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _child_env(args, global_rank: int, local_rank: int,
+               world: int, master: str) -> dict:
+    env = dict(os.environ)
+    if args.backend == "cpu":
+        # scrub anything steering jax toward a warm TPU backend
+        # (mirrors __graft_entry__.dryrun_multichip)
+        for k in list(env):
+            if k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU", "PJRT_",
+                             "AXON", "PALLAS_")):
+                del env[k]
+        env["JAX_PLATFORMS"] = "cpu"
+        n = args.devices_per_proc or 1
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p and "axon" not in p])
+    env.update({
+        "PADDLE_MASTER": master,
+        "PADDLE_TRAINER_ID": str(global_rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(args.nnodes),
+        "FLAGS_selected_devices": str(local_rank),
+    })
+    return env
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    world = args.nnodes * args.nproc_per_node
+    master = args.master
+    if master is None:
+        if args.nnodes > 1:
+            print("--master ip:port is required for multi-node jobs",
+                  file=sys.stderr)
+            return 2
+        master = f"127.0.0.1:{_free_port()}"
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs: List[subprocess.Popen] = []
+    logs = []
+    for local_rank in range(args.nproc_per_node):
+        global_rank = args.node_rank * args.nproc_per_node + local_rank
+        env = _child_env(args, global_rank, local_rank, world, master)
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        if args.log_dir:
+            f = open(os.path.join(args.log_dir,
+                                  f"workerlog.{global_rank}"), "w")
+            logs.append(f)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=f,
+                                          stderr=subprocess.STDOUT))
+        else:
+            procs.append(subprocess.Popen(cmd, env=env))
+
+    # watch loop (ref collective.py watch): first failure kills the rest
+    rc = 0
+    try:
+        while procs:
+            alive = []
+            for p in procs:
+                r = p.poll()
+                if r is None:
+                    alive.append(p)
+                elif r != 0:
+                    rc = r
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    alive = [q for q in procs if q.poll() is None]
+                    for q in alive:
+                        try:
+                            q.wait(timeout=30)
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                    procs = []
+                    break
+            else:
+                procs = alive
+                if procs:
+                    time.sleep(0.2)
+    finally:
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main() -> int:
+    return launch()

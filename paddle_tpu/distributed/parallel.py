@@ -22,19 +22,56 @@ from .communication import (
 )
 
 
+_multiprocess_initialized = False
+
+
+def _maybe_init_jax_distributed() -> bool:
+    """Multi-process bootstrap (ref parallel.py:943: TCPStore +
+    init_parallel_env; here jax.distributed against the coordinator).
+
+    Reads the launcher's env (PADDLE_MASTER / PADDLE_TRAINERS_NUM /
+    PADDLE_TRAINER_ID, set by paddle_tpu.distributed.launch). After
+    this, jax.devices() is the GLOBAL device list across every host and
+    collectives ride ICI within a host / DCN (Gloo on CPU) across
+    hosts. Idempotent; no-op for single-process jobs."""
+    global _multiprocess_initialized
+    if _multiprocess_initialized:
+        return True
+    import os
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master = os.environ.get("PADDLE_MASTER")
+    if world <= 1 or not master:
+        return False
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    jax.distributed.initialize(coordinator_address=master,
+                               num_processes=world, process_id=rank)
+    _multiprocess_initialized = True
+    return True
+
+
+def _env_world() -> int:
+    import os
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def _env_rank() -> int:
+    import os
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
 class ParallelEnv:
     """ref: parallel.py ParallelEnv"""
 
     def __init__(self):
-        init_default_group()
+        init_parallel_env()
 
     @property
     def rank(self):
-        return 0
+        return get_rank()
 
     @property
     def world_size(self):
-        return len(jax.devices())
+        return get_world_size()
 
     @property
     def device_id(self):
@@ -49,17 +86,31 @@ class ParallelEnv:
 
 
 def init_parallel_env() -> Group:
-    """ref: parallel.py:943 — returns the world group."""
+    """ref: parallel.py:943 — bootstraps the (possibly multi-process)
+    runtime and returns the world group."""
+    _maybe_init_jax_distributed()
     return init_default_group()
 
 
 def get_rank(group=None) -> int:
+    """Trainer rank. Multi-process: the launcher-assigned process id
+    (read from env — NEVER from jax.process_index(), which would
+    initialize the backend before jax.distributed can bootstrap).
+    Single-controller: 0 (the one process drives every device)."""
+    if _env_world() > 1:
+        return _env_rank()
     return 0
 
 
 def get_world_size(group=None) -> int:
+    """Trainer world size, consistent with get_rank's units:
+    multi-process jobs count PROCESSES (launcher env, no backend
+    touch); the single-controller rendering counts devices (every
+    device is a rank of the collective surface)."""
     if group is not None:
         return group.nranks
+    if _env_world() > 1:
+        return _env_world()
     return len(jax.devices())
 
 

@@ -1,0 +1,53 @@
+"""Payload for the launch-CLI multi-process test (run by
+test_launch.py through `python -m paddle_tpu.distributed.launch`, the
+reference's test_dist_base.py:1217 subprocess pattern).
+
+Each process: bootstrap via init_parallel_env (jax.distributed), build
+a GLOBAL 8-device mesh spanning both processes, run one dp-sharded
+train step with globally-sharded data, and print the loss — the
+launcher's parent test asserts both ranks print the same finite value.
+"""
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    assert n_global == 8 and n_local == 4, (n_global, n_local)
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.models import gpt_tiny, GPTForCausalLM, \
+        GPTPretrainingCriterion
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer import AdamW
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    pt.seed(0)
+    cfg = gpt_tiny(hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+    crit = GPTPretrainingCriterion()
+    step = TrainStep(model, opt, lambda m, i, l: crit(m(i), l),
+                     mesh=mesh, shard_data=P("dp", None))
+
+    rng = np.random.default_rng(0)  # same on every process (SPMD)
+    ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    loss = step(ids, labels)
+    val = float(np.asarray(jax.device_get(loss._data)))
+    assert np.isfinite(val)
+    print(f"LAUNCH_OK rank={rank} world={n_global // n_local} "
+          f"loss={val:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

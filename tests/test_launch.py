@@ -1,0 +1,85 @@
+"""Launch CLI + multi-process bootstrap tests (VERDICT r1 item 6).
+
+The real-process test spawns `python -m paddle_tpu.distributed.launch
+--backend cpu --nproc_per_node 2 --devices-per-proc 4` — two OS
+processes, each with 4 virtual CPU devices, forming one 8-device
+jax.distributed job (the reference's test_dist_base subprocess
+pattern)."""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAYLOAD = os.path.join(REPO, "tests", "launch_payload.py")
+
+
+def _scrubbed_env():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU",
+                                "PJRT_", "AXON", "PALLAS_"))}
+    # the LAUNCHER process itself must not grab a TPU backend (libtpu is
+    # installed even when the axon plugin env is scrubbed)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p)
+    return env
+
+
+class TestLaunchCLI:
+    def test_two_process_train_step(self, tmp_path):
+        log_dir = str(tmp_path / "logs")
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--backend", "cpu", "--nproc_per_node", "2",
+             "--devices-per-proc", "4", "--log_dir", log_dir, PAYLOAD],
+            env=_scrubbed_env(), cwd=REPO, timeout=600,
+            capture_output=True, text=True)
+        logs = ""
+        for rank in (0, 1):
+            with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
+                logs += f.read()
+        assert proc.returncode == 0, (proc.stdout, proc.stderr, logs)
+        losses = re.findall(r"LAUNCH_OK rank=(\d) world=2 "
+                            r"loss=([0-9.]+)", logs)
+        assert sorted(r for r, _ in losses) == ["0", "1"], logs
+        # SPMD: both processes computed the same global loss
+        assert losses[0][1] == losses[1][1], logs
+
+    def test_failure_propagates(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import sys; sys.exit(3)\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--backend", "cpu", "--nproc_per_node", "2",
+             "--devices-per-proc", "2", str(bad)],
+            env=_scrubbed_env(), cwd=REPO, timeout=120,
+            capture_output=True, text=True)
+        assert proc.returncode == 3
+
+    def test_multinode_requires_master(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", PAYLOAD],
+            env=_scrubbed_env(), cwd=REPO, timeout=60,
+            capture_output=True, text=True)
+        assert proc.returncode == 2
+        assert "--master" in proc.stderr
+
+
+class TestBootstrapEnv:
+    def test_single_process_noop(self):
+        import paddle_tpu.distributed as dist
+        g = dist.init_parallel_env()
+        assert g is not None
+        assert dist.get_rank() == 0
+
+    def test_env_parsing_guard(self, monkeypatch):
+        from paddle_tpu.distributed import parallel
+        monkeypatch.delenv("PADDLE_MASTER", raising=False)
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+        assert parallel._maybe_init_jax_distributed() is False
