@@ -46,6 +46,7 @@ from . import vision  # noqa: F401
 from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
+from . import inference  # noqa: F401
 from . import utils  # noqa: F401
 from .framework_io import save, load  # noqa: F401
 from .hapi.model_api import Model, summary  # noqa: F401

@@ -46,16 +46,22 @@ def _parse(argv):
     return p.parse_args(argv)
 
 
+# env prefixes that steer jax toward an already-warm backend; one list
+# shared by the launcher, the driver gate and tests
+BACKEND_ENV_PREFIXES = ("JAX_", "XLA_", "TPU_", "LIBTPU", "PJRT_",
+                        "AXON", "PALLAS_")
+
+
+def scrub_backend_env(env: dict) -> dict:
+    return {k: v for k, v in env.items()
+            if not k.startswith(BACKEND_ENV_PREFIXES)}
+
+
 def _child_env(args, global_rank: int, local_rank: int,
                world: int, master: str) -> dict:
     env = dict(os.environ)
     if args.backend == "cpu":
-        # scrub anything steering jax toward a warm TPU backend
-        # (mirrors __graft_entry__.dryrun_multichip)
-        for k in list(env):
-            if k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU", "PJRT_",
-                             "AXON", "PALLAS_")):
-                del env[k]
+        env = scrub_backend_env(env)
         env["JAX_PLATFORMS"] = "cpu"
         n = args.devices_per_proc or 1
         env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
@@ -102,8 +108,19 @@ def launch(argv: Optional[List[str]] = None) -> int:
         else:
             procs.append(subprocess.Popen(cmd, env=env))
 
-    # watch loop (ref collective.py watch): first failure kills the rest
+    # watch loop (ref collective.py watch): first failure kills the
+    # rest; launcher death (SIGTERM/SIGINT, e.g. a CI timeout) must
+    # not orphan trainers or leak the coordinator port
     rc = 0
+
+    def _reap(signum, frame):
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        raise SystemExit(128 + signum)
+
+    old_term = signal.signal(signal.SIGTERM, _reap)
+    old_int = signal.signal(signal.SIGINT, _reap)
     try:
         while procs:
             alive = []
@@ -113,22 +130,27 @@ def launch(argv: Optional[List[str]] = None) -> int:
                     alive.append(p)
                 elif r != 0:
                     rc = r
-                    for q in procs:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
-                    alive = [q for q in procs if q.poll() is None]
-                    for q in alive:
-                        try:
-                            q.wait(timeout=30)
-                        except subprocess.TimeoutExpired:
-                            q.kill()
-                    procs = []
+                    procs = [q for q in procs if q.poll() is None]
                     break
             else:
                 procs = alive
                 if procs:
                     time.sleep(0.2)
+                continue
+            break
     finally:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        deadline = time.time() + 30
+        for q in procs:
+            if q.poll() is None:
+                try:
+                    q.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    q.kill()
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
         for f in logs:
             f.close()
     return rc

@@ -106,7 +106,13 @@ def get_world_size(group=None) -> int:
     """Trainer world size, consistent with get_rank's units:
     multi-process jobs count PROCESSES (launcher env, no backend
     touch); the single-controller rendering counts devices (every
-    device is a rank of the collective surface)."""
+    device is a rank of the collective surface).
+
+    NOTE: `get_world_size(group)` returns group.nranks, which counts
+    DEVICE ranks — in a multi-process job the world group spans all
+    devices of all processes, so it is larger than the no-group
+    (trainer) world size. Use the no-group form for data sharding and
+    the group form for collective shapes."""
     if group is not None:
         return group.nranks
     if _env_world() > 1:

@@ -344,27 +344,133 @@ class TrainStep:
         return self.model
 
 
+def _export_specs(input_spec):
+    """InputSpec list -> jax.ShapeDtypeStructs. None/negative dims
+    become symbolic so the exported program serves any size there. All
+    symbols are created in ONE jax.export scope (mixing scopes is an
+    export error) and each (input, dim) gets its own symbol — two
+    dynamic inputs are not silently constrained to equal sizes."""
+    import jax.export as jex
+
+    shapes = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shapes.append((s.shape, s.dtype))
+        elif isinstance(s, Tensor):
+            shapes.append((tuple(s.shape), s._data.dtype))
+        else:
+            shapes.append((tuple(s.shape), s.dtype))
+    names = [f"s{i}_{j}" for i, (shape, _) in enumerate(shapes)
+             for j, d in enumerate(shape)
+             if d is None or (isinstance(d, int) and d < 0)]
+    symbols = iter(jex.symbolic_shape(", ".join(names))) if names \
+        else iter(())
+    specs = []
+    for shape, dtype in shapes:
+        dims = [next(symbols)
+                if d is None or (isinstance(d, int) and d < 0) else d
+                for d in shape]
+        specs.append(jax.ShapeDtypeStruct(tuple(dims), jnp.dtype(dtype)))
+    return specs
+
+
 def save(layer, path, input_spec=None, **config):
-    """jit.save (ref: jit/api.py save): persists params + input spec.
-    Program serialization (StableHLO export) lands with the inference
-    engine milestone."""
+    """jit.save (ref: jit/api.py:755): serializes the PROGRAM as
+    portable StableHLO (jax.export, cpu+tpu platforms) next to the
+    params — the analog of the reference's inference program + params
+    pair consumed by its analysis_predictor
+    (paddle/fluid/inference/api/analysis_predictor.h). jit.load /
+    paddle_tpu.inference reconstitute a callable with no Python model
+    class. Without input_spec only params are saved (state-dict style).
+    """
     import os
     import pickle
     import numpy as np
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if isinstance(layer, StaticFunction):
         layer = layer._layer
     state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
-    meta = {"input_spec": [(s.shape, str(s.dtype)) if isinstance(
-        s, InputSpec) else s for s in (input_spec or [])]}
+
+    meta = {"format": "paddle_tpu.stablehlo.v1",
+            "input_spec": [(getattr(s, "shape", None),
+                            str(getattr(s, "dtype", "float32")))
+                           for s in (input_spec or [])],
+            "stablehlo": None, "param_names": None}
+    if input_spec:
+        import jax.export as jex
+        from ..autograd import tape as _tape
+
+        _, ptensors, _, btensors = _collect_params(layer)
+        consts = [np.asarray(t._data) for t in ptensors + btensors]
+        was_training = layer.training
+        layer.eval()
+        try:
+            def fwd(consts, *inputs):
+                with _functional_params(ptensors + btensors, consts):
+                    with _tape.no_grad():
+                        out = layer(*[Tensor._wrap(jnp.asarray(x))
+                                      for x in inputs])
+                return jax.tree_util.tree_map(
+                    lambda t: t._data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+            specs = _export_specs(input_spec)
+            const_specs = [jax.ShapeDtypeStruct(c.shape, c.dtype)
+                           for c in consts]
+            exp = jex.export(jax.jit(fwd), platforms=("cpu", "tpu"))(
+                const_specs, *specs)
+            meta["stablehlo"] = exp.serialize()
+            meta["n_consts"] = len(consts)
+            with open(path + ".pdconsts", "wb") as f:
+                pickle.dump(consts, f, protocol=4)
+        finally:
+            if was_training:
+                layer.train()
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(meta, f, protocol=4)
 
 
+class TranslatedLayer(Layer):
+    """jit.load result (ref: translated_layer.py TranslatedLayer): a
+    callable rebuilt from the serialized StableHLO program + params —
+    no Python model class required. Inference-only: parameters are
+    constants of the program (stop_gradient)."""
+
+    def __init__(self, exported, consts, state):
+        super().__init__()
+        self._exported = exported
+        self._consts = [jnp.asarray(c) for c in consts]
+        self._state = state
+
+    def forward(self, *inputs):
+        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        out = self._exported.call(self._consts, *arrs)
+        return jax.tree_util.tree_map(Tensor._wrap, out)
+
+    def state_dict(self, *a, **kw):
+        return {k: Tensor(v) for k, v in self._state.items()}
+
+
 def load(path, **config):
+    """jit.load (ref: jit/api.py:1081). Returns a TranslatedLayer when
+    the artifact carries a serialized program, else the raw state
+    dict."""
     import pickle
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
-    return state
+    try:
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+    except FileNotFoundError:
+        return state
+    if not isinstance(meta, dict) or not meta.get("stablehlo"):
+        return state
+    import jax.export as jex
+    exported = jex.deserialize(meta["stablehlo"])
+    with open(path + ".pdconsts", "rb") as f:
+        consts = pickle.load(f)
+    return TranslatedLayer(exported, consts, state)

@@ -1,0 +1,144 @@
+"""jit.save -> StableHLO export -> jit.load / Predictor (VERDICT r1
+item 7). Round-trip criterion: identical logits without the Python
+model class."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit import InputSpec, TranslatedLayer
+
+
+def _model():
+    pt.seed(3)
+    return pt.nn.Sequential(
+        pt.nn.Linear(8, 32), pt.nn.GELU(), pt.nn.Linear(32, 4))
+
+
+class TestExportRoundTrip:
+    def test_save_load_identical_logits(self, tmp_path):
+        m = _model()
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path, input_spec=[InputSpec([None, 8], "float32")])
+        x = np.random.default_rng(0).standard_normal((5, 8)) \
+            .astype(np.float32)
+        ref = m(pt.to_tensor(x)).numpy()
+
+        loaded = pt.jit.load(path)
+        assert isinstance(loaded, TranslatedLayer)
+        out = loaded(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+    def test_symbolic_batch_serves_any_size(self, tmp_path):
+        m = _model()
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path, input_spec=[InputSpec([None, 8], "float32")])
+        loaded = pt.jit.load(path)
+        for bs in (1, 3, 17):
+            x = np.ones((bs, 8), np.float32)
+            assert loaded(pt.to_tensor(x)).shape == [bs, 4]
+
+    def test_gpt_logits_roundtrip(self, tmp_path):
+        from paddle_tpu.models import gpt_tiny, GPTForCausalLM
+        pt.seed(1)
+        m = GPTForCausalLM(gpt_tiny(hidden_dropout_prob=0.0,
+                                    attention_dropout_prob=0.0))
+        m.eval()
+        path = str(tmp_path / "gpt")
+        pt.jit.save(m, path, input_spec=[InputSpec([1, 16], "int32")])
+        ids = np.random.default_rng(0).integers(0, 1000, (1, 16)) \
+            .astype(np.int32)
+        ref = m(pt.to_tensor(ids)).numpy()
+        loaded = pt.jit.load(path)
+        out = loaded(pt.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_params_only_save_without_spec(self, tmp_path):
+        m = _model()
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path)
+        state = pt.jit.load(path)
+        assert isinstance(state, dict)
+        assert any(k.endswith("weight") for k in state)
+
+    def test_state_dict_exposed(self, tmp_path):
+        m = _model()
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path, input_spec=[InputSpec([2, 8], "float32")])
+        loaded = pt.jit.load(path)
+        sd = loaded.state_dict()
+        assert set(sd) == set(k for k, _ in m.named_parameters())
+
+
+class TestPredictor:
+    def test_handle_api(self, tmp_path):
+        from paddle_tpu import inference
+        m = _model()
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path, input_spec=[InputSpec([None, 8], "float32")])
+        cfg = inference.Config(path + ".pdmodel", path + ".pdiparams")
+        pred = inference.create_predictor(cfg)
+        names = pred.get_input_names()
+        assert names == ["x0"]
+        x = np.random.default_rng(1).standard_normal((4, 8)) \
+            .astype(np.float32)
+        pred.get_input_handle("x0").copy_from_cpu(x)
+        pred.run()
+        out_names = pred.get_output_names()
+        out = pred.get_output_handle(out_names[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, m(pt.to_tensor(x)).numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_direct_run(self, tmp_path):
+        from paddle_tpu import inference
+        m = _model()
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path, input_spec=[InputSpec([2, 8], "float32")])
+        pred = inference.create_predictor(inference.Config(path))
+        x = np.ones((2, 8), np.float32)
+        (out,) = pred.run([x])
+        np.testing.assert_allclose(out, m(pt.to_tensor(x)).numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_missing_program_raises(self, tmp_path):
+        from paddle_tpu import inference
+        m = _model()
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path)  # params only
+        with pytest.raises(ValueError, match="no serialized program"):
+            inference.create_predictor(inference.Config(path))
+
+
+class TestSymbolicDims:
+    def test_multiple_dynamic_dims_one_scope(self, tmp_path):
+        # regression: two dynamic dims used to land in different
+        # symbolic scopes and fail to export
+        pt.seed(4)
+        m = pt.nn.Sequential(pt.nn.Linear(8, 8))
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path,
+                    input_spec=[InputSpec([None, None, 8], "float32")])
+        loaded = pt.jit.load(path)
+        for shp in ((2, 3, 8), (5, 7, 8)):
+            x = np.ones(shp, np.float32)
+            assert loaded(pt.to_tensor(x)).shape == list(shp)
+
+    def test_two_dynamic_inputs_independent_sizes(self, tmp_path):
+        pt.seed(4)
+
+        class Cat(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = pt.nn.Linear(8, 2)
+
+            def forward(self, a, b):
+                return self.lin(pt.ops.concat([a, b], axis=0))
+
+        m = Cat()
+        path = str(tmp_path / "m")
+        pt.jit.save(m, path,
+                    input_spec=[InputSpec([None, 8], "float32"),
+                                InputSpec([None, 8], "float32")])
+        loaded = pt.jit.load(path)
+        out = loaded(pt.to_tensor(np.ones((2, 8), np.float32)),
+                     pt.to_tensor(np.ones((5, 8), np.float32)))
+        assert out.shape == [7, 2]

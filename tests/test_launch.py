@@ -18,9 +18,8 @@ PAYLOAD = os.path.join(REPO, "tests", "launch_payload.py")
 
 
 def _scrubbed_env():
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU",
-                                "PJRT_", "AXON", "PALLAS_"))}
+    from paddle_tpu.distributed.launch.main import scrub_backend_env
+    env = scrub_backend_env(dict(os.environ))
     # the LAUNCHER process itself must not grab a TPU backend (libtpu is
     # installed even when the axon plugin env is scrubbed)
     env["JAX_PLATFORMS"] = "cpu"
