@@ -64,10 +64,10 @@ def __getattr__(name):
         mod = importlib.import_module(".sparse", __name__)
         globals()["sparse"] = mod
         return mod
-    if name == "fft":
+    if name in ("fft", "signal", "quantization"):
         import importlib
-        mod = importlib.import_module(".fft", __name__)
-        globals()["fft"] = mod
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 

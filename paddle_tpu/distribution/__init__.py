@@ -263,3 +263,647 @@ def kl_divergence(p, q):
         return _wrap(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+# ===================== wider zoo (ref files named per class) ==============
+class ExponentialFamily(Distribution):
+    """ref: exponential_family.py — natural-parameter base; entropy via
+    the Bregman identity (log-normalizer grads) where subclasses opt in.
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Laplace(Distribution):
+    """ref: laplace.py"""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * jnp.square(self.scale),
+                                      self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(math.sqrt(2.0) * self.scale,
+                                      self.batch_shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               tuple(shape) + self.batch_shape,
+                               minval=-0.5 + 1e-7, maxval=0.5 - 1e-7)
+        return _wrap(self.loc - self.scale * jnp.sign(u)
+                     * jnp.log1p(-2 * jnp.abs(u)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(-jnp.log(2 * self.scale)
+                     - jnp.abs(v - self.loc) / self.scale)
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                      self.batch_shape))
+
+    def cdf(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, q):
+        q = _arr(q)
+        t = q - 0.5
+        return _wrap(self.loc - self.scale * jnp.sign(t)
+                     * jnp.log1p(-2 * jnp.abs(t)))
+
+
+class Cauchy(Distribution):
+    """ref: cauchy.py"""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               tuple(shape) + self.batch_shape,
+                               minval=1e-7, maxval=1 - 1e-7)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale)
+                     - jnp.log1p(jnp.square(z)))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.log(4 * math.pi * self.scale), self.batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Geometric(Distribution):
+    """ref: geometric.py — #failures-before-first-success support
+    {0, 1, ...} (paddle counts trials from 0)."""
+
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is None:
+            self.probs_arr = jax.nn.sigmoid(_arr(logits))
+        else:
+            self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs_arr) / self.probs_arr)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs_arr) / jnp.square(self.probs_arr))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               tuple(shape) + self.batch_shape,
+                               minval=1e-7, maxval=1 - 1e-7)
+        return _wrap(jnp.floor(jnp.log(u)
+                               / jnp.log1p(-self.probs_arr)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jnp.log1p(-self.probs_arr)
+                     + jnp.log(self.probs_arr))
+
+    def entropy(self):
+        p = self.probs_arr
+        return _wrap(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+    def cdf(self, value):
+        v = _arr(value)
+        return _wrap(1 - jnp.power(1 - self.probs_arr,
+                                   jnp.floor(v) + 1))
+
+
+class Gumbel(Distribution):
+    """ref: gumbel.py"""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    _EULER = 0.57721566490153286060
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc + self._EULER * self.scale,
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * jnp.square(self.scale),
+            self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(_arr(self.variance)))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               tuple(shape) + self.batch_shape,
+                               minval=1e-7, maxval=1 - 1e-7)
+        return _wrap(self.loc - self.scale * jnp.log(-jnp.log(u)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.log(self.scale) + 1 + self._EULER, self.batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(jnp.exp(-jnp.exp(-z)))
+
+
+class LogNormal(Distribution):
+    """ref: lognormal.py (TransformedDistribution(Normal, Exp) there;
+    closed forms here)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return _wrap(jnp.expm1(s2) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        z = jax.random.normal(next_key(),
+                              tuple(shape) + self.batch_shape)
+        return _wrap(jnp.exp(self.loc + self.scale * z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logv = jnp.log(v)
+        return _wrap(-jnp.square((logv - self.loc) / self.scale) / 2
+                     - jnp.log(self.scale) - logv
+                     - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+            + self.loc, self.batch_shape))
+
+
+class Independent(Distribution):
+    """ref: independent.py — reinterprets batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape
+        super().__init__(shape[:len(shape) - self._rank],
+                         shape[len(shape) - self._rank:]
+                         + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        return _wrap(lp.sum(axis=tuple(range(lp.ndim - self._rank,
+                                             lp.ndim)))
+                     if self._rank else lp)
+
+    def entropy(self):
+        e = _arr(self.base.entropy())
+        return _wrap(e.sum(axis=tuple(range(e.ndim - self._rank,
+                                            e.ndim)))
+                     if self._rank else e)
+
+
+# ===================== transforms (ref: transform.py) =====================
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    """ref: transform.py Transform"""
+    _type = Type.INJECTION
+
+    def forward(self, x):
+        return _wrap(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _wrap(-self._fldj(self._inverse(_arr(y))))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # right-inverse (positive branch), ref behavior
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self.base._fldj(x)
+        return ld.sum(axis=tuple(range(ld.ndim - self._rank, ld.ndim)))
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _fldj(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead)
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _apply(self, x, method):
+        parts = [getattr(t, method)(xi) for t, xi in zip(
+            self.transforms,
+            jnp.moveaxis(x, self.axis, 0))]
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._apply(x, "_forward")
+
+    def _inverse(self, y):
+        return self._apply(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._apply(x, "_fldj")
+
+
+class StickBreakingTransform(Transform):
+    """simplex parameterization (ref transform.py StickBreaking)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,),
+                                            z.dtype)], -1)
+        cum = jnp.concatenate([jnp.ones(z.shape[:-1] + (1,), z.dtype),
+                               jnp.cumprod(1 - z, -1)], -1)
+        return zpad * cum
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rem
+        k = y.shape[-1] - 1
+        offset = k - jnp.arange(k)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(
+            offset.astype(y.dtype))
+
+    def _fldj(self, x):
+        # log|det J| = sum_k [ x_off_k - softplus(x_off_k)
+        #                      + log y_k ]  with x_off = x - log(offset)
+        k = x.shape[-1]
+        offset = (k + 1 - jnp.arange(1, k + 1)).astype(x.dtype)
+        x_off = x - jnp.log(offset)
+        y = self._forward(x)
+        return jnp.sum(-x_off + jax.nn.log_sigmoid(x_off)
+                       + jnp.log(y[..., :-1]), -1)
+
+
+class TransformedDistribution(Distribution):
+    """ref: transformed_distribution.py"""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = _arr(self.base.sample(shape))
+        for t in self.transforms:
+            x = t._forward(x)
+        return _wrap(x)
+
+    def rsample(self, shape=()):
+        x = _arr(self.base.rsample(shape))
+        for t in self.transforms:
+            x = t._forward(x)
+        return _wrap(x)
+
+    def log_prob(self, value):
+        y = _arr(value)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            lp = lp - t._fldj(x)
+            y = x
+        return _wrap(lp + _arr(self.base.log_prob(y)))
+
+
+# ===================== KL registry (ref: kl.py) ===========================
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """ref: kl.py register_kl — decorator registering a pairwise rule."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):  # noqa: F811 — supersedes the 2-pair version
+    """ref: kl.py kl_divergence — most-derived registered rule wins."""
+    best, best_fn = None, None
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            score = (len(type(p).__mro__) - len(pc.__mro__)) + \
+                (len(type(q).__mro__) - len(qc.__mro__))
+            if best is None or score < best:
+                best, best_fn = score, fn
+    if best_fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return best_fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return _wrap(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    # support must nest, else KL is +inf
+    inside = jnp.logical_and(q.low <= p.low, p.high <= q.high)
+    val = jnp.log((q.high - q.low) / (p.high - p.low))
+    return _wrap(jnp.where(inside, val, jnp.inf))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp, qq = p.probs_arr, q.probs_arr
+    t1 = pp * (jnp.log(jnp.maximum(pp, 1e-30))
+               - jnp.log(jnp.maximum(qq, 1e-30)))
+    t2 = (1 - pp) * (jnp.log(jnp.maximum(1 - pp, 1e-30))
+                     - jnp.log(jnp.maximum(1 - qq, 1e-30)))
+    return _wrap(t1 + t2)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    r = p.scale / q.scale
+    t = jnp.abs(p.loc - q.loc) / q.scale
+    return _wrap(-jnp.log(r) + r * jnp.exp(-jnp.abs(p.loc - q.loc)
+                                           / p.scale) + t - 1)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    pp, qq = p.probs_arr, q.probs_arr
+    return _wrap((jnp.log(pp) - jnp.log(qq)
+                  + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qq))))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from jax.scipy.special import gammaln, digamma
+    a1, b1 = p.concentration, p.rate
+    a2, b2 = q.concentration, q.rate
+    return _wrap((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+                 + a2 * (jnp.log(b1) - jnp.log(b2)) + a1 * (b2 / b1 - 1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import gammaln, digamma
+    a1, b1 = p.alpha, p.beta
+    a2, b2 = q.alpha, q.beta
+    s1, s2 = a1 + b1, a2 + b2
+    return _wrap(gammaln(s1) - gammaln(a1) - gammaln(b1)
+                 - gammaln(s2) + gammaln(a2) + gammaln(b2)
+                 + (a1 - a2) * (digamma(a1) - digamma(s1))
+                 + (b1 - b2) * (digamma(b1) - digamma(s1)))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    from jax.scipy.special import gammaln, digamma
+    a, b = p.concentration, q.concentration
+    sa = a.sum(-1, keepdims=True)
+    t = ((a - b) * (digamma(a) - digamma(sa))).sum(-1)
+    return _wrap(gammaln(a.sum(-1)) - gammaln(b.sum(-1))
+                 + (gammaln(b) - gammaln(a)).sum(-1) + t)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal(p, q)  # KL is invariant to the shared Exp bijection
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    # closed form: log(b2/b1) + g*(b1/b2 - 1)
+    #   + exp((u2-u1)/b2 + lgamma(1 + b1/b2)) - 1 + (u1-u2)/b2
+    g = Gumbel._EULER
+    r = p.scale / q.scale
+    d = (p.loc - q.loc) / q.scale
+    return _wrap(jnp.log(q.scale / p.scale) + g * (r - 1)
+                 + jnp.exp(-d + jax.scipy.special.gammaln(1 + r))
+                 - 1 + d)

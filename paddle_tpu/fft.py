@@ -1,0 +1,155 @@
+"""paddle_tpu.fft — discrete Fourier transform family.
+
+Reference: python/paddle/fft.py (fft:163 ... ifftshift:1418; numpy
+conventions, norm in {backward, ortho, forward}) lowering to
+phi/kernels/funcs/cufft_util.h on GPU.
+
+TPU rendering: jnp.fft lowers to XLA's FFT HLO (TPU has a native FFT
+lowering); autograd comes from jax's fft JVP rules through the op
+registry. hfft2/hfftn/ihfft2/ihfftn (absent from numpy/jnp) are built
+from the Hermitian identities hfft(x) = irfft(conj(x)) with the norm
+direction swapped, matching torch/paddle semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.registry import register_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def _swap_norm(norm):
+    """forward<->backward (used by the Hermitian composites: an inverse
+    transform with swapped norm IS the unnormalized forward)."""
+    return {"backward": "forward", "forward": "backward",
+            "ortho": "ortho"}[norm]
+
+
+@register_op("fft_fft")
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+@register_op("fft_ifft")
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+@register_op("fft_rfft")
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+@register_op("fft_irfft")
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+@register_op("fft_hfft")
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+@register_op("fft_ihfft")
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+@register_op("fft_fft2")
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.fft2(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+@register_op("fft_ifft2")
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.ifft2(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+@register_op("fft_rfft2")
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.rfft2(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+@register_op("fft_irfft2")
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.irfft2(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+@register_op("fft_hfft2")
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.irfftn(jnp.conj(jnp.asarray(x)), s=s, axes=axes,
+                          norm=_swap_norm(_check_norm(norm)))
+
+
+@register_op("fft_ihfft2")
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes,
+                                  norm=_swap_norm(_check_norm(norm))))
+
+
+@register_op("fft_fftn")
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+@register_op("fft_ifftn")
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+@register_op("fft_rfftn")
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+@register_op("fft_irfftn")
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+@register_op("fft_hfftn")
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.irfftn(jnp.conj(jnp.asarray(x)), s=s, axes=axes,
+                          norm=_swap_norm(_check_norm(norm)))
+
+
+@register_op("fft_ihfftn")
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes,
+                                  norm=_swap_norm(_check_norm(norm))))
+
+
+@register_op("fft_fftfreq")
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("fft_rfftfreq")
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@register_op("fft_fftshift")
+def fftshift(x, axes=None, name=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@register_op("fft_ifftshift")
+def ifftshift(x, axes=None, name=None):
+    return jnp.fft.ifftshift(x, axes=axes)

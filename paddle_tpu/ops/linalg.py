@@ -191,8 +191,9 @@ def qr(x, mode="reduced"):
 
 @register_op("svd")
 def svd(x, full_matrices=False):
-    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
-    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+    # paddle returns (U, S, VH) with X = U diag(S) VH
+    # (ref tensor/linalg.py:2002 "VH is the conjugate transpose of V")
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
 
 
 @register_op("svdvals")

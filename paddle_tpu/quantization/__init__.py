@@ -1,0 +1,259 @@
+"""paddle_tpu.quantization — QAT / PTQ.
+
+Reference: python/paddle/quantization/ (config.py QuantConfig,
+qat.py QAT.quantize -> wrapper.py QuantedLayer with activation+weight
+quanters, quanters/abs_max.py FakeQuanterWithAbsMaxObserver with a
+moving-average abs-max scale, ptq.py PTQ with observers).
+
+TPU rendering: fake-quant is a jnp round/clip with a straight-through
+estimator (custom_vjp identity-through-clip) — inside jit XLA fuses it
+into the surrounding matmul's epilogue, so QAT costs one multiply-add
+per tensor. int8 deployment itself rides XLA's native int8 dot support
+when `convert`ed weights are fed as int8 + scale.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant(x, scale, bit_length):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+def _fake_quant_fwd(x, scale, bit_length):
+    return _fake_quant(x, scale, bit_length), (x, scale)
+
+
+def _fake_quant_bwd(bit_length, res, g):
+    # straight-through estimator: pass-through inside the clip range
+    x, scale = res
+    inside = (jnp.abs(x) <= jnp.maximum(scale, 1e-9)).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)
+
+
+_fake_quant.defvjp(_fake_quant_fwd, _fake_quant_bwd)
+
+from ..ops.registry import register_op  # noqa: E402
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_quant_op(x, scale, bit_length=8):
+    """Tape-recorded fake quant (ref: the fake_quantize_dequantize op
+    family) — dispatching through the registry is what lets gradients
+    flow THROUGH the quantizer (STE) instead of stopping at it."""
+    return _fake_quant(x, scale, bit_length)
+
+
+def quantize_linear(x, scale, zero_point=0, bit_length=8, axis=None):
+    """Functional quantize (ref ops quantize_linear)."""
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    qmax = 2 ** (bit_length - 1) - 1
+    s = jnp.maximum(jnp.asarray(scale), 1e-9)
+    q = jnp.clip(jnp.round(data / s * qmax) + zero_point, -qmax - 1, qmax)
+    return Tensor._wrap(q.astype(jnp.int8 if bit_length <= 8
+                                 else jnp.int32))
+
+
+def dequantize_linear(x, scale, zero_point=0, bit_length=8, axis=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    qmax = 2 ** (bit_length - 1) - 1
+    return Tensor._wrap((data.astype(jnp.float32) - zero_point)
+                        * jnp.asarray(scale) / qmax)
+
+
+class BaseQuanter(Layer):
+    """ref: base_quanter.py"""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """ref: quanters/abs_max.py:129 — moving-average abs-max scale +
+    fake quant with STE."""
+
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        from ..nn.initializer import Constant
+        self.scale = self.create_parameter(
+            [1], default_initializer=Constant(1e-3), is_bias=False)
+        self.scale.stop_gradient = True
+        self._accum = 1.0
+
+    def forward(self, x):
+        t = x if isinstance(x, Tensor) else Tensor(x)
+        import jax.core
+        if self.training and not isinstance(t._data, jax.core.Tracer):
+            # observer calibration is an EAGER side effect; under a
+            # trace the update would leak tracers into persistent state
+            data = t._data
+            cur = jnp.max(jnp.abs(data)).reshape(1)
+            r = self._moving_rate
+            state = r * self.scale._data * self._accum + (1 - r) * cur
+            self._accum = r * self._accum + 1 - r
+            self.scale._data = state / self._accum
+        return _fake_quant_op(t, self.scale.detach()[0],
+                              bit_length=self._bit_length)
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class FakeQuanterWithAbsMaxObserver:
+    """ref: quanters/abs_max.py:26 — factory passed to QuantConfig."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        self._kwargs = dict(moving_rate=moving_rate,
+                            bit_length=bit_length, dtype=dtype)
+
+    def instance(self, layer=None):
+        return FakeQuanterWithAbsMaxObserverLayer(layer, **self._kwargs)
+
+
+class SingleLayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """ref: config.py QuantConfig"""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = SingleLayerConfig(activation, weight)
+        self._layer_cfg: Dict[int, SingleLayerConfig] = {}
+        self._type_cfg: Dict[Type, SingleLayerConfig] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_cfg[t] = SingleLayerConfig(activation, weight)
+
+    def config_for(self, layer) -> Optional[SingleLayerConfig]:
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._global.activation or self._global.weight:
+            from ..nn.layers.common import Linear
+            from ..nn.layers.conv import Conv2D
+            if isinstance(layer, (Linear, Conv2D)):
+                return self._global
+        return None
+
+
+class QuantedLayer(Layer):
+    """ref: wrapper.py — wraps a layer with activation/weight fake
+    quanters; forward quantizes inputs and weight, calls the float
+    kernel (XLA fuses the dequant into the dot)."""
+
+    def __init__(self, layer, cfg: SingleLayerConfig):
+        super().__init__()
+        self._inner = layer
+        self.activation_quanter = (cfg.activation.instance(layer)
+                                   if cfg.activation else None)
+        self.weight_quanter = (cfg.weight.instance(layer)
+                               if cfg.weight else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self._inner,
+                                                       "weight"):
+            # substitute the quanted TENSOR (a tape node) as the
+            # layer's weight for this call: backward flows through the
+            # quanter's STE to the leaf weight — swapping only the
+            # buffer would detach the quantizer from autograd
+            w = self._inner.weight
+            qw = self.weight_quanter(w)
+            params = self._inner._parameters
+            params["weight"], orig = qw, params["weight"]
+            try:
+                out = self._inner(x)
+            finally:
+                params["weight"] = orig
+            return out
+        return self._inner(x)
+
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        raise NotImplementedError
+
+    def convert(self, model: Layer, inplace=False):
+        """Strip quanters; bake weight fake-quant into the weights
+        (ref qat.py convert -> ONNX-style QDQ; here: final simulated
+        values)."""
+        for name, sub in list(model.named_sublayers()):
+            if isinstance(sub, QuantedLayer):
+                inner = sub._inner
+                if sub.weight_quanter is not None and \
+                        hasattr(inner, "weight"):
+                    inner.weight._data = sub.weight_quanter(
+                        inner.weight)._data
+                _set_sublayer(model, name, inner)
+        return model
+
+
+class QAT(Quantization):
+    """ref: qat.py QAT"""
+
+    def quantize(self, model: Layer, inplace=False):
+        for name, sub in list(model.named_sublayers()):
+            if isinstance(sub, QuantedLayer):
+                continue
+            cfg = self._config.config_for(sub)
+            if cfg is not None and (cfg.activation or cfg.weight):
+                _set_sublayer(model, name, QuantedLayer(sub, cfg))
+        return model
+
+
+class PTQ(Quantization):
+    """ref: ptq.py — observer-based post-training quantization; the
+    same wrapper in eval mode collects abs-max scales over calibration
+    batches."""
+
+    def quantize(self, model: Layer, inplace=False):
+        qat = QAT(self._config)
+        model = qat.quantize(model, inplace=inplace)
+        model.train()  # observers update during calibration
+        return model
+
+
+def _set_sublayer(root: Layer, dotted: str, new: Layer):
+    parts = dotted.split(".")
+    obj = root
+    for p in parts[:-1]:
+        obj = obj._sub_layers[p] if p in getattr(obj, "_sub_layers", {}) \
+            else getattr(obj, p)
+    obj.add_sublayer(parts[-1], new)

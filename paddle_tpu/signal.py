@@ -1,0 +1,181 @@
+"""paddle_tpu.signal — short-time Fourier analysis.
+
+Reference: python/paddle/signal.py (frame:27, overlap_add:134,
+stft:231, istft:384; frame/overlap_add lower to phi kernels, stft/istft
+are python composites over them + fft).
+
+TPU rendering: frame is a gather of static window indices (one XLA
+gather, MXU-free), overlap_add a segment-sum via scatter-add —
+both shapes static under jit. stft/istft compose them with the fft
+module exactly like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .ops.registry import register_op
+from . import fft as _fft
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_jnp(x, frame_length, hop_length, axis=-1):
+    x = jnp.asarray(x)
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+    n_time = x.shape[axis]
+    if frame_length > n_time:
+        raise ValueError(
+            f"frame_length {frame_length} > signal length {n_time}")
+    n_frames = 1 + (n_time - frame_length) // hop_length
+    starts = np.arange(n_frames) * hop_length
+    idx = starts[:, None] + np.arange(frame_length)[None, :]  # [n, fl]
+    if axis == -1:
+        return jnp.take(x, jnp.asarray(idx.T), axis=-1)  # [..., fl, n]
+    return jnp.take(x, jnp.asarray(idx), axis=0)         # [n, fl, ...]
+
+
+def _overlap_add_jnp(x, hop_length, axis=-1):
+    x = jnp.asarray(x)
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+    if axis == -1:
+        frame_length, n_frames = x.shape[-2], x.shape[-1]
+    else:
+        n_frames, frame_length = x.shape[0], x.shape[1]
+    out_len = (n_frames - 1) * hop_length + frame_length
+    starts = np.arange(n_frames) * hop_length
+    if axis == -1:
+        idx = (starts[None, :] + np.arange(frame_length)[:, None])
+        out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+        # ONE scatter-add over the full [fl, n] index matrix (duplicate
+        # indices accumulate) — not n_frames chained updates
+        return out.at[..., jnp.asarray(idx)].add(x)
+    idx = (starts[:, None] + np.arange(frame_length)[None, :])
+    out = jnp.zeros((out_len,) + x.shape[2:], x.dtype)
+    return out.at[jnp.asarray(idx)].add(x)
+
+
+@register_op("signal_frame")
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames; frame axis is added next to the
+    time axis (ref signal.py:27: axis=-1 -> [..., frame_length, n],
+    axis=0 -> [n, frame_length, ...])."""
+    return _frame_jnp(x, frame_length, hop_length, axis)
+
+
+@register_op("signal_overlap_add")
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (ref signal.py:134): frames at stride
+    hop_length scatter-add into the output signal."""
+    return _overlap_add_jnp(x, hop_length, axis)
+
+
+def _window_arr(window, win_length, dtype):
+    if window is None:
+        return jnp.ones((win_length,), dtype)
+    w = window._data if hasattr(window, "_data") else jnp.asarray(window)
+    if w.shape != (win_length,):
+        raise ValueError(
+            f"window must have shape ({win_length},), got {w.shape}")
+    return w.astype(dtype)
+
+
+@register_op("signal_stft")
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """ref signal.py:231. x: [batch?, seq]; returns
+    [batch?, n_fft//2+1 (or n_fft), n_frames] complex. Registered as
+    one composite op so autograd flows through it (jax.vjp over the
+    whole jnp composite)."""
+    data = jnp.asarray(x)
+    if data.ndim not in (1, 2):
+        raise ValueError("stft expects a 1D or 2D input")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if not (0 < win_length <= n_fft):
+        raise ValueError("0 < win_length <= n_fft required")
+    is_complex = jnp.iscomplexobj(data)
+    if onesided and is_complex:
+        raise ValueError("onesided is not supported for complex input")
+    real_dtype = jnp.zeros((), data.dtype).real.dtype
+    w = _window_arr(window, win_length, real_dtype)
+    if win_length < n_fft:  # center-pad the window (ref behavior)
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+    if center:
+        pad = n_fft // 2
+        cfg = [(0, 0)] * (data.ndim - 1) + [(pad, pad)]
+        data = jnp.pad(data, cfg, mode=pad_mode)
+    frames = _frame_jnp(data, n_fft, hop_length, axis=-1)
+    frames = frames * w[:, None]
+    frames = jnp.swapaxes(frames, -1, -2)  # [..., n, n_fft]
+    if onesided:
+        spec = jnp.fft.rfft(frames, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)  # [..., n_freq, n_frames]
+
+
+@register_op("signal_istft")
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """ref signal.py:384 — inverse STFT with COLA window
+    normalization. Registered composite (differentiable, see stft)."""
+    spec = jnp.asarray(x)
+    if spec.ndim not in (2, 3):
+        raise ValueError("istft expects [.., n_freq, n_frames]")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    n_freq = spec.shape[-2]
+    if onesided and n_freq != n_fft // 2 + 1:
+        raise ValueError(f"expected {n_fft // 2 + 1} freq bins, "
+                         f"got {n_freq}")
+    if not onesided and n_freq != n_fft:
+        raise ValueError(f"expected {n_fft} freq bins, got {n_freq}")
+    spec = jnp.swapaxes(spec, -1, -2)  # [..., n_frames, n_freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, n=n_fft, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    real_dtype = jnp.zeros((), frames.dtype).real.dtype
+    w = _window_arr(window, win_length, real_dtype)
+    if win_length < n_fft:
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+    frames = frames * w  # analysis-window product
+    sig = jnp.swapaxes(frames, -1, -2)       # [..., n_fft, n_frames]
+    y = _overlap_add_jnp(sig, hop_length, axis=-1)
+    # COLA denominator: overlap-added squared window
+    n_frames = frames.shape[-2]
+    wsq = jnp.broadcast_to((w * w)[:, None], (n_fft, n_frames))
+    denom = _overlap_add_jnp(wsq, hop_length, axis=-1)
+    y = y / jnp.where(denom > 1e-11, denom, 1.0)
+    if center:
+        pad = n_fft // 2
+        # with an explicit length, only the left pad is trimmed and the
+        # right edge extends into the final frames (torch/paddle
+        # semantics); without it both pads are dropped
+        if length is not None:
+            y = y[..., pad:]
+        else:
+            y = y[..., pad:y.shape[-1] - pad]
+    if length is not None:
+        if y.shape[-1] < length:  # zero-pad to the requested length
+            cfg = [(0, 0)] * (y.ndim - 1) + [(0, length - y.shape[-1])]
+            y = jnp.pad(y, cfg)
+        y = y[..., :length]
+    return y

