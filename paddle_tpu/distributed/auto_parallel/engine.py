@@ -1,0 +1,200 @@
+"""Auto-parallel Engine facade.
+
+Reference: python/paddle/distributed/auto_parallel/static/engine.py
+(Engine:59 — fit:909, evaluate:1081, predict:1209, prepare, save/load;
+built on the static Program + planner/cost-model pipeline).
+
+TPU rendering: the planner/cost-model stage is GSPMD — the Engine
+binds (model, loss, optimizer, strategy) to a DistModel (one fused XLA
+train-step executable over the committed shardings) and runs the
+epoch/loop orchestration around it. No Program IR exists; save/load
+delegate to the framework checkpoint (see README "unsupported
+surface" for the static Program stack)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None,
+                 metrics=None, cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else ([metrics] if metrics is not None else [])
+        self._strategy = strategy
+        self._dist_model = None
+        self._mode = None
+        self.history: dict = {"loss": []}
+
+    # ---- ref engine.py prepare: mode-specific program build ----
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        from .api import DistModel
+        self._mode = mode
+        opt = self._optimizer if mode == "train" else None
+        self._dist_model = DistModel(self._model, loss=self._loss,
+                                     optimizer=opt,
+                                     strategy=self._strategy)
+        getattr(self._dist_model, "train" if mode == "train"
+                else "eval")()
+        return self
+
+    def _ensure(self, mode):
+        if self._dist_model is None:
+            self.prepare(mode=mode)
+            return
+        if self._mode == mode:
+            return
+        self._sync_trained_state()
+        if mode == "train" and self._optimizer is not None \
+                and self._dist_model._optimizer is None:
+            # the current DistModel was built for eval (no optimizer
+            # bound) — rebuild, else fit would silently run the
+            # no-grad path and never update parameters
+            self.prepare(mode=mode)
+            return
+        self._mode = mode
+        getattr(self._dist_model, "train" if mode == "train"
+                else "eval")()
+
+    def _sync_trained_state(self):
+        """TrainStep owns the live (donated) parameter buffers; write
+        them back into the model before any path that reads the model's
+        own tensors (eval/predict/save)."""
+        step = getattr(self._dist_model, "_step", None)
+        if step is not None:
+            step.sync()
+
+    @staticmethod
+    def _batches(data, batch_size):
+        """Accept a DataLoader-like iterable or an (inputs, labels)
+        array pair (ref engine.py accepts Dataset/DataLoader)."""
+        if hasattr(data, "__iter__") and not isinstance(data, tuple):
+            yield from data
+            return
+        xs, ys = data
+        n = len(xs)
+        for i in range(0, n, batch_size):
+            yield xs[i:i + batch_size], ys[i:i + batch_size]
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
+            valid_sample_split=None, valid_freq=1, valid_steps=None,
+            collate_fn=None, callbacks=None, verbose=2, nvprof_range=None):
+        """ref engine.py:909"""
+        self._ensure("train")
+        for epoch in range(epochs):
+            losses = []
+            for step, batch in enumerate(
+                    self._batches(train_data, batch_size)):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                loss = self._dist_model(*batch)
+                losses.append(float(np.asarray(
+                    loss.numpy() if hasattr(loss, "numpy") else loss)))
+                if verbose and log_freq and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step} "
+                          f"loss {losses[-1]:.6f}", flush=True)
+            self.history["loss"].append(losses)
+            if valid_data is not None and (epoch + 1) % valid_freq == 0:
+                self.evaluate(valid_data, batch_size=batch_size,
+                              steps=valid_steps, verbose=verbose)
+            self._mode = "train"  # evaluate() flipped the mode
+            getattr(self._dist_model, "train")()
+        # leave the model's own tensors valid for direct reads after fit
+        self._sync_trained_state()
+        return self.history
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, collate_fn=None, callbacks=None,
+                 verbose=2):
+        """ref engine.py:1081 — mean loss (+ metrics) over the data."""
+        self._ensure("eval")
+        self._sync_trained_state()
+        self._dist_model.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(self._batches(valid_data,
+                                                   batch_size)):
+            if steps is not None and step >= steps:
+                break
+            *inputs, label = [b if isinstance(b, Tensor) else Tensor(b)
+                              for b in batch]
+            out = self._dist_model.network(*inputs)
+            if self._loss is not None:
+                losses.append(float(self._loss(out, label).numpy()))
+            for m in self._metrics:
+                m.update(*[np.asarray(t.numpy()) for t in
+                           (m.compute(out, label)
+                            if hasattr(m, "compute") else (out, label))])
+        result = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            result[m.name() if callable(getattr(m, "name", None))
+                   else type(m).__name__] = m.accumulate()
+        if verbose:
+            print(f"eval {result}", flush=True)
+        return result
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, collate_fn=None, callbacks=None, verbose=2):
+        """ref engine.py:1209 — forward passes, outputs gathered."""
+        self._ensure("predict")
+        self._sync_trained_state()
+        self._dist_model.eval()
+        outs = []
+        for step, batch in enumerate(self._batches(test_data,
+                                                   batch_size)):
+            if steps is not None and step >= steps:
+                break
+            if not isinstance(batch, (tuple, list)):
+                batch = (batch,)
+            if len(batch) > 1:   # (inputs, labels) pairs: drop labels
+                batch = batch[:-1]
+            inputs = [b if isinstance(b, Tensor) else Tensor(b)
+                      for b in batch]
+            out = self._dist_model.network(*inputs)
+            outs.append(np.asarray(out.numpy() if hasattr(out, "numpy")
+                                   else out))
+        return outs
+
+    def save(self, path, training=True):
+        """ref engine.py save — delegates to distributed checkpoint."""
+        if self._dist_model is not None:
+            self._sync_trained_state()
+        from .. import checkpoint
+        state = dict(self._model.state_dict())
+        if training and self._optimizer is not None:
+            for k, v in self._optimizer.state_dict().items():
+                if hasattr(v, "shape"):
+                    state[f"opt.{k}"] = v
+        checkpoint.save_state_dict(state, path)
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from .. import checkpoint
+        state = dict(self._model.state_dict())
+        if load_optimizer and self._optimizer is not None:
+            for k, v in self._optimizer.state_dict().items():
+                if hasattr(v, "shape"):
+                    state[f"opt.{k}"] = v
+        checkpoint.load_state_dict(state, path)
+        self._model.set_state_dict(
+            {k: v for k, v in state.items() if not k.startswith("opt.")})
+        if load_optimizer and self._optimizer is not None:
+            opt_state = {k[len("opt."):]: v for k, v in state.items()
+                         if k.startswith("opt.")}
+            if opt_state:
+                self._optimizer.set_state_dict(opt_state)
+        return self
+
+    @property
+    def main_program(self):
+        raise NotImplementedError(
+            "Engine.main_program: no static Program IR exists in the "
+            "TPU runtime — the executable is an XLA computation; "
+            "see README 'unsupported surface'")

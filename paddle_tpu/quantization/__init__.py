@@ -94,7 +94,9 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
         self.scale = self.create_parameter(
             [1], default_initializer=Constant(1e-3), is_bias=False)
         self.scale.stop_gradient = True
-        self._accum = 1.0
+        self._accum = 0.0   # bias-corrected moving average: the first
+        # observation sets scale = cur exactly (accum 1.0 would pin
+        # early scales to the 1e-3 init and starve the STE)
 
     def forward(self, x):
         t = x if isinstance(x, Tensor) else Tensor(x)

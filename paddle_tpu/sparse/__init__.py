@@ -261,10 +261,8 @@ def _ewise(x, y, fn):
     bx = _as_bcoo(sx)
     by = _as_bcoo(sy)
     out = fn(bx.todense(), by.todense())
-    res = _dense_to_coo(out)
-    if isinstance(x, SparseCsrTensor):
-        return _dense_to_csr(out)
-    return res
+    return _dense_to_csr(out) if isinstance(x, SparseCsrTensor) \
+        else _dense_to_coo(out)
 
 
 def add(x, y, name=None):

@@ -1,0 +1,133 @@
+"""Auto-parallel Engine facade + to_static limitation detection
+(VERDICT r1 items 6/8/10)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+RNG = np.random.default_rng(0)
+
+
+def _data(n=32, din=8, dout=4):
+    xs = RNG.standard_normal((n, din)).astype(np.float32)
+    w = RNG.standard_normal((din, dout)).astype(np.float32)
+    ys = xs @ w + 0.01 * RNG.standard_normal((n, dout)).astype(np.float32)
+    return xs, ys
+
+
+class TestEngine:
+    def _engine(self):
+        pt.seed(0)
+        model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                                 pt.nn.Linear(16, 4))
+        opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+        loss = lambda out, y: pt.ops.mean((out - y) ** 2)  # noqa: E731
+        return Engine(model=model, loss=loss, optimizer=opt), model
+
+    def test_fit_reduces_loss(self):
+        eng, _ = self._engine()
+        hist = eng.fit(_data(), batch_size=8, epochs=8, verbose=0)
+        first = np.mean(hist["loss"][0])
+        last = np.mean(hist["loss"][-1])
+        assert last < first * 0.7, (first, last)
+
+    def test_evaluate_returns_loss(self):
+        eng, _ = self._engine()
+        eng.fit(_data(), batch_size=8, epochs=2, verbose=0)
+        res = eng.evaluate(_data(n=16), batch_size=8, verbose=0)
+        assert np.isfinite(res["loss"])
+
+    def test_predict_shapes(self):
+        eng, _ = self._engine()
+        xs, _ = _data(n=10)
+        outs = eng.predict((xs, np.zeros((10, 4), np.float32)),
+                           batch_size=4, verbose=0)
+        total = sum(o.shape[0] for o in outs)
+        assert total == 10
+        assert all(o.shape[1] == 4 for o in outs)
+
+    def test_fit_with_validation(self):
+        eng, _ = self._engine()
+        hist = eng.fit(_data(), valid_data=_data(n=16), batch_size=8,
+                       epochs=2, verbose=0)
+        assert len(hist["loss"]) == 2
+
+    def test_save_load_roundtrip(self, tmp_path):
+        eng, model = self._engine()
+        eng.fit(_data(), batch_size=8, epochs=1, verbose=0)
+        w0 = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+        eng.save(str(tmp_path / "ckpt"))
+        # perturb then load back
+        for p in model.parameters():
+            p._data = p._data + 1.0
+        eng.load(str(tmp_path / "ckpt"))
+        for k, v in model.state_dict().items():
+            np.testing.assert_allclose(v.numpy(), w0[k], rtol=1e-6)
+
+    def test_main_program_unsupported(self):
+        eng, _ = self._engine()
+        with pytest.raises(NotImplementedError, match="Program IR"):
+            eng.main_program
+
+
+class TestToStaticLimitationDetection:
+    def test_data_dependent_branch_reports(self):
+        @pt.jit.to_static
+        def f(x):
+            if x.sum() > 0:   # data-dependent python branch
+                return x * 2
+            return x - 1
+
+        with pytest.raises(RuntimeError,
+                           match="data-dependent Python control flow"):
+            f(pt.to_tensor(np.ones(4, np.float32)))
+
+    def test_value_branch_free_code_stages_fine(self):
+        @pt.jit.to_static
+        def g(x):
+            return pt.ops.where(x > 0, x * 2, x - 1)
+
+        out = g(pt.to_tensor(np.array([-1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [-2.0, 4.0])
+
+
+class TestEngineReviewRegressions:
+    def test_eval_then_fit_still_trains(self):
+        # code-review r2: evaluate-first must not permanently detach
+        # the optimizer from the train path
+        pt.seed(0)
+        model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                                 pt.nn.Linear(16, 4))
+        opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+        eng = Engine(model=model,
+                     loss=lambda o, y: pt.ops.mean((o - y) ** 2),
+                     optimizer=opt)
+        eng.evaluate(_data(n=8), batch_size=8, verbose=0)
+        hist = eng.fit(_data(), batch_size=8, epochs=8, verbose=0)
+        assert np.mean(hist["loss"][-1]) < np.mean(hist["loss"][0]) * 0.7
+
+    def test_probe_refires_after_caught_error(self):
+        @pt.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            return x - 1
+
+        for _ in range(2):  # second call must re-detect, not miscompile
+            with pytest.raises(RuntimeError,
+                               match="data-dependent"):
+                f(pt.to_tensor(np.ones(4, np.float32)))
+
+    def test_full_graph_false_keeps_eager_branching(self):
+        @pt.jit.to_static(full_graph=False)
+        def f(x):
+            if float(x.sum()) > 0:
+                return x * 2
+            return x - 1
+
+        out = f(pt.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])

@@ -371,11 +371,11 @@ class TestRegistryCoverage:
         "max_pool2d", "avg_pool2d", "mse_loss", "l1_loss", "nll_loss",
         "binary_cross_entropy", "binary_cross_entropy_with_logits",
         "softmax_with_cross_entropy", "kl_div", "smooth_l1_loss",
-        "unbind" if False else "swiglu",
+        "swiglu", "unbind",
         "fused_rms_norm", "fused_layer_norm", "fused_linear",
         "fused_rotary_position_embedding", "expand", "broadcast_to",
         "slice_op", "getitem", "setitem", "full_like", "ones_like",
-        "zeros_like", "arange" if False else "assign",
+        "zeros_like", "assign",
     }
 
     def test_coverage_accounting(self):
