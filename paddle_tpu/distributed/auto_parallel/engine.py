@@ -74,7 +74,9 @@ class Engine:
     def _batches(data, batch_size):
         """Accept a DataLoader-like iterable or an (inputs, labels)
         array pair (ref engine.py accepts Dataset/DataLoader)."""
-        if hasattr(data, "__iter__") and not isinstance(data, tuple):
+        is_pair = (isinstance(data, (tuple, list)) and len(data) == 2
+                   and all(hasattr(d, "shape") for d in data))
+        if not is_pair and hasattr(data, "__iter__"):
             yield from data
             return
         xs, ys = data
@@ -126,7 +128,9 @@ class Engine:
                 break
             *inputs, label = [b if isinstance(b, Tensor) else Tensor(b)
                               for b in batch]
-            out = self._dist_model.network(*inputs)
+            from ...autograd import no_grad
+            with no_grad():
+                out = self._dist_model.network(*inputs)
             if self._loss is not None:
                 losses.append(float(self._loss(out, label).numpy()))
             for m in self._metrics:
@@ -158,7 +162,9 @@ class Engine:
                 batch = batch[:-1]
             inputs = [b if isinstance(b, Tensor) else Tensor(b)
                       for b in batch]
-            out = self._dist_model.network(*inputs)
+            from ...autograd import no_grad
+            with no_grad():
+                out = self._dist_model.network(*inputs)
             outs.append(np.asarray(out.numpy() if hasattr(out, "numpy")
                                    else out))
         return outs
@@ -190,6 +196,11 @@ class Engine:
                          if k.startswith("opt.")}
             if opt_state:
                 self._optimizer.set_state_dict(opt_state)
+        # the live TrainStep (if any) still holds PRE-load parameter
+        # buffers; drop it so the next fit/eval rebuilds from the
+        # loaded weights instead of syncing stale state over them
+        if self._dist_model is not None:
+            self._dist_model._step = None
         return self
 
     @property

@@ -24,3 +24,5 @@ from .sequence_parallel import (  # noqa: F401
     RowSequenceParallelLinear, register_sequence_parallel_allreduce_hooks,
     mark_as_sequence_parallel_parameter,
 )
+from .ring_attention import (  # noqa: F401,E402
+    ring_flash_attention, RingAttention)

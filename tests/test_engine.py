@@ -131,3 +131,30 @@ class TestEngineReviewRegressions:
 
         out = f(pt.to_tensor(np.ones(2, np.float32)))
         np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+
+    def test_load_invalidates_live_step(self, tmp_path):
+        # code-review r2: loaded weights must not be clobbered by a
+        # stale TrainStep sync on the next evaluate/fit
+        pt.seed(0)
+        model = pt.nn.Sequential(pt.nn.Linear(8, 4))
+        opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+        eng = Engine(model=model,
+                     loss=lambda o, y: pt.ops.mean((o - y) ** 2),
+                     optimizer=opt)
+        eng.fit(_data(din=8, dout=4), batch_size=8, epochs=1, verbose=0)
+        eng.save(str(tmp_path / "c"))
+        w_saved = {k: v.numpy().copy()
+                   for k, v in model.state_dict().items()}
+        eng.fit(_data(din=8, dout=4), batch_size=8, epochs=2, verbose=0)
+        eng.load(str(tmp_path / "c"))
+        eng.evaluate(_data(n=8, din=8, dout=4), batch_size=8, verbose=0)
+        for k, v in model.state_dict().items():
+            np.testing.assert_allclose(v.numpy(), w_saved[k], rtol=1e-6,
+                                       err_msg=k)
+
+    def test_list_pair_data(self):
+        eng, _ = TestEngine()._engine()
+        xs, ys = _data(n=16)
+        hist = eng.fit([xs, ys], batch_size=8, epochs=1, verbose=0)
+        assert len(hist["loss"][0]) == 2

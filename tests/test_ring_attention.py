@@ -1,0 +1,89 @@
+"""Ring attention (sequence-parallel long context, SURVEY §5.7)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.meta_parallel import ring_flash_attention
+
+
+def _mesh(n=8, axis="sep"):
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+def _dense_ref(q, k, v, causal):
+    s = np.einsum("bqhd,bkhd->bhqk", q.astype(np.float64),
+                  k.astype(np.float64)) / np.sqrt(q.shape[-1])
+    if causal:
+        qpos = np.arange(s.shape[-2])[:, None]
+        kpos = np.arange(s.shape[-1])[None, :]
+        s = np.where(qpos >= kpos, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bkhd->bhqd", p, v.astype(np.float64))
+    return np.swapaxes(o, 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_dense(causal):
+    rng = np.random.default_rng(0)
+    b, s, h, d = 2, 64, 2, 16
+    q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    out = ring_flash_attention(q, k, v, _mesh(), causal=causal)
+    ref = _dense_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_gradients_match_dense(causal=True):
+    rng = np.random.default_rng(1)
+    b, s, h, d = 1, 32, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    mesh = _mesh()
+
+    def ring_loss(q, k, v):
+        return (ring_flash_attention(q, k, v, mesh, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    def dense_loss(q, k, v):
+        sc = 1.0 / np.sqrt(d)
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sc
+        qpos = jnp.arange(s_.shape[-2])[:, None]
+        kpos = jnp.arange(s_.shape[-1])[None, :]
+        s_ = jnp.where(qpos >= kpos, s_, -1e30)
+        p = jax.nn.softmax(s_, -1)
+        o = jnp.einsum("bhqk,bkhd->bhqd", p, v)
+        return (jnp.swapaxes(o, 1, 2) ** 2).sum()
+
+    gr = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_output_stays_sequence_sharded():
+    rng = np.random.default_rng(2)
+    q = rng.standard_normal((1, 64, 2, 8)).astype(np.float32)
+    mesh = _mesh()
+    out = ring_flash_attention(q, q, q, mesh, causal=True)
+    spec = out.sharding.spec
+    assert "sep" in str(spec), spec
+
+
+def test_tensor_api_and_uneven_raises():
+    rng = np.random.default_rng(3)
+    x = pt.to_tensor(rng.standard_normal((1, 64, 2, 8))
+                     .astype(np.float32))
+    out = ring_flash_attention(x, x, x, _mesh(), causal=True)
+    assert out.shape == [1, 64, 2, 8]
+    bad = pt.to_tensor(rng.standard_normal((1, 60, 2, 8))
+                       .astype(np.float32))
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_flash_attention(bad, bad, bad, _mesh())
