@@ -16,6 +16,7 @@ from typing import Optional
 
 import numpy as np
 
+from ...autograd import no_grad
 from ...core.tensor import Tensor
 
 
@@ -74,9 +75,11 @@ class Engine:
     def _batches(data, batch_size):
         """Accept a DataLoader-like iterable or an (inputs, labels)
         array pair (ref engine.py accepts Dataset/DataLoader)."""
-        is_pair = (isinstance(data, (tuple, list)) and len(data) == 2
-                   and all(hasattr(d, "shape") for d in data))
-        if not is_pair and hasattr(data, "__iter__"):
+        # ONLY a tuple means an (inputs, labels) array pair; lists (and
+        # any other iterable) are pre-batched DataLoader-style streams —
+        # a [a1, a2] list of batch arrays must not be misread as a pair
+        if not (isinstance(data, tuple) and len(data) == 2
+                and all(hasattr(d, "shape") for d in data)):
             yield from data
             return
         xs, ys = data
@@ -128,7 +131,6 @@ class Engine:
                 break
             *inputs, label = [b if isinstance(b, Tensor) else Tensor(b)
                               for b in batch]
-            from ...autograd import no_grad
             with no_grad():
                 out = self._dist_model.network(*inputs)
             if self._loss is not None:
@@ -162,7 +164,6 @@ class Engine:
                 batch = batch[:-1]
             inputs = [b if isinstance(b, Tensor) else Tensor(b)
                       for b in batch]
-            from ...autograd import no_grad
             with no_grad():
                 out = self._dist_model.network(*inputs)
             outs.append(np.asarray(out.numpy() if hasattr(out, "numpy")

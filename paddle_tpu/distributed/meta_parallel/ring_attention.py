@@ -87,20 +87,13 @@ def _ring_local(q, k, v, *, axis, sm_scale, causal, chunk):
     return jnp.swapaxes(out, 1, 2)                     # [b, s, h, d]
 
 
-def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = "sep",
-                         causal: bool = True, softmax_scale=None):
-    """Sequence-parallel attention over `mesh[axis]`.
+from ...ops.registry import register_op
 
-    q, k, v: [batch, seq, heads, head_dim] GLOBAL arrays (or Tensors)
-    sharded (or shardable) on the sequence dim over `axis`. Returns the
-    output with the same layout/sharding. seq must divide evenly by the
-    axis size."""
-    from ...core.tensor import Tensor
-    wrap = isinstance(q, Tensor)
-    qa = q._data if wrap else jnp.asarray(q)
-    ka = k._data if isinstance(k, Tensor) else jnp.asarray(k)
-    va = v._data if isinstance(v, Tensor) else jnp.asarray(v)
 
+def ring_attention_impl(q, k, v, mesh: Mesh = None, axis: str = "sep",
+                        causal: bool = True, softmax_scale=None):
+    """Raw-array ring attention (for jax.grad/jit callers)."""
+    qa, ka, va = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
     n = mesh.shape[axis]
     if qa.shape[1] % n:
         raise ValueError(
@@ -115,11 +108,27 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = "sep",
                           causal=causal, chunk=n),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     sharding = NamedSharding(mesh, spec)
-    qa = jax.device_put(qa, sharding)
-    ka = jax.device_put(ka, sharding)
-    va = jax.device_put(va, sharding)
-    out = fn(qa, ka, va)
-    return Tensor._wrap(out) if wrap else out
+    if not isinstance(qa, jax.core.Tracer):
+        qa = jax.device_put(qa, sharding)
+        ka = jax.device_put(ka, sharding)
+        va = jax.device_put(va, sharding)
+    return fn(qa, ka, va)
+
+
+@register_op("ring_flash_attention")
+def ring_flash_attention(q, k, v, mesh: Mesh = None, axis: str = "sep",
+                         causal: bool = True, softmax_scale=None):
+    """Sequence-parallel attention over `mesh[axis]`.
+
+    q, k, v: [batch, seq, heads, head_dim] GLOBAL Tensors/arrays
+    sharded (or shardable) on the sequence dim over `axis`. Returns the
+    output with the same layout/sharding. seq must divide evenly by the
+    axis size. Registered through the op registry so the eager tape
+    differentiates it (jax.vjp through shard_map + scan); raw-jax
+    callers use ring_attention_impl."""
+    return ring_attention_impl(q, k, v, mesh=mesh, axis=axis,
+                               causal=causal,
+                               softmax_scale=softmax_scale)
 
 
 class RingAttention:

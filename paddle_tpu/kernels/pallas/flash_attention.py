@@ -17,10 +17,10 @@ of the reference's flash_attn_bwd
 Inputs are fed to the MXU in their native dtype (bf16 in, f32 accumulate
 via preferred_element_type) — no f32 upcast before the dot.
 
-Default blocks are large (512 q x 1024 k): measured on v5e, per-grid-step
+Default blocks are large (1024 x 1024): measured on v5e, per-grid-step
 overhead dominates below ~256-wide blocks (128x128 blocks ran 3.4x slower
-than 512x1024 at [96, 1024, 64]); VMEM comfortably holds the bigger tiles
-at d <= 256.
+at [96, 1024, 64], and 1024x1024 beat 512x1024 by ~11% at [192, 1024,
+64]); VMEM comfortably holds the bigger tiles at d <= 256.
 
 Layout contract matches paddle: [batch, seq, heads, head_dim]
 (ref: python/paddle/nn/functional/flash_attention.py:146).
@@ -134,7 +134,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = jax.lax.transpose(lse_tile, (1, 0))[:_SUBL]
 
 
-def _flash_fwd_bhsd(q, k, v, sm_scale, causal, block_q=512, block_k=1024,
+def _flash_fwd_bhsd(q, k, v, sm_scale, causal, block_q=1024, block_k=1024,
                     interpret=False):
     """q,k,v: [bh, s, d] -> (out [bh, s, d], lse [bh, SUBL, s] f32).
 
@@ -290,7 +290,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_bhsd(q, k, v, o, lse, do, sm_scale, causal,
-                    block_q=512, block_k=1024, interpret=False):
+                    block_q=1024, block_k=1024, interpret=False):
     """Blockwise dq/dk/dv. q,k,v,o,do: [bh, s, d]; lse: [bh, SUBL, sq]."""
     bh, sq, d = q.shape
     sk = k.shape[1]

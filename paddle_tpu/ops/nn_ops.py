@@ -629,43 +629,62 @@ def _reduce(x, reduction):
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0):
-    if use_softmax:
-        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
-    else:
-        logp = jnp.log(jnp.maximum(input.astype(jnp.float32), 1e-30))
     if soft_label:
+        if use_softmax:
+            logp = jax.nn.log_softmax(input.astype(jnp.float32),
+                                      axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(input.astype(jnp.float32), 1e-30))
         lbl = label.astype(jnp.float32)
         if label_smoothing > 0:
             n = input.shape[axis]
             lbl = lbl * (1 - label_smoothing) + label_smoothing / n
         loss = -jnp.sum(lbl * logp, axis=axis)
-        valid = None
+        valid, w_tok = None, None
     else:
         lbl = label
-        if lbl.ndim == logp.ndim:
+        if lbl.ndim == input.ndim:
             lbl = jnp.squeeze(lbl, axis=axis)
         lbl = lbl.astype(jnp.int32)
         valid = (lbl != ignore_index)
         safe = jnp.where(valid, lbl, 0)
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(safe, axis), axis=axis)
-        picked = jnp.squeeze(picked, axis=axis)
-        if label_smoothing > 0:
-            n = input.shape[axis]
-            smooth = jnp.mean(logp, axis=axis)
-            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
-        loss = jnp.where(valid, -picked, 0.0)
+        if use_softmax:
+            # loss = logsumexp(z) - z[label]. Never materialize the full
+            # [.., vocab] f32 log-softmax (3+ GB at GPT scale) — the
+            # logsumexp fuses the f32 accumulation into one reduction
+            # pass and the backward recomputes softmax rows from bf16
+            # logits.
+            lse = jax.scipy.special.logsumexp(
+                input.astype(jnp.float32), axis=axis)
+            picked = jnp.take_along_axis(
+                input, jnp.expand_dims(safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis).astype(jnp.float32)
+            if label_smoothing > 0:
+                mean_logit = jnp.mean(input.astype(jnp.float32),
+                                      axis=axis)
+                picked = ((1 - label_smoothing) * picked
+                          + label_smoothing * mean_logit)
+            loss = jnp.where(valid, lse - picked, 0.0)
+        else:
+            logp = jnp.log(jnp.maximum(input.astype(jnp.float32), 1e-30))
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0:
+                smooth = jnp.mean(logp, axis=axis)
+                picked = ((1 - label_smoothing) * picked
+                          + label_smoothing * smooth)
+            loss = jnp.where(valid, -picked, 0.0)
+        w_tok = None
         if weight is not None:
-            w = jnp.take(weight, safe)
-            loss = loss * jnp.where(valid, w, 0.0)
+            w_tok = jnp.where(valid, jnp.take(weight, safe), 0.0)
+            loss = loss * w_tok
     if reduction == "mean":
         if valid is not None:
-            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
-            if weight is not None:
-                denom = jnp.maximum(jnp.sum(
-                    jnp.where(valid, jnp.take(weight, jnp.where(
-                        valid, label.astype(jnp.int32) if label.ndim != logp.ndim
-                        else jnp.squeeze(label, axis).astype(jnp.int32), 0)), 0.0)), 1e-12)
+            denom = (jnp.maximum(jnp.sum(w_tok), 1e-12)
+                     if w_tok is not None else
+                     jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                 1.0))
             return jnp.sum(loss) / denom
         return jnp.mean(loss)
     if reduction == "sum":

@@ -153,8 +153,13 @@ class TestEngineReviewRegressions:
             np.testing.assert_allclose(v.numpy(), w_saved[k], rtol=1e-6,
                                        err_msg=k)
 
-    def test_list_pair_data(self):
+    def test_tuple_pair_vs_list_batches(self):
         eng, _ = TestEngine()._engine()
         xs, ys = _data(n=16)
-        hist = eng.fit([xs, ys], batch_size=8, epochs=1, verbose=0)
+        hist = eng.fit((xs, ys), batch_size=8, epochs=1, verbose=0)
         assert len(hist["loss"][0]) == 2
+        # a LIST is a pre-batched stream, never a pair
+        eng2, _ = TestEngine()._engine()
+        batches = [(xs[:8], ys[:8]), (xs[8:], ys[8:])]
+        hist2 = eng2.fit(batches, epochs=1, verbose=0)
+        assert len(hist2["loss"][0]) == 2

@@ -7,6 +7,8 @@ from jax.sharding import Mesh
 
 import paddle_tpu as pt
 from paddle_tpu.distributed.meta_parallel import ring_flash_attention
+from paddle_tpu.distributed.meta_parallel.ring_attention import (
+    ring_attention_impl)
 
 
 def _mesh(n=8, axis="sep"):
@@ -48,7 +50,7 @@ def test_gradients_match_dense(causal=True):
     mesh = _mesh()
 
     def ring_loss(q, k, v):
-        return (ring_flash_attention(q, k, v, mesh, causal=True)
+        return (ring_attention_impl(q, k, v, mesh, causal=True)
                 .astype(jnp.float32) ** 2).sum()
 
     def dense_loss(q, k, v):
@@ -73,7 +75,7 @@ def test_output_stays_sequence_sharded():
     q = rng.standard_normal((1, 64, 2, 8)).astype(np.float32)
     mesh = _mesh()
     out = ring_flash_attention(q, q, q, mesh, causal=True)
-    spec = out.sharding.spec
+    spec = out._data.sharding.spec
     assert "sep" in str(spec), spec
 
 
@@ -87,3 +89,15 @@ def test_tensor_api_and_uneven_raises():
                        .astype(np.float32))
     with pytest.raises(ValueError, match="not divisible"):
         ring_flash_attention(bad, bad, bad, _mesh())
+
+
+def test_eager_tape_backward():
+    # code-review r2: eager Tensor path must record on the tape
+    rng = np.random.default_rng(4)
+    x = pt.to_tensor(rng.standard_normal((1, 32, 2, 8))
+                     .astype(np.float32), stop_gradient=False)
+    out = ring_flash_attention(x, x, x, _mesh(), causal=True)
+    assert not out.stop_gradient
+    (out ** 2).sum().backward()
+    assert x.grad is not None
+    assert np.count_nonzero(x.grad.numpy()) > 0
