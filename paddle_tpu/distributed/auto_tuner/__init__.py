@@ -1,0 +1,260 @@
+"""Auto-tuner: parallel-config search with memory-model pruning.
+
+Reference: python/paddle/distributed/auto_tuner/tuner.py:19 (AutoTuner
+with grid search over dp/mp/pp/sharding/micro-batch candidates),
+prune.py (divisibility + memory pruning rules), search.py (GridSearch).
+
+TPU rendering: candidates are hybrid-mesh degree assignments
+(dp x mp x pp x sharding == chips) plus micro-batch size; the memory
+model prices the training state (params + grads + AdamW moments +
+activations) per chip against its HBM, mirroring the reference's
+prune_by_memory estimate. Trials run through a user-supplied runner
+(e.g. a TrainStep benchmark on a CPU mesh or real slice); grid order +
+history-based pruning (a config whose smaller micro-batch already
+OOM'd is skipped) match the reference's flow.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, asdict
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class Config:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sharding_stage: int = 1
+    micro_batch_size: int = 1
+    use_recompute: bool = False
+    # filled by trials
+    time_per_step: Optional[float] = None
+    error: Optional[str] = None
+    pruned_reason: Optional[str] = None
+
+    @property
+    def world(self):
+        return (self.dp_degree * self.mp_degree * self.pp_degree
+                * self.sharding_degree)
+
+    def to_dict(self):
+        return asdict(self)
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg: Dict) -> Dict[str, List]:
+    """ref tuner.py default_candidates: 'auto' expands to divisors of
+    the world size; explicit lists pass through."""
+    world = int(tuner_cfg["world_size"])
+    out = {}
+    for key, cap in (("dp_degree", None), ("mp_degree", 8),
+                     ("pp_degree", None), ("sharding_degree", None)):
+        v = tuner_cfg.get(key, "auto")
+        if v == "auto":
+            ds = _divisors(world)
+            if cap:
+                ds = [d for d in ds if d <= cap]
+            out[key] = ds
+        else:
+            out[key] = [int(x) for x in (v if isinstance(v, list)
+                                         else [v])]
+    mbs = tuner_cfg.get("micro_batch_size", "auto")
+    if mbs == "auto":
+        gbs = int(tuner_cfg.get("global_batch_size", 8))
+        out["micro_batch_size"] = [m for m in _divisors(gbs) if m <= gbs]
+    else:
+        out["micro_batch_size"] = [int(x) for x in (
+            mbs if isinstance(mbs, list) else [mbs])]
+    out["sharding_stage"] = tuner_cfg.get("sharding_stage", [1])
+    if not isinstance(out["sharding_stage"], list):
+        out["sharding_stage"] = [out["sharding_stage"]]
+    out["use_recompute"] = tuner_cfg.get("use_recompute", [False])
+    if not isinstance(out["use_recompute"], list):
+        out["use_recompute"] = [out["use_recompute"]]
+    return out
+
+
+def estimate_memory_bytes(cfg: Config, tuner_cfg: Dict) -> float:
+    """Per-chip training-state estimate (ref prune.py memory model):
+
+    params:     2 bytes (bf16 compute copy) / (mp * pp), further / sharding
+                when stage 3
+    grads:      4 bytes / (mp * pp), / sharding when stage >= 2
+    opt states: 2 x 4 bytes + fp32 master 4 bytes, / (mp * pp),
+                / sharding at stage >= 1
+    activations: per micro-batch per layer ~ s * h * (34 + 5*a*s/h)
+                bytes (Korthikanti et al.), / mp; pipeline holds up to
+                pp in-flight micro-batches at 1F1B; recompute keeps
+                only layer boundaries."""
+    n = float(tuner_cfg["model_num_params"])
+    h = float(tuner_cfg.get("hidden_size", 1024))
+    s = float(tuner_cfg.get("seq_length", 1024))
+    layers = float(tuner_cfg.get("num_layers", 24))
+    heads = float(tuner_cfg.get("num_heads", max(1, h // 64)))
+    mp, pp, sh = cfg.mp_degree, cfg.pp_degree, cfg.sharding_degree
+    stage = cfg.sharding_stage
+
+    shard = mp * pp
+    p_bytes = 2.0 * n / shard / (sh if stage == 3 else 1)
+    g_bytes = 4.0 * n / shard / (sh if stage >= 2 else 1)
+    o_bytes = 12.0 * n / shard / (sh if stage >= 1 else 1)
+
+    b = cfg.micro_batch_size
+    per_layer = b * s * h * (34.0 + 5.0 * heads * s / h) / mp
+    if cfg.use_recompute:
+        per_layer = b * s * h * 2.0 / mp  # boundary activations only
+    # 1F1B keeps at most min(pp, num_micro_batches) micro-batches of
+    # activations in flight per stage
+    gbs = tuner_cfg.get("global_batch_size")
+    if gbs:
+        local = max(1, int(gbs) // max(1, cfg.dp_degree
+                                       * cfg.sharding_degree))
+        num_micro = max(1, local // max(1, b))
+    else:
+        num_micro = pp
+    act = per_layer * (layers / pp) * min(pp, num_micro)
+    return p_bytes + g_bytes + o_bytes + act
+
+
+# ---- prune rules (ref prune.py register_prune) ----
+_PRUNES: List[Callable] = []
+
+
+def register_prune(fn):
+    _PRUNES.append(fn)
+    return fn
+
+
+@register_prune
+def prune_by_world(tuner_cfg, cfg, history):
+    if cfg.world != int(tuner_cfg["world_size"]):
+        return "degrees do not multiply to world size"
+    return None
+
+
+@register_prune
+def prune_by_mp(tuner_cfg, cfg, history):
+    h = tuner_cfg.get("hidden_size")
+    heads = tuner_cfg.get("num_heads")
+    if h and h % cfg.mp_degree:
+        return f"hidden_size {h} % mp {cfg.mp_degree} != 0"
+    if heads and heads % cfg.mp_degree:
+        return f"num_heads {heads} % mp {cfg.mp_degree} != 0"
+    return None
+
+
+@register_prune
+def prune_by_pp(tuner_cfg, cfg, history):
+    layers = tuner_cfg.get("num_layers")
+    if layers and layers % cfg.pp_degree:
+        return f"num_layers {layers} % pp {cfg.pp_degree} != 0"
+    return None
+
+
+@register_prune
+def prune_by_mbs(tuner_cfg, cfg, history):
+    gbs = tuner_cfg.get("global_batch_size")
+    if gbs:
+        dp_like = cfg.dp_degree * cfg.sharding_degree
+        if gbs % dp_like:
+            return f"global batch {gbs} % dp*sharding {dp_like} != 0"
+        local = gbs // dp_like
+        if local % cfg.micro_batch_size:
+            return (f"local batch {local} % micro "
+                    f"{cfg.micro_batch_size} != 0")
+    return None
+
+
+@register_prune
+def prune_by_memory(tuner_cfg, cfg, history):
+    hbm = tuner_cfg.get("hbm_bytes")
+    if hbm:
+        need = estimate_memory_bytes(cfg, tuner_cfg)
+        if need > 0.92 * hbm:  # leave headroom for XLA temps
+            return (f"memory model {need / 2**30:.1f} GiB > "
+                    f"0.92 * HBM {hbm / 2**30:.1f} GiB")
+    return None
+
+
+@register_prune
+def prune_by_history(tuner_cfg, cfg, history):
+    """A config identical but for a SMALLER micro batch that already
+    OOM'd/failed prunes this one (ref prune_by_mbs_history)."""
+    for old in history:
+        if old.error and old.micro_batch_size <= cfg.micro_batch_size \
+                and (old.dp_degree, old.mp_degree, old.pp_degree,
+                     old.sharding_degree, old.sharding_stage,
+                     old.use_recompute) == \
+                    (cfg.dp_degree, cfg.mp_degree, cfg.pp_degree,
+                     cfg.sharding_degree, cfg.sharding_stage,
+                     cfg.use_recompute):
+            return (f"smaller micro batch {old.micro_batch_size} "
+                    f"already failed: {old.error}")
+    return None
+
+
+class GridSearch:
+    """ref search.py GridSearch — iterate candidates, prune, yield."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = tuner_cfg
+        cands = default_candidates(tuner_cfg)
+        keys = ["dp_degree", "mp_degree", "pp_degree", "sharding_degree",
+                "sharding_stage", "micro_batch_size", "use_recompute"]
+        self._all = [Config(**dict(zip(keys, combo)))
+                     for combo in itertools.product(
+                         *[cands[k] for k in keys])]
+        self._idx = 0
+
+    def search_once(self, history) -> Optional[Config]:
+        while self._idx < len(self._all):
+            cfg = self._all[self._idx]
+            self._idx += 1
+            for rule in _PRUNES:
+                reason = rule(self.tuner_cfg, cfg, history)
+                if reason:
+                    cfg.pruned_reason = reason
+                    break
+            else:
+                return cfg
+        return None
+
+
+class AutoTuner:
+    """ref tuner.py:19. runner(cfg) -> seconds/step (raise on OOM)."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.task_limit = int(tuner_cfg.get("task_limit", 100))
+        self.algo = GridSearch(self.tuner_cfg)
+        self.history_cfgs: List[Config] = []
+
+    def search_once(self) -> Optional[Config]:
+        if len(self.history_cfgs) >= self.task_limit:
+            return None
+        return self.algo.search_once(self.history_cfgs)
+
+    def add_cfg(self, cfg: Config):
+        self.history_cfgs.append(cfg)
+
+    def tune(self, runner: Callable[[Config], float]) -> Optional[Config]:
+        while True:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            try:
+                cfg.time_per_step = float(runner(cfg))
+            except Exception as e:  # trial failure == prune material
+                cfg.error = f"{type(e).__name__}: {e}"
+            self.add_cfg(cfg)
+        return self.best_cfg()
+
+    def best_cfg(self) -> Optional[Config]:
+        done = [c for c in self.history_cfgs
+                if c.time_per_step is not None]
+        return min(done, key=lambda c: c.time_per_step) if done else None

@@ -35,6 +35,11 @@ def _parse(argv):
                         "single-node only)")
     p.add_argument("--log_dir", type=str, default=None,
                    help="per-rank stdout/stderr capture directory")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic mode: relaunch the whole job up to N "
+                        "times after a worker failure (ref fleet/elastic"
+                        "/manager.py; collective jobs restart as a unit "
+                        "because the coordinator epoch dies with them)")
     p.add_argument("--backend", type=str, default=None,
                    choices=[None, "tpu", "cpu"],
                    help="cpu = hardware-free mode with virtual devices")
@@ -80,13 +85,40 @@ def _child_env(args, global_rank: int, local_rank: int,
 
 def launch(argv: Optional[List[str]] = None) -> int:
     args = _parse(argv if argv is not None else sys.argv[1:])
+    if args.master is None and args.nnodes > 1:
+        print("--master ip:port is required for multi-node jobs",
+              file=sys.stderr)
+        return 2
+    if args.max_restarts < 0:
+        print("--max_restarts must be >= 0", file=sys.stderr)
+        return 2
+    if args.max_restarts > 0 and args.nnodes > 1:
+        # per-node restarting cannot coordinate a collective epoch:
+        # surviving nodes hang in collectives and the fixed master
+        # port may sit in TIME_WAIT — an external elastic controller
+        # (k8s operator / GKE jobset) must restart multi-node jobs
+        print("--max_restarts only supports single-node jobs; "
+              "multi-node elastic needs an external controller",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for attempt in range(args.max_restarts + 1):
+        rc = _launch_once(args, attempt)
+        if rc == 0:
+            return 0
+        if attempt < args.max_restarts:
+            print(f"paddle_tpu.launch: job failed (rc={rc}); elastic "
+                  f"restart {attempt + 1}/{args.max_restarts}",
+                  file=sys.stderr, flush=True)
+    return rc
+
+
+def _launch_once(args, restart_count: int) -> int:
     world = args.nnodes * args.nproc_per_node
     master = args.master
     if master is None:
-        if args.nnodes > 1:
-            print("--master ip:port is required for multi-node jobs",
-                  file=sys.stderr)
-            return 2
+        # fresh coordinator port per attempt: the previous epoch's
+        # jax.distributed service may still own the old one
         master = f"127.0.0.1:{_free_port()}"
 
     if args.log_dir:
@@ -97,11 +129,15 @@ def launch(argv: Optional[List[str]] = None) -> int:
     for local_rank in range(args.nproc_per_node):
         global_rank = args.node_rank * args.nproc_per_node + local_rank
         env = _child_env(args, global_rank, local_rank, world, master)
+        env["PADDLE_RESTART_COUNT"] = str(restart_count)
         cmd = [sys.executable, args.training_script,
                *args.training_script_args]
         if args.log_dir:
+            # append across elastic restarts so earlier attempts'
+            # output survives for postmortem
+            mode = "a" if restart_count else "w"
             f = open(os.path.join(args.log_dir,
-                                  f"workerlog.{global_rank}"), "w")
+                                  f"workerlog.{global_rank}"), mode)
             logs.append(f)
             procs.append(subprocess.Popen(cmd, env=env, stdout=f,
                                           stderr=subprocess.STDOUT))

@@ -93,6 +93,10 @@ from ...ops.registry import register_op
 def ring_attention_impl(q, k, v, mesh: Mesh = None, axis: str = "sep",
                         causal: bool = True, softmax_scale=None):
     """Raw-array ring attention (for jax.grad/jit callers)."""
+    if mesh is None:
+        raise ValueError(
+            "ring attention needs a jax.sharding.Mesh with the "
+            f"sequence axis ({axis!r})")
     qa, ka, va = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
     n = mesh.shape[axis]
     if qa.shape[1] % n:

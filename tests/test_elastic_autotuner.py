@@ -1,0 +1,159 @@
+"""Elastic restart (ref fleet/elastic/manager.py) + auto-tuner
+(ref auto_tuner/tuner.py, prune.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner, Config, default_candidates, estimate_memory_bytes)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestAutoTuner:
+    CFG = {
+        "world_size": 8,
+        "global_batch_size": 16,
+        "model_num_params": 1.3e9,
+        "hidden_size": 2048,
+        "num_heads": 16,
+        "num_layers": 24,
+        "seq_length": 1024,
+        "hbm_bytes": 16 * 2**30,
+    }
+
+    def test_candidates_divide_world(self):
+        c = default_candidates(self.CFG)
+        assert all(8 % d == 0 for d in c["dp_degree"])
+        assert all(16 % m == 0 for m in c["micro_batch_size"])
+
+    def test_prune_rules(self):
+        tuner = AutoTuner(self.CFG)
+        seen = []
+        while True:
+            cfg = tuner.search_once()
+            if cfg is None:
+                break
+            seen.append(cfg)
+            tuner.add_cfg(cfg)
+        assert seen, "grid produced no valid configs"
+        for cfg in seen:
+            assert cfg.world == 8
+            assert self.CFG["hidden_size"] % cfg.mp_degree == 0
+            assert self.CFG["num_layers"] % cfg.pp_degree == 0
+            # memory model holds for every surviving config
+            assert estimate_memory_bytes(cfg, self.CFG) <= \
+                0.92 * self.CFG["hbm_bytes"]
+
+    def test_memory_model_monotone_in_sharding(self):
+        base = dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                    sharding_degree=8, micro_batch_size=1)
+        m1 = estimate_memory_bytes(Config(**base, sharding_stage=1),
+                                   self.CFG)
+        m2 = estimate_memory_bytes(Config(**base, sharding_stage=2),
+                                   self.CFG)
+        m3 = estimate_memory_bytes(Config(**base, sharding_stage=3),
+                                   self.CFG)
+        assert m3 < m2 < m1
+        # replicated 1.3B on 16G must be pruned, stage-3 8-way must fit
+        assert m1 - (m3) > 1e9
+
+    def test_replicated_large_model_pruned(self):
+        cfg = {**self.CFG, "dp_degree": [8], "mp_degree": [1],
+               "pp_degree": [1], "sharding_degree": [1],
+               "micro_batch_size": [1]}
+        tuner = AutoTuner(cfg)
+        assert tuner.search_once() is None  # 1.3B replicated > HBM
+        # grid recorded nothing runnable
+        assert tuner.best_cfg() is None
+
+    def test_tune_picks_fastest_and_prunes_history(self):
+        cfg = {**self.CFG, "global_batch_size": 128,
+               "model_num_params": 3e8, "seq_length": 256,
+               "sharding_degree": [8], "dp_degree": [1],
+               "mp_degree": [1], "pp_degree": [1],
+               "sharding_stage": [3],
+               "micro_batch_size": [1, 2, 4, 8, 16]}
+        calls = []
+
+        def runner(c):
+            calls.append(c.micro_batch_size)
+            if c.micro_batch_size >= 4:
+                raise MemoryError("oom")
+            return 1.0 / c.micro_batch_size  # bigger mbs = faster
+
+        best = AutoTuner(cfg).tune(runner)
+        assert best is not None and best.micro_batch_size == 2
+        # mbs=4 failed; 8 and 16 pruned by history without running
+        assert calls == [1, 2, 4]
+
+
+class TestElasticRestart:
+    def test_job_restarts_until_success(self, tmp_path):
+        # worker fails on the first epoch (restart_count 0), succeeds
+        # after the elastic relaunch
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            "import os, sys\n"
+            "rc = int(os.environ.get('PADDLE_RESTART_COUNT', '0'))\n"
+            "rank = os.environ.get('PADDLE_TRAINER_ID')\n"
+            "print(f'attempt={rc} rank={rank}', flush=True)\n"
+            "sys.exit(0 if rc >= 1 else 7)\n")
+        from paddle_tpu.distributed.launch.main import scrub_backend_env
+        env = scrub_backend_env(dict(os.environ))
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        log_dir = str(tmp_path / "logs")
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--max_restarts", "2",
+             "--log_dir", log_dir, str(script)],
+            env=env, cwd=REPO, timeout=300, capture_output=True,
+            text=True)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "elastic restart 1/2" in proc.stderr
+        logs = ""
+        for r in (0, 1):
+            with open(os.path.join(log_dir, f"workerlog.{r}")) as f:
+                logs += f.read()
+        assert "attempt=0" in logs and "attempt=1" in logs
+
+    def test_restarts_exhausted_propagates_rc(self, tmp_path):
+        script = tmp_path / "dead.py"
+        script.write_text("import sys; sys.exit(5)\n")
+        from paddle_tpu.distributed.launch.main import scrub_backend_env
+        env = scrub_backend_env(dict(os.environ))
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "1", "--max_restarts", "1",
+             str(script)],
+            env=env, cwd=REPO, timeout=120, capture_output=True,
+            text=True)
+        assert proc.returncode == 5
+        assert "elastic restart 1/1" in proc.stderr
+
+    def test_negative_restarts_rejected(self, tmp_path):
+        script = tmp_path / "s.py"
+        script.write_text("print('hi')\n")
+        from paddle_tpu.distributed.launch.main import launch
+        assert launch(["--max_restarts", "-1", str(script)]) == 2
+        assert launch(["--nnodes", "2", "--node_rank", "0",
+                       "--master", "127.0.0.1:1", "--max_restarts", "1",
+                       str(script)]) == 2
+
+    def test_recompute_variant_not_pruned_by_dense_oom(self):
+        from paddle_tpu.distributed.auto_tuner import (
+            prune_by_history, Config)
+        failed = Config(sharding_degree=8, micro_batch_size=2,
+                        use_recompute=False, error="MemoryError: oom")
+        candidate = Config(sharding_degree=8, micro_batch_size=2,
+                           use_recompute=True)
+        assert prune_by_history({}, candidate, [failed]) is None
+        same = Config(sharding_degree=8, micro_batch_size=4,
+                      use_recompute=False)
+        assert prune_by_history({}, same, [failed]) is not None
