@@ -1,9 +1,12 @@
 """Data loading (ref: python/paddle/io/reader.py:216 DataLoader,
 io/dataloader/batch_sampler.py).
 
-v1 is in-process with a background prefetch thread (host->TPU transfer
-overlaps compute); the native multi-worker loader is tracked for the C++
-runtime milestone."""
+Single-thread mode uses a background prefetch thread (host->TPU
+transfer overlaps compute). num_workers > 0 feeds batches through the
+NATIVE C++ blocking queue (io/native/queue.cc — the analog of the
+reader BlockingQueue under the reference's DataLoader workers) with
+ordered reassembly, and large-sample collation runs through its
+parallel memcpy."""
 from __future__ import annotations
 
 import queue
@@ -216,10 +219,14 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = epoch
 
 
+_WORKER_ERROR = object()
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        from .native import collate_stack
+        return Tensor(collate_stack(batch))  # falls back to np.stack
     if isinstance(sample, Tensor):
         import jax.numpy as jnp
         return Tensor(jnp.stack([s._data for s in batch]))
@@ -243,6 +250,7 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -281,6 +289,12 @@ class DataLoader:
         if not self.use_buffer_reader:
             yield from self._batches()
             return
+        if self.num_workers > 0 and not self._iterable_mode:
+            yield from self._iter_workers()
+            return
+        yield from self._iter_buffered()
+
+    def _iter_buffered(self):
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
         err = []
@@ -303,3 +317,70 @@ class DataLoader:
                     raise err[0]
                 break
             yield item
+
+    def _iter_workers(self):
+        """num_workers > 0: worker threads load+collate batches into
+        NATIVE C++ blocking queues (ref: the reader BlockingQueue
+        under paddle's DataLoader workers, operators/reader/
+        blocking_queue.h). One bounded queue PER worker with
+        round-robin consumption: batch i comes from queue i % W, so
+        ordering is deterministic, memory stays capped at
+        W * prefetch_factor batches, and a slow worker backpressures
+        only itself (a shared queue would need an unbounded reorder
+        buffer). Falls back to the single-thread buffered reader when
+        the native library can't build."""
+        from .native import NativeQueue, available
+        if not available():
+            yield from self._iter_buffered()
+            return
+        idx_batches = list(self.batch_sampler)
+        W = self.num_workers
+        queues = [NativeQueue(max(self.prefetch_factor, 1))
+                  for _ in range(W)]
+        stop = threading.Event()
+        errs = []
+
+        def worker(wid):
+            nq = queues[wid]
+            try:
+                for bi in range(wid, len(idx_batches), W):
+                    if stop.is_set():
+                        return
+                    samples = [self.dataset[i] for i in idx_batches[bi]]
+                    while not stop.is_set():
+                        if nq.push(self.collate_fn(samples),
+                                   timeout_ms=200):
+                            break
+            except StopIteration:
+                return  # consumer closed the queue: orderly shutdown
+            except BaseException as e:
+                if not stop.is_set():
+                    errs.append(e)
+                try:
+                    nq.push(_WORKER_ERROR, timeout_ms=0)
+                except Exception:
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(w,),
+                                    daemon=True)
+                   for w in range(W)]
+        for t in threads:
+            t.start()
+        try:
+            for bi in range(len(idx_batches)):
+                while True:
+                    if errs:
+                        raise errs[0]
+                    try:
+                        batch = queues[bi % W].pop(timeout_ms=500)
+                        break
+                    except TimeoutError:
+                        continue
+                if batch is _WORKER_ERROR:
+                    raise errs[0] if errs else RuntimeError(
+                        "dataloader worker failed")
+                yield batch
+        finally:
+            stop.set()
+            for nq in queues:
+                nq.close()

@@ -1,0 +1,144 @@
+"""Native data-loader core (C++ blocking queue + parallel collation,
+ref operators/reader/blocking_queue.h) and the worker-threaded
+DataLoader path."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io.native import (NativeQueue, collate_stack, available)
+
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native io library unavailable")
+
+
+class TestNativeQueue:
+    def test_fifo_through_threads(self):
+        q = NativeQueue(4)
+        got = []
+
+        def consumer():
+            while True:
+                try:
+                    got.append(q.pop(timeout_ms=5000))
+                except StopIteration:
+                    return
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        for i in range(32):
+            q.push(i)
+        q.close()
+        t.join()
+        assert got == list(range(32))
+
+    def test_capacity_blocks_and_timeout(self):
+        q = NativeQueue(2)
+        assert q.push(1, timeout_ms=100)
+        assert q.push(2, timeout_ms=100)
+        assert not q.push(3, timeout_ms=50)   # full -> timeout
+        assert q.pop() == 1
+        assert q.push(3, timeout_ms=100)
+
+    def test_pop_timeout_raises(self):
+        q = NativeQueue(2)
+        with pytest.raises(TimeoutError):
+            q.pop(timeout_ms=50)
+
+    def test_close_drains_then_stops(self):
+        q = NativeQueue(4)
+        q.push("a")
+        q.close()
+        assert q.pop(timeout_ms=100) == "a"
+        with pytest.raises(StopIteration):
+            q.pop(timeout_ms=100)
+
+
+class TestNativeCollate:
+    def test_matches_np_stack(self):
+        arrs = [np.random.default_rng(i).standard_normal(
+            (64, 257)).astype(np.float32) for i in range(7)]
+        np.testing.assert_array_equal(collate_stack(arrs),
+                                      np.stack(arrs))
+
+    def test_mixed_shapes_fall_back(self):
+        arrs = [np.zeros((4, 4), np.float32), np.zeros((4,), np.float32)]
+        with pytest.raises(Exception):
+            collate_stack(arrs)  # np.stack raises identically
+
+
+class TestWorkerDataLoader:
+    class _DS:
+        def __init__(self, n=64, d=128):
+            self.data = np.arange(n * d, dtype=np.float32).reshape(n, d)
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            time.sleep(0.001)  # simulated decode cost
+            return self.data[i]
+
+    def test_worker_loader_matches_serial(self):
+        ds = self._DS()
+        serial = [b.numpy() for b in pt.io.DataLoader(
+            ds, batch_size=8, num_workers=0, shuffle=False)]
+        parallel = [b.numpy() for b in pt.io.DataLoader(
+            ds, batch_size=8, num_workers=4, shuffle=False)]
+        assert len(serial) == len(parallel) == 8
+        for a, b in zip(serial, parallel):
+            np.testing.assert_array_equal(a, b)
+
+    def test_worker_error_propagates(self):
+        class Bad(self._DS):
+            def __getitem__(self, i):
+                if i == 19:
+                    raise ValueError("corrupt sample")
+                return super().__getitem__(i)
+
+        loader = pt.io.DataLoader(Bad(), batch_size=8, num_workers=2)
+        with pytest.raises(ValueError, match="corrupt sample"):
+            list(loader)
+
+    def test_workers_actually_concurrent(self):
+        # structural overlap check (wall-clock ratios flake on loaded
+        # CI boxes): observe >1 __getitem__ in flight at once
+        lock = threading.Lock()
+        live = {"now": 0, "peak": 0}
+
+        outer = self
+
+        class Probe(self._DS):
+            def __getitem__(self, i):
+                with lock:
+                    live["now"] += 1
+                    live["peak"] = max(live["peak"], live["now"])
+                try:
+                    time.sleep(0.002)
+                    return outer._DS.__getitem__(self, i)
+                finally:
+                    with lock:
+                        live["now"] -= 1
+
+        list(pt.io.DataLoader(Probe(n=96), batch_size=8, num_workers=4))
+        assert live["peak"] > 1, live
+
+    def test_early_break_no_thread_spew(self):
+        loader = pt.io.DataLoader(self._DS(n=64), batch_size=8,
+                                  num_workers=3)
+        it = iter(loader)
+        next(it)
+        it.close()  # consumer abandons mid-epoch; workers must exit
+
+    def test_object_dtype_collate_safe(self):
+        import gc
+        from paddle_tpu.io.native import collate_stack
+        objs = [np.array([{"k": i}] * 9000, dtype=object)
+                for i in range(3)]
+        out = collate_stack(objs)
+        del objs
+        gc.collect()
+        assert out[0][0]["k"] == 0  # no dangling PyObject pointers
