@@ -81,3 +81,9 @@ define_flag("FLAGS_seed", 0, "global RNG seed")
 define_flag("FLAGS_allocator_strategy", "pjrt",
             "memory strategy (informational; PJRT owns device memory)")
 define_flag("FLAGS_log_level", 0, "framework vlog level")
+define_flag("FLAGS_watchdog_timeout_s", 0.0,
+            "hang watchdog: dump thread stacks when a blocking region "
+            "(train step / checkpoint) exceeds this many seconds; 0 off")
+define_flag("FLAGS_watchdog_abort", False,
+            "hang watchdog: os._exit(124) after the dump so the "
+            "elastic layer restarts the worker")

@@ -56,6 +56,12 @@ def _tensor_items(state_dict):
 def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0) -> None:
     """ref: save_state_dict.py:77"""
+    from ...utils.watchdog import watchdog
+    with watchdog(what=f"checkpoint save to {path}"):
+        _save_state_dict(state_dict, path)
+
+
+def _save_state_dict(state_dict: Dict, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     meta = {}
     for name, arr in _tensor_items(state_dict):
@@ -137,6 +143,12 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     intentionally NOT interoperable with the reference's .distcp files —
     the metadata schema there is tied to its Program/DistTensor
     serialization."""
+    from ...utils.watchdog import watchdog
+    with watchdog(what=f"checkpoint load from {path}"):
+        _load_state_dict(state_dict, path)
+
+
+def _load_state_dict(state_dict: Dict, path: str) -> None:
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
     for name, t in list(state_dict.items()):

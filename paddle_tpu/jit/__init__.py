@@ -362,9 +362,16 @@ class TrainStep:
         seed = jax.random.fold_in(self._rng, self._step_count)
         self._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.params, self.opt_states = self._step_fn(
-            self.params, self.opt_states, self.buffers, seed, lr, args,
-            kwargs)
+        from ..utils.watchdog import watchdog
+        with watchdog(what=f"TrainStep step {self._step_count}") as wd:
+            loss, self.params, self.opt_states = self._step_fn(
+                self.params, self.opt_states, self.buffers, seed, lr,
+                args, kwargs)
+            if wd is not None:
+                # jit returns futures immediately; a hang detector must
+                # observe DEVICE completion. Armed mode trades async
+                # dispatch for detection (off by default: zero cost).
+                jax.block_until_ready(loss)
         from ..optimizer.lr import LRScheduler
         if isinstance(self.optimizer._lr, LRScheduler):
             self.optimizer._lr.step()

@@ -1,0 +1,44 @@
+"""Hang watchdog (SURVEY §5.2 comm-hang sanitizer analog)."""
+import io
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.utils.watchdog import watchdog
+
+
+def test_disarmed_is_noop():
+    with watchdog(0, what="x") as w:
+        assert w is None
+
+
+def test_fires_and_dumps_stacks(capfd):
+    with watchdog(0.05, what="slow region"):
+        time.sleep(0.3)
+    err = capfd.readouterr().err
+    assert "slow region" in err and "watchdog" in err
+    assert "Thread" in err or "File" in err  # faulthandler dump
+
+
+def test_fast_region_stays_silent(capfd):
+    with watchdog(5.0, what="quick"):
+        pass
+    assert "watchdog" not in capfd.readouterr().err
+
+
+def test_flags_arm_trainstep(capfd):
+    pt.set_flags({"FLAGS_watchdog_timeout_s": 60.0})
+    try:
+        model = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        from paddle_tpu.jit import TrainStep
+        step = TrainStep(model, opt,
+                         lambda m, x: pt.ops.mean(m(x) ** 2))
+        step(np.ones((2, 4), np.float32))
+        assert "watchdog" not in capfd.readouterr().err
+    finally:
+        pt.set_flags({"FLAGS_watchdog_timeout_s": 0.0})
