@@ -359,11 +359,12 @@ class TrainStep:
                   for k, v in kwargs.items()}
         if self._data_sharding is not None:
             args = [jax.device_put(a, self._data_sharding) for a in args]
-        seed = jax.random.fold_in(self._rng, self._step_count)
+        step_id = self._step_count
+        seed = jax.random.fold_in(self._rng, step_id)
         self._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         from ..utils.watchdog import watchdog
-        with watchdog(what=f"TrainStep step {self._step_count}") as wd:
+        with watchdog(what=f"TrainStep step {step_id}") as wd:
             loss, self.params, self.opt_states = self._step_fn(
                 self.params, self.opt_states, self.buffers, seed, lr,
                 args, kwargs)

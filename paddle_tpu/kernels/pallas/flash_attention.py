@@ -14,16 +14,26 @@ touches HBM in either direction. Two kernels: one gridded over K blocks
 of the reference's flash_attn_bwd
 (/root/reference/paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu).
 
+Fused-head layout: the kernels run on [batch, seq, heads*head_dim] — the
+layout a fused QKV projection naturally produces — and slice heads
+in-kernel (lane offsets h*D). Measured on v5e at [16, 1024, 12, 64] this
+beats the per-head [b*h, s, d] fold two ways:
+  * no [b,s,h,d] <-> [b*h,s,d] transposes (sublane-shuffle copies that
+    cost more than the attention math itself at d=64), and
+  * no HBM padding: minor dim h*d is lane-aligned, whereas a d=64 minor
+    dim is padded to 128 lanes (2x footprint and bandwidth).
+
+Two more measured wins: sm_scale is folded into q before the kernel
+(drops one [bq, bk] VPU pass per head per block pair), and the causal
+mask is applied only on diagonal-straddling block pairs — fully-valid
+pairs take an unmasked branch (runtime pl.when on grid indices).
+
 Inputs are fed to the MXU in their native dtype (bf16 in, f32 accumulate
 via preferred_element_type) — no f32 upcast before the dot.
 
-Default blocks are large (1024 x 1024): measured on v5e, per-grid-step
-overhead dominates below ~256-wide blocks (128x128 blocks ran 3.4x slower
-at [96, 1024, 64], and 1024x1024 beat 512x1024 by ~11% at [192, 1024,
-64]); VMEM comfortably holds the bigger tiles at d <= 256.
-
-Layout contract matches paddle: [batch, seq, heads, head_dim]
-(ref: python/paddle/nn/functional/flash_attention.py:146).
+Layout contract of the public API matches paddle: [batch, seq, heads,
+head_dim] (ref: python/paddle/nn/functional/flash_attention.py:146);
+the [b,s,h,d] <-> [b,s,h*d] reshape is free (no axis reordering).
 """
 from __future__ import annotations
 
@@ -36,53 +46,43 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+# raised scoped-VMEM budget: the 1024-wide K/V blocks measured fastest
+# need ~17MB with double buffering (the default scoped limit is 16MB)
+_VMEM_LIMIT = 64 * 1024 * 1024
 _LANES = 128
-_SUBL = 8   # lse/delta carried as [bh, _SUBL, s]: seq in lanes, stats
-            # replicated over one sublane tile (minimum TPU tile height)
+_SUBL = 8   # per-head stats ride as [b, h*_SUBL, s]: seq in lanes, each
+            # head's row replicated over one sublane tile (minimum height)
 
 
-def _pair_mask(causal, qi, ki, block_q, block_k, q_limit, k_limit):
-    """Validity mask for a (block_q, block_k) score tile: causal lower
-    triangle and/or in-bounds rows/cols for padded final blocks. Returns
-    None when every position is valid (compile-time)."""
-    need_q = q_limit is not None and q_limit % block_q
-    need_k = k_limit is not None and k_limit % block_k
-    if not (causal or need_q or need_k):
-        return None
+def _causal_tile_mask(qi, ki, block_q, block_k):
+    """Bool [block_q, block_k] validity (q_pos >= k_pos) for a block pair.
+    Only called on diagonal-straddling pairs."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    ok = None
-    if causal:
-        ok = q_pos >= k_pos
-    if need_q:
-        m = q_pos < q_limit
-        ok = m if ok is None else jnp.logical_and(ok, m)
-    if need_k:
-        m = k_pos < k_limit
-        ok = m if ok is None else jnp.logical_and(ok, m)
-    return ok
+    return q_pos >= k_pos
 
 
-def _load_rows(ref, block_idx, block, limit):
-    """Load ref[0], zeroing rows past `limit` (padded final block).
+def _block_classes(causal, qi, ki, block_q, block_k):
+    """(run, needs_mask) predicates for a (q_block, k_block) pair.
 
-    Padding contents are undefined; a 0 * NaN = NaN would otherwise leak
-    through the dot products even where p is masked to zero. Compile-time
-    no-op when block divides limit."""
-    x = ref[0]
-    if limit % block:
-        rows = block_idx * block + jax.lax.broadcasted_iota(
-            jnp.int32, x.shape, 0)
-        x = jnp.where(rows < limit, x, jnp.zeros_like(x))
-    return x
+    run: some (q_pos, k_pos) pair is valid -> compute the block at all.
+    needs_mask: the pair straddles the diagonal -> apply the tile mask.
+    Fully-valid pairs (min q_pos >= max k_pos) skip the mask pass.
+    """
+    if not causal:
+        return None, None
+    last_q = qi * block_q + block_q - 1
+    run = last_q >= ki * block_k
+    full = qi * block_q >= ki * block_k + block_k - 1
+    return run, jnp.logical_and(run, jnp.logical_not(full))
 
 
 # ======================= forward =======================
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, sm_scale, causal, block_q, block_k, seq_k):
+                *, causal, block_q, block_k, H, D):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -93,110 +93,120 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    run = True
-    if causal:
-        # whole K block strictly above the diagonal -> skip
-        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+    def _body(masked):
+        qf = q_ref[0]          # [bq, H*D] native dtype (pre-scaled)
+        kf = k_ref[0]
+        vf = v_ref[0]
+        ok = _causal_tile_mask(qi, ki, block_q, block_k) if masked else None
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            s = jax.lax.dot_general(
+                qf[:, sl], kf[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [bq, bk] f32
+            if ok is not None:
+                s = jnp.where(ok, s, _NEG_INF)
+            m_prev = m_ref[:, h:h + 1]                   # [bq, 1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)                       # [bq, bk] f32
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:, h:h + 1] = alpha * l_ref[:, h:h + 1] + jnp.sum(
+                p, axis=1, keepdims=True)
+            acc_ref[:, sl] = acc_ref[:, sl] * alpha + jax.lax.dot_general(
+                p.astype(vf.dtype), vf[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[:, h:h + 1] = m_new
 
-    @pl.when(run if causal else True)
-    def _compute():
-        q = q_ref[0]          # [block_q, d] native dtype -> bf16 MXU pass
-        k = _load_rows(k_ref, ki, block_k, seq_k)
-        v = _load_rows(v_ref, ki, block_k, seq_k)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk] f32
-        ok = _pair_mask(causal, qi, ki, block_q, block_k, None, seq_k)
-        if ok is not None:
-            s = jnp.where(ok, s, _NEG_INF)
-        m_prev = m_ref[:, 0:1]                      # [bq, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)   # [bq, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                      # [bq, bk] f32
-        alpha = jnp.exp(m_prev - m_new)             # [bq, 1]
-        l_new = alpha * l_ref[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+    run, needs_mask = _block_classes(causal, qi, ki, block_q, block_k)
+    if run is None:
+        _body(False)
+    else:
+        @pl.when(jnp.logical_and(run, jnp.logical_not(needs_mask)))
+        def _full():
+            _body(False)
+
+        @pl.when(needs_mask)
+        def _diag():
+            _body(True)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_ref[:, 0:1]
+        l = l_ref[:]                                 # [bq, LANES], col/head
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        # lse is [block_q] worth of per-row stats living in sublanes
-        # (replicated across lanes); the compact [bh, sq] output wants it
-        # in lanes — one in-register transpose per q block.
-        lse_tile = m_ref[:] + jnp.log(jnp.where(l_ref[:] == 0.0, 1.0,
-                                                l_ref[:]))
-        lse_ref[0] = jax.lax.transpose(lse_tile, (1, 0))[:_SUBL]
+        acc = acc_ref[:]
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            o_ref[0, :, sl] = (acc[:, sl] / safe_l[:, h:h + 1]).astype(
+                o_ref.dtype)
+        # per-head lse rows want seq in lanes: one [bq, LANES] transpose,
+        # then each head's row broadcast over its sublane tile.
+        lse_t = jax.lax.transpose(m_ref[:] + jnp.log(safe_l), (1, 0))
+        for h in range(H):
+            lse_ref[0, h * _SUBL:(h + 1) * _SUBL, :] = jnp.broadcast_to(
+                lse_t[h:h + 1], (_SUBL, lse_t.shape[1]))
 
 
-def _flash_fwd_bhsd(q, k, v, sm_scale, causal, block_q=1024, block_k=1024,
-                    interpret=False):
-    """q,k,v: [bh, s, d] -> (out [bh, s, d], lse [bh, SUBL, s] f32).
-
-    lse rides transposed (seq in lanes, replicated over 8 sublanes): TPU
-    block rules need the last two dims tiled (8, 128), and per-row softmax
-    stats naturally live in sublanes — one in-register transpose per block
-    beats a 128-lane-replicated [bh, s, 128] buffer 16x on HBM footprint.
-    """
-    bh, sq, d = q.shape
+def _flash_fwd_fused(q, k, v, H, causal, block_q=256, block_k=1024,
+                     interpret=False):
+    """q,k,v: [b, s, H*D] (q pre-scaled by sm_scale).
+    Returns (out [b, s, H*D], lse [b, H*_SUBL, s] f32)."""
+    b, sq, HD = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+    D = HD // H
+    block_q, block_k = _fit_blocks(block_q, block_k, HD,
+                                   n_bufs_q=2, n_bufs_k=2)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    grid = (b, sq // block_q, sk // block_k)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=sk)
+        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        H=H, D=D)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, HD), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, HD), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, HD), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, _SUBL, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, block_q, HD), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, H * _SUBL, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, _SUBL, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, sq, HD), q.dtype),
+            jax.ShapeDtypeStruct((b, H * _SUBL, sq), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, HD), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(q, k, v)
 
 
 # ======================= backward =======================
 
-def _lane_to_col(ref, block_q, block_idx, limit):
-    """Read a (1, SUBL, block_q) stats block (values in lanes) as a
-    [block_q, 1] column (values in sublanes) for row-wise broadcasting.
-    Stats for rows past `limit` are undefined padding — zero them, else
-    0 * NaN leaks into the accumulators through ds (compile-time no-op
-    when block_q divides limit)."""
-    col = jax.lax.transpose(ref[0], (1, 0))[:, 0:1]
-    if limit % block_q:
-        rows = block_idx * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, col.shape, 0)
-        col = jnp.where(rows < limit, col, jnp.zeros_like(col))
-    return col
+def _stats_cols(ref):
+    """[1, H*_SUBL, bq] stats block -> [bq, H*_SUBL] (one col per head at
+    lane h*_SUBL) via a single transpose."""
+    return jax.lax.transpose(ref[0], (1, 0))
 
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, dk_acc, dv_acc, *,
-                     sm_scale, causal, block_q, block_k, seq_q):
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                causal, block_q, block_k, H, D):
+    """Single-pass backward: one s/p recompute per block pair feeds dk, dv
+    AND this pair's dq contribution (vs. the classic two-kernel split that
+    recomputes s/p and the dp dot twice). dq contributions can't accumulate
+    in scratch here (the k-block axis is the outer grid dim), so each pair
+    writes a partial into dqp [b, n_kblocks, sq, HD] f32; the caller sums
+    over the k-block axis in XLA — a few hundred MB of streaming traffic
+    that costs far less than a second full recompute pass."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -206,38 +216,58 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = True
-    if causal:
-        run = (ki * block_k) <= (qi * block_q + block_q - 1)
+    def _body(masked):
+        qf = q_ref[0]                        # [bq, HD] (pre-scaled)
+        kf = k_ref[0]
+        vf = v_ref[0]
+        dof = do_ref[0]
+        lse_c = _stats_cols(lse_ref)         # [bq, H*_SUBL]
+        delta_c = _stats_cols(delta_ref)
+        ok = _causal_tile_mask(qi, ki, block_q, block_k) if masked else None
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            cl = slice(h * _SUBL, h * _SUBL + 1)
+            s = jax.lax.dot_general(
+                qf[:, sl], kf[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [bq, bk]
+            p = jnp.exp(s - lse_c[:, cl])
+            if ok is not None:
+                p = jnp.where(ok, p, 0.0)
+            # dv += p^T @ do
+            dv_acc[:, sl] += jax.lax.dot_general(
+                p.astype(dof.dtype), dof[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                dof[:, sl], vf[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [bq, bk]
+            ds = p * (dp - delta_c[:, cl])
+            # dk += ds^T @ q_scaled
+            dk_acc[:, sl] += jax.lax.dot_general(
+                ds.astype(qf.dtype), qf[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            # this pair's dq contribution: ds @ k (stored in the input
+            # dtype; the caller's partial-sum accumulates in f32)
+            dqp_ref[0, 0, :, sl] = jax.lax.dot_general(
+                ds.astype(kf.dtype), kf[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dqp_ref.dtype)
 
-    @pl.when(run if causal else True)
-    def _compute():
-        q = _load_rows(q_ref, qi, block_q, seq_q)   # [bq, d]
-        k = k_ref[0]                       # [bk, d]
-        v = v_ref[0]                       # [bk, d]
-        do = _load_rows(do_ref, qi, block_q, seq_q)  # [bq, d]
-        lse = _lane_to_col(lse_ref, block_q, qi, seq_q)      # [bq, 1]
-        delta = _lane_to_col(delta_ref, block_q, qi, seq_q)  # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        p = jnp.exp(s - lse)
-        ok = _pair_mask(causal, qi, ki, block_q, block_k, seq_q, None)
-        if ok is not None:
-            p = jnp.where(ok, p, 0.0)
-        # dv += p^T @ do     (contract over q rows)
-        dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        # dp = do @ v^T      [bq, bk]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale   # [bq, bk] f32
-        # dk += ds^T @ q     (contract over q rows)
-        dk_acc[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    run, needs_mask = _block_classes(causal, qi, ki, block_q, block_k)
+    if run is None:
+        _body(False)
+    else:
+        # skipped pairs (fully above the diagonal) still own an output
+        # block in dqp — zero it so the XLA-side sum sees no garbage.
+        @pl.when(jnp.logical_not(run))
+        def _skip():
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+        @pl.when(jnp.logical_and(run, jnp.logical_not(needs_mask)))
+        def _full():
+            _body(False)
+
+        @pl.when(needs_mask)
+        def _diag():
+            _body(True)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -245,117 +275,93 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, sm_scale, causal, block_q, block_k,
-                   seq_q, seq_k):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+def _flash_bwd_fused(q, k, v, o, lse, do, H, causal,
+                     block_q=256, block_k=512, interpret=False):
+    """Blockwise dq/dk/dv on the fused-head layout.
 
-    @pl.when(ki == 0)
-    def _init():
-        dq_acc[:] = jnp.zeros_like(dq_acc)
-
-    run = True
-    if causal:
-        run = (ki * block_k) <= (qi * block_q + block_q - 1)
-
-    @pl.when(run if causal else True)
-    def _compute():
-        q = q_ref[0]
-        k = _load_rows(k_ref, ki, block_k, seq_k)
-        v = _load_rows(v_ref, ki, block_k, seq_k)
-        do = do_ref[0]
-        lse = _lane_to_col(lse_ref, block_q, qi, seq_q)
-        delta = _lane_to_col(delta_ref, block_q, qi, seq_q)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        p = jnp.exp(s - lse)
-        ok = _pair_mask(causal, qi, ki, block_q, block_k, None, seq_k)
-        if ok is not None:
-            p = jnp.where(ok, p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale   # [bq, bk] f32
-        # dq += ds @ k
-        dq_acc[:] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    @pl.when(ki == nk - 1)
-    def _finalize():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
-
-
-def _flash_bwd_bhsd(q, k, v, o, lse, do, sm_scale, causal,
-                    block_q=1024, block_k=1024, interpret=False):
-    """Blockwise dq/dk/dv. q,k,v,o,do: [bh, s, d]; lse: [bh, SUBL, sq]."""
-    bh, sq, d = q.shape
+    q,k,v,o,do: [b, s, H*D] (q pre-scaled); lse: [b, H*_SUBL, sq] f32.
+    Returns (dq_scaled f32, dk, dv) — caller multiplies dq by sm_scale.
+    """
+    b, sq, HD = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    # delta_i = rowsum(do_i * o_i) — one fused elementwise pass in XLA,
-    # laid out like lse: [bh, SUBL, sq].
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                              # [bh, sq]
-    delta = jnp.broadcast_to(delta[:, None, :], (bh, _SUBL, sq))
+    D = HD // H
+    # long sequences: grow K blocks so the dq partial-sum buffer
+    # (b * nk * sq * HD) stays bounded at nk <= 8 — _fit_blocks may shrink
+    # them back if HD is too wide for VMEM, which keeps correctness and
+    # trades the extra partials for compile-safety.
+    block_k = max(block_k, sk // 8)
+    block_q, block_k = _fit_blocks(block_q, block_k, HD,
+                                   n_bufs_q=3, n_bufs_k=4)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    nk = sk // block_k
 
-    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    stat_q = pl.BlockSpec((1, _SUBL, block_q), lambda b, i, j: (b, 0, i))
+    # delta_i = rowsum(do_i * o_i) per head — fused elementwise in XLA,
+    # laid out like lse: [b, H*_SUBL, sq].
+    dof = do.reshape(b, sq, H, D).astype(jnp.float32)
+    of = o.reshape(b, sq, H, D).astype(jnp.float32)
+    delta = jnp.einsum("bshd,bshd->bhs", dof, of)         # [b, H, sq]
+    delta = jnp.broadcast_to(delta[:, :, None, :],
+                             (b, H, _SUBL, sq)).reshape(b, H * _SUBL, sq)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, block_k=block_k,
-                          seq_q=sq),
-        grid=(bh, pl.cdiv(sk, block_k), pl.cdiv(sq, block_q)),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, _SUBL, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, _SUBL, block_q), lambda b, j, i: (b, 0, i)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        ],
+    q_spec_i = pl.BlockSpec((1, block_q, HD), lambda b, j, i: (b, i, 0))
+    k_spec_j = pl.BlockSpec((1, block_k, HD), lambda b, j, i: (b, j, 0))
+    stat_i = pl.BlockSpec((1, H * _SUBL, block_q), lambda b, j, i: (b, 0, i))
+    dqp_spec = pl.BlockSpec((1, 1, block_q, HD),
+                            lambda b, j, i: (b, j, i, 0))
+
+    dqp, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, H=H, D=D),
+        grid=(b, nk, sq // block_q),
+        in_specs=[q_spec_i, k_spec_j, k_spec_j, q_spec_i, stat_i, stat_i],
+        out_specs=[dqp_spec, k_spec_j, k_spec_j],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b, nk, sq, HD), q.dtype),
+            jax.ShapeDtypeStruct((b, sk, HD), k.dtype),
+            jax.ShapeDtypeStruct((b, sk, HD), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, HD), jnp.float32),
+            pltpu.VMEM((block_k, HD), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
+    return jnp.sum(dqp, axis=1, dtype=jnp.float32), dk, dv
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, block_k=block_k,
-                          seq_q=sq, seq_k=sk),
-        grid=(bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)),
-        in_specs=[
-            q_spec,
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            q_spec,
-            stat_q,
-            stat_q,
-        ],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+
+def _pick_block(s, target):
+    """Largest block <= target that divides s (s is a multiple of 128)."""
+    if s % 128:
+        raise ValueError(f"seq {s} must be a multiple of 128")
+    blk = min(target, s)
+    while s % blk:
+        blk -= 128
+    return blk
+
+
+def _fit_blocks(block_q, block_k, HD, n_bufs_q, n_bufs_k, budget=_VMEM_LIMIT):
+    """Shrink (block_q, block_k) until the kernel's VMEM appetite fits.
+
+    The dominant consumers scale linearly with HD (double-buffered block
+    DMAs + f32 accumulators) and with block_q*block_k (score-tile
+    transients), so large-model head widths (e.g. HD=4096) must trade
+    block size rather than crash the Pallas compile."""
+    def est(bq, bk):
+        io = 2 * (n_bufs_q * bq + n_bufs_k * bk) * HD * 2   # dbuf bf16 DMAs
+        acc = (bq + bk) * HD * 4                            # f32 accumulators
+        tile = 3 * bq * bk * 4                              # score transients
+        return io + acc + tile
+    while est(block_q, block_k) > budget * 0.75 and (
+            block_q > 128 or block_k > 128):
+        if block_k >= block_q and block_k > 128:
+            block_k //= 2
+        else:
+            block_q //= 2
+    return max(block_q, 128), max(block_k, 128)
 
 
 # ======================= dispatch =======================
@@ -392,49 +398,48 @@ def _pallas_available():
                 _pallas_ok = False
             else:
                 x = jnp.zeros((1, 128, 128), jnp.float32)
-                _flash_fwd_bhsd(x, x, x, 1.0, False)
+                _flash_fwd_fused(x, x, x, 1, False, block_q=128,
+                                 block_k=128)
                 _pallas_ok = True
         except Exception:
             _pallas_ok = False
     return _pallas_ok
 
 
-def _bshd_to_bhsd(x):
-    b, s, h, d = x.shape
-    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
-
-
-def _bhsd_to_bshd(x, b, h):
-    bh, s, d = x.shape
-    return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_core(q, k, v, causal, sm_scale, use_pallas):
+    """[b, s, h, d] in/out."""
     if use_pallas:
-        o, _ = _flash_fwd_bhsd(_bshd_to_bhsd(q), _bshd_to_bhsd(k),
-                               _bshd_to_bhsd(v), sm_scale, causal)
-        return _bhsd_to_bshd(o, q.shape[0], q.shape[2])
+        b, s, h, d = q.shape
+        qs = (q * sm_scale).astype(q.dtype).reshape(b, s, h * d)
+        o, _ = _flash_fwd_fused(qs, k.reshape(b, -1, h * d),
+                                v.reshape(b, -1, h * d), h, causal)
+        return o.reshape(b, s, h, d)
     return _xla_attention(q, k, v, None, causal, sm_scale)
 
 
 def _flash_core_fwd(q, k, v, causal, sm_scale, use_pallas):
     if use_pallas:
-        qm, km, vm = map(_bshd_to_bhsd, (q, k, v))
-        o, lse = _flash_fwd_bhsd(qm, km, vm, sm_scale, causal)
-        out = _bhsd_to_bshd(o, q.shape[0], q.shape[2])
-        return out, (qm, km, vm, o, lse, q.shape[0], q.shape[2])
+        b, s, h, d = q.shape
+        qs = (q * sm_scale).astype(q.dtype).reshape(b, s, h * d)
+        km = k.reshape(b, -1, h * d)
+        vm = v.reshape(b, -1, h * d)
+        o, lse = _flash_fwd_fused(qs, km, vm, h, causal)
+        return o.reshape(b, s, h, d), (qs, km, vm, o, lse, h)
     out = _xla_attention(q, k, v, None, causal, sm_scale)
-    return out, (q, k, v, None, None, None, None)
+    return out, (q, k, v, None, None, None)
 
 
 def _flash_core_bwd(causal, sm_scale, use_pallas, res, g):
-    q, k, v, o, lse, b, h = res
+    q, k, v, o, lse, h = res
     if use_pallas:
-        gm = _bshd_to_bhsd(g)
-        dq, dk, dv = _flash_bwd_bhsd(q, k, v, o, lse, gm, sm_scale, causal)
-        return (_bhsd_to_bshd(dq, b, h), _bhsd_to_bshd(dk, b, h),
-                _bhsd_to_bshd(dv, b, h))
+        b, s, hd = q.shape
+        gm = g.reshape(b, s, hd)
+        dq, dk, dv = _flash_bwd_fused(q, k, v, o, lse, gm, h, causal)
+        d = hd // h
+        dq = (dq * sm_scale).astype(q.dtype)  # dq arrives as f32 partial-sum
+        return (dq.reshape(b, s, h, d), dk.reshape(b, -1, h, d),
+                dv.reshape(b, -1, h, d))
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _xla_attention(q_, k_, v_, None, causal, sm_scale),
         q, k, v)
@@ -445,9 +450,11 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def _shapes_ok(q_shape, k_shape):
-    sq, sk, d = q_shape[1], k_shape[1], q_shape[-1]
+    sq, sk, h, d = q_shape[1], k_shape[1], q_shape[2], q_shape[-1]
     return (sq >= 128 and sk >= 128 and d in (64, 128, 256)
-            and sq % 128 == 0 and sk % 128 == 0)
+            and sq % 128 == 0 and sk % 128 == 0
+            and (h * d) % _LANES == 0 and h <= _LANES
+            and k_shape[2] == h)
 
 
 def attention_path(q_shape, k_shape, masked=False):

@@ -32,7 +32,7 @@ from contextlib import contextmanager
 from ..core.flags import get_flags
 
 
-def _flag(name, default):
+def _flag(name):
     # fails loudly on an unknown name (a typo must not silently
     # disarm the watchdog); get_flags returns {name: value}
     return get_flags(name)[name]
@@ -93,9 +93,9 @@ def watchdog(timeout_s: float = None, what: str = "blocking region",
     reads FLAGS_watchdog_timeout_s (0 = disarmed); abort=None reads
     FLAGS_watchdog_abort (default: warn only)."""
     if timeout_s is None:
-        timeout_s = float(_flag("FLAGS_watchdog_timeout_s", 0.0) or 0.0)
+        timeout_s = float(_flag("FLAGS_watchdog_timeout_s") or 0.0)
     if abort is None:
-        abort = bool(_flag("FLAGS_watchdog_abort", False))
+        abort = bool(_flag("FLAGS_watchdog_abort"))
     if not timeout_s:
         yield None
         return
