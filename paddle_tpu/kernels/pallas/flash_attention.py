@@ -245,8 +245,11 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dk_acc[:, sl] += jax.lax.dot_general(
                 ds.astype(qf.dtype), qf[:, sl], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            # this pair's dq contribution: ds @ k (stored in the input
-            # dtype; the caller's partial-sum accumulates in f32)
+            # this pair's dq contribution: ds @ k. Stored in the input
+            # dtype (bf16 under AMP): each partial is individually rounded
+            # before the f32-accumulated sum — acceptable because nk <= 8
+            # and the final dq is cast to the same dtype anyway (validated
+            # by the multi-k-block bf16 test in test_flash_attention.py)
             dqp_ref[0, 0, :, sl] = jax.lax.dot_general(
                 ds.astype(kf.dtype), kf[:, sl], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32).astype(dqp_ref.dtype)

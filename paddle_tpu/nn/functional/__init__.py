@@ -26,6 +26,10 @@ from ...ops import (  # noqa: F401
     scaled_dot_product_attention,
     pixel_shuffle, pixel_unshuffle, channel_shuffle, interpolate, upsample,
     temporal_shift, affine_grid, pad,
+    depthwise_conv2d, conv3d_transpose, deformable_conv, fold,
+    max_pool2d_with_index, unpool, rrelu,
+    huber_loss, bce_loss, hsigmoid_loss, margin_cross_entropy, ctc_loss,
+    bilinear,
 )
 from ...ops.registry import register_op
 from ...core.tensor import Tensor

@@ -37,6 +37,22 @@ from .random import (  # noqa: F401
     randn_like, exponential_,
 )
 from .nn_ops import *  # noqa: F401,F403
+from .vision_ops import (  # noqa: F401
+    depthwise_conv2d, conv3d_transpose, deformable_conv, fold,
+    max_pool2d_with_index, unpool, roi_pool, psroi_pool, prior_box,
+    yolo_box, matrix_nms, multiclass_nms,
+)
+from .sequence_ops import (  # noqa: F401
+    ctc_loss, viterbi_decode, gather_tree, top_p_sampling, edit_distance,
+    class_center_sample,
+)
+from .math import logcumsumexp, clip_by_norm, renorm, add_n, \
+    elementwise_pow  # noqa: F401
+from .linalg import p_norm, lu_unpack, spectral_norm  # noqa: F401
+from .manipulation import unstack, fill_diagonal  # noqa: F401
+from .random import (  # noqa: F401
+    binomial, dirichlet, standard_gamma, truncated_normal,
+)
 
 
 # ---------------------------------------------------------------------------

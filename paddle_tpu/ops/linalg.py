@@ -303,3 +303,78 @@ def _einsum(equation, operands):
 
 def einsum(equation, *operands):
     return _einsum(equation, list(operands))
+
+
+@register_op("p_norm")
+def p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False,
+           asvector=False):
+    """ref: phi/kernels/gpu/p_norm_kernel.cu (the functional behind
+    paddle.linalg norms)."""
+    if asvector:
+        x = x.reshape(-1)
+        axis = 0
+    xf = x.astype(jnp.float32)
+    if porder == float("inf"):
+        out = jnp.max(jnp.abs(xf), axis=axis, keepdims=keepdim)
+    elif porder == float("-inf"):
+        out = jnp.min(jnp.abs(xf), axis=axis, keepdims=keepdim)
+    elif porder == 0:
+        out = jnp.sum((xf != 0).astype(jnp.float32), axis=axis,
+                      keepdims=keepdim)
+    else:
+        out = jnp.sum(jnp.abs(xf) ** porder, axis=axis,
+                      keepdims=keepdim) ** (1.0 / porder)
+    return out.astype(x.dtype)
+
+
+@register_op("lu_unpack")
+def lu_unpack(x, pivots, unpack_ludata=True, unpack_pivots=True):
+    """Expand lu()'s compact output to (P, L, U) (ref: lu_unpack in
+    ops.yaml; pivots are 1-based as lu() returns them)."""
+    m, n = x.shape[-2:]
+    k = min(m, n)
+    L = jnp.tril(x[..., :, :k], -1) + jnp.eye(m, k, dtype=x.dtype)
+    U = jnp.triu(x[..., :k, :])
+    # pivots encode successive row swaps; materialize the permutation
+    perm = jnp.arange(m)
+    piv0 = pivots.astype(jnp.int32) - 1
+
+    def swap(p, i):
+        pi = piv0[..., i]
+        a = p[..., i]
+        b = jnp.take_along_axis(p, pi[..., None], axis=-1)[..., 0]
+        p = p.at[..., i].set(b)
+        p = jnp.put_along_axis(p, pi[..., None], a[..., None],
+                               axis=-1, inplace=False)
+        return p, None
+
+    perm = jnp.broadcast_to(perm, pivots.shape[:-1] + (m,))
+    perm, _ = jax.lax.scan(swap, perm, jnp.arange(piv0.shape[-1]))
+    P = (perm[..., :, None] == jnp.arange(m)[None, :]).astype(x.dtype)
+    P = jnp.swapaxes(P, -1, -2)
+    return P, L, U
+
+
+@register_op("spectral_norm")
+def spectral_norm(weight, u=None, v=None, dim=0, power_iters=1, eps=1e-12):
+    """Power-iteration spectral normalization (ref:
+    phi/kernels/impl/spectral_norm_kernel_impl.h)."""
+    w = jnp.moveaxis(weight, dim, 0)
+    mat = w.reshape(w.shape[0], -1).astype(jnp.float32)
+    h, wdim = mat.shape
+    if u is None:
+        u = jnp.ones((h,), jnp.float32) / jnp.sqrt(float(h))
+    else:
+        u = u.astype(jnp.float32).reshape(h)
+    if v is None:
+        v = jnp.ones((wdim,), jnp.float32) / jnp.sqrt(float(wdim))
+    else:
+        v = v.astype(jnp.float32).reshape(wdim)
+    for _ in range(max(power_iters, 1)):
+        v = mat.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = mat @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    sigma = u @ mat @ v
+    out = (mat / jnp.maximum(sigma, eps)).reshape(w.shape)
+    return jnp.moveaxis(out, 0, dim).astype(weight.dtype)

@@ -420,3 +420,30 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
     shard_size = (index_num + nshards - 1) // nshards
     in_shard = (x // shard_size) == shard_id
     return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+@register_op("unstack")
+def unstack(x, axis=0, num=None):
+    """Split into single slices along axis, squeezing it (ref: unstack in
+    ops.yaml)."""
+    n = num if num is not None else x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, n, axis=axis))
+
+
+@register_op("fill_diagonal")
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """ref: fill_diagonal in ops.yaml (out-of-place; Tensor.fill_diagonal_
+    wraps it in-place)."""
+    m, n = x.shape[-2:]
+    rows = jnp.arange(m)[:, None]
+    cols = jnp.arange(n)[None, :]
+    hit = (cols - rows) == offset
+    if wrap and x.ndim == 2 and m > n:
+        if offset != 0:
+            raise NotImplementedError(
+                "fill_diagonal: wrap=True with a nonzero offset is not "
+                "supported")
+        # torch/paddle wrap: restart the diagonal every n+1 rows
+        hit = ((rows - cols) % (n + 1)) == 0
+    return jnp.where(hit, jnp.asarray(value, x.dtype), x)

@@ -424,3 +424,50 @@ def trapezoid(y, x=None, dx=None, axis=-1):
 @register_op("diff")
 def diff(x, n=1, axis=-1, prepend=None, append=None):
     return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@register_op("logcumsumexp")
+def logcumsumexp(x, axis=-1):
+    """Numerically-stable running logsumexp (ref: logcumsumexp in
+    ops.yaml) via an associative log-add-exp scan — O(log n) depth on the
+    VPU instead of the sequential CUDA scan."""
+    xf = x.astype(jnp.float32)
+    # jnp.logaddexp (not a hand-rolled max+log1p) -- it guards the
+    # -inf/-inf case that otherwise NaN-poisons the scan
+    out = jax.lax.associative_scan(jnp.logaddexp, xf, axis=axis)
+    return out.astype(x.dtype) if x.dtype != jnp.float32 else out
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(x, max_norm):
+    """ref: phi/kernels/impl/clip_by_norm_kernel_impl.h"""
+    n = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+    factor = jnp.where(n > max_norm, max_norm / jnp.maximum(n, 1e-12), 1.0)
+    return (x.astype(jnp.float32) * factor).astype(x.dtype)
+
+
+@register_op("renorm")
+def renorm(x, p, axis, max_norm):
+    """Clamp each slice along `axis` to p-norm <= max_norm (ref: renorm in
+    ops.yaml; torch-compatible semantics)."""
+    xf = x.astype(jnp.float32)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(xf) ** p, axis=reduce_axes,
+                    keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm,
+                       max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return (xf * factor).astype(x.dtype)
+
+
+@register_op("add_n")
+def add_n(inputs):
+    """Sum a list of same-shaped tensors (ref: add_n in ops.yaml)."""
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+def elementwise_pow(x, y):
+    """Alias kept for reference-API parity (legacy_ops.yaml)."""
+    return pow(x, y)

@@ -1014,3 +1014,113 @@ def affine_grid(theta, out_shape, align_corners=True):
     ones = jnp.ones_like(gx)
     base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
     return jnp.einsum("hwk,njk->nhwj", base, theta)
+
+
+@register_op("huber_loss", amp_policy="black")
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    """ref: phi/kernels/impl/huber_loss_kernel_impl.h"""
+    d = (input - label).astype(jnp.float32)
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def bce_loss(input, label, weight=None, reduction="mean"):
+    """Alias of binary_cross_entropy kept for ops.yaml name parity."""
+    return binary_cross_entropy(input, label, weight=weight,
+                                reduction=reduction)
+
+
+@register_op("rrelu")
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, key=None):
+    """Randomized leaky ReLU (ref: rrelu in ops.yaml): training samples
+    the negative slope per element from U(lower, upper); eval uses the
+    mean slope."""
+    if not training:
+        return jnp.where(x >= 0, x, x * ((lower + upper) / 2.0))
+    if key is None:
+        from ..core.generator import next_key
+        key = next_key()
+    slope = jax.random.uniform(key, x.shape, jnp.float32,
+                               minval=lower, maxval=upper).astype(x.dtype)
+    return jnp.where(x >= 0, x, x * slope)
+
+
+@register_op("hsigmoid_loss", amp_policy="black")
+def hsigmoid_loss(x, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None):
+    """Hierarchical sigmoid loss over the default complete binary tree
+    (ref: phi/kernels/cpu/hsigmoid_loss_kernel.cc + the SimpleCode scheme
+    in phi/kernels/funcs/matrix_bit_code.h: for class c the tree walk is
+    the binary expansion of c + num_classes).
+
+    x: [B, F]; label: [B]; weight: [num_classes - 1, F]; bias:
+    [num_classes - 1]. Custom trees pass path_table/path_code:
+    [B, max_depth] with -1 padding.
+    """
+    B = x.shape[0]
+    xf = x.astype(jnp.float32)
+    if path_table is None:
+        code = label.astype(jnp.int32) + num_classes
+        max_depth = int(np.floor(np.log2(max(num_classes, 2)))) + 1
+        ds = jnp.arange(max_depth)
+        length = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(
+            jnp.int32)
+        # node index at depth d (from the msb side): (code >> (len - d)) - 1
+        shift = jnp.maximum(length[:, None] - ds[None, :], 0)
+        node = (code[:, None] >> shift) - 1                 # [B, D]
+        bit = (code[:, None] >> jnp.maximum(shift - 1, 0)) & 1
+        valid = ds[None, :] < length[:, None]
+    else:
+        node = path_table.astype(jnp.int32)
+        bit = path_code.astype(jnp.int32)
+        valid = node >= 0
+    node = jnp.where(valid, node, 0)
+    w = weight[node]                                        # [B, D, F]
+    logits = jnp.einsum("bdf,bf->bd", w.astype(jnp.float32), xf)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)[node]
+    # BCE with target = bit
+    t = bit.astype(jnp.float32)
+    per = jnp.maximum(logits, 0) - logits * t + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    per = jnp.where(valid, per, 0.0)
+    return jnp.sum(per, axis=1, keepdims=True)
+
+
+@register_op("margin_cross_entropy", amp_policy="black")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False):
+    """ArcFace/CosFace-style margin softmax CE (ref:
+    phi/kernels/gpu/margin_cross_entropy_kernel.cu). logits are cosine
+    similarities in [-1, 1]; the target class logit cos(theta) becomes
+    cos(margin1*theta + margin2) - margin3 before scaling."""
+    lf = logits.astype(jnp.float32)
+    lbl = label.astype(jnp.int32).reshape(-1)
+    cos_t = jnp.clip(
+        jnp.take_along_axis(lf, lbl[:, None], axis=1)[:, 0], -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    cos_m = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.put_along_axis(lf, lbl[:, None], cos_m[:, None],
+                                  axis=1, inplace=False)
+    z = adjusted * scale
+    lse = jax.scipy.special.logsumexp(z, axis=1)
+    tgt = jnp.take_along_axis(z, lbl[:, None], axis=1)[:, 0]
+    loss = (lse - tgt)[:, None]
+    if return_softmax:
+        return loss, jax.nn.softmax(z, axis=1)
+    return loss
+
+
+@register_op("bilinear", amp_policy="white")
+def bilinear(x1, x2, weight, bias=None):
+    """out[b, o] = x1[b]^T W[o] x2[b] (ref: bilinear in ops.yaml)."""
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2,
+                     preferred_element_type=jnp.float32).astype(x1.dtype)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return out

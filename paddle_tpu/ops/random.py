@@ -137,3 +137,32 @@ def randn_like(x, dtype=None):
 def normal_like(x, mean=0.0, std=1.0):
     return Tensor._wrap(jax.random.normal(
         next_key(), tuple(x.shape), x._data.dtype) * std + mean)
+
+
+def binomial(count, prob):
+    """ref: binomial in ops.yaml (counts of successes)."""
+    c = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    shape = jnp.broadcast_shapes(jnp.shape(c), jnp.shape(p))
+    out = jax.random.binomial(next_key(), c.astype(jnp.float32),
+                              p.astype(jnp.float32), shape=shape)
+    return Tensor._wrap(out.astype(jnp.int32))  # x32 mode: int64 truncates
+
+
+def dirichlet(concentration):
+    a = (concentration._data if isinstance(concentration, Tensor)
+         else jnp.asarray(concentration))
+    return Tensor._wrap(jax.random.dirichlet(next_key(), a))
+
+
+def standard_gamma(alpha):
+    a = (alpha._data if isinstance(alpha, Tensor) else jnp.asarray(alpha))
+    return Tensor._wrap(jax.random.gamma(next_key(), a))
+
+
+def truncated_normal(shape, mean=0.0, std=1.0, a=-2.0, b=2.0, dtype=None):
+    """ref: truncated_gaussian_random in ops.yaml (resample outside
+    [a, b] std bounds)."""
+    out = jax.random.truncated_normal(
+        next_key(), a, b, _shape(shape), _dt(dtype))
+    return Tensor._wrap(out * std + mean)

@@ -1,0 +1,265 @@
+"""Sequence / decoding ops.
+
+TPU-native substitutions for the reference's dynloaded warpctc
+(/root/reference/paddle/phi/kernels/impl/warpctc_kernel_impl.h,
+backends/dynload/warpctc.cc), viterbi_decode
+(phi/kernels/cpu/viterbi_decode_kernel.cc), gather_tree, edit distance
+and top-p sampling kernels. All recurrences are `lax.scan`s over the time
+axis with static shapes — the XLA-compilable form of the CUDA kernels'
+per-timestep loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+_NEG = -1e30
+
+
+def _logaddexp(a, b):
+    # jnp.logaddexp: gradient-safe at the -1e30 floor (a hand-rolled
+    # max+log(exp+exp) produces 0/0 gradients there, which the TPU
+    # backward turns into NaN)
+    return jnp.logaddexp(a, b)
+
+
+@register_op("ctc_loss", amp_policy="black")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss via the log-space alpha recursion (ref: warpctc's
+    compute_ctc_loss, phi/kernels/impl/warpctc_kernel_impl.h:376; API
+    python/paddle/nn/functional/loss.py ctc_loss).
+
+    log_probs: [T, B, C] log-softmax outputs (raw logits are normalized
+    here, matching the reference's warpctc contract); labels: [B, L];
+    input_lengths, label_lengths: [B].
+    """
+    log_probs = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    labels = labels.astype(jnp.int32)
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    lab_len = label_lengths.astype(jnp.int32)
+    in_len = input_lengths.astype(jnp.int32)
+    s_len = 2 * lab_len + 1
+
+    # alpha transitions: from s, s-1 always; from s-2 iff ext[s] != blank
+    # and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    def emit(t):
+        # [B, S] log prob of emitting ext symbol at time t
+        return jnp.take_along_axis(log_probs[t], ext, axis=1)
+
+    alpha0 = jnp.full((B, S), _NEG)
+    alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lab_len > 0, emit(0)[:, 1], _NEG))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1)
+        a = _logaddexp(alpha, prev1)
+        a = jnp.where(can_skip, _logaddexp(a, prev2), a)
+        new = a + emit(t)
+        # frozen past each sequence's input length
+        new = jnp.where((t < in_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    last = jnp.take_along_axis(alpha, (s_len - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(
+        alpha, jnp.maximum(s_len - 2, 0)[:, None], axis=1)[:, 0]
+    ll = _logaddexp(last, jnp.where(lab_len > 0, last2, _NEG))
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # reference divides by label length before averaging
+        return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op("viterbi_decode")
+def viterbi_decode(potentials, transition, lengths,
+                   include_bos_eos_tag=True):
+    """CRF viterbi decode (ref: phi/kernels/cpu/viterbi_decode_kernel.cc;
+    API python/paddle/text/viterbi_decode.py).
+
+    potentials: [B, T, N]; transition: [N, N]; lengths: [B].
+    Returns (scores [B], paths [B, T]) — paths padded with 0 past length.
+    """
+    B, T, N = potentials.shape
+    lengths = lengths.astype(jnp.int32)
+    trans = transition.astype(jnp.float32)
+    pots = potentials.astype(jnp.float32)
+    if include_bos_eos_tag:
+        # tag N-2 = BOS, N-1 = EOS (reference convention)
+        start = pots[:, 0] + trans[N - 2][None, :]
+    else:
+        start = pots[:, 0]
+
+    def step(carry, t):
+        score = carry                                  # [B, N]
+        cand = score[:, :, None] + trans[None, :, :]   # [B, from, to]
+        best = jnp.max(cand, axis=1) + pots[:, t]
+        back = jnp.argmax(cand, axis=1)                # [B, N]
+        live = (t < lengths)[:, None]
+        return jnp.where(live, best, score), jnp.where(
+            live, back, jnp.arange(N)[None, :])
+
+    score, backs = jax.lax.scan(step, start, jnp.arange(1, T))
+    if include_bos_eos_tag:
+        final = score + trans[:, N - 1][None, :]
+    else:
+        final = score
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)
+    best_score = jnp.max(final, axis=1)
+
+    def backtrace(carry, back_t):
+        tag = carry                                    # [B]
+        prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+        return prev.astype(jnp.int32), tag
+
+    first_tag, path_rev = jax.lax.scan(backtrace, last_tag, backs,
+                                       reverse=True)
+    # reverse scan stacks outputs at original positions: path_rev[t] is
+    # the tag at time t+1; the final carry is the tag at time 0.
+    paths = jnp.concatenate(
+        [first_tag[:, None], path_rev.transpose(1, 0)], axis=1)  # [B, T]
+    # mask out positions past each length (reference pads with 0)
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    return best_score, jnp.where(mask, paths, 0)
+
+
+@register_op("gather_tree")
+def gather_tree(ids, parents):
+    """Beam-search ancestry backtrace (ref: phi/kernels/cpu/
+    gather_tree_kernel.cc). ids, parents: [max_time, batch, beam]."""
+    T, B, W = ids.shape
+
+    def step(carry, t_in):
+        beam_of = carry
+        id_t, par_t = t_in
+        out = jnp.take_along_axis(id_t, beam_of, axis=1)
+        nxt = jnp.take_along_axis(par_t, beam_of, axis=1)
+        return nxt.astype(parents.dtype), out
+
+    init = jnp.broadcast_to(jnp.arange(W, dtype=parents.dtype), (B, W))
+    _, out_rev = jax.lax.scan(step, init, (ids, parents), reverse=True)
+    return out_rev
+
+
+@register_op("top_p_sampling")
+def top_p_sampling(x, ps, seed=None, key=None):
+    """Nucleus sampling (ref: phi/kernels/gpu/top_p_sampling_kernel.cu).
+    x: [B, V] probabilities; ps: [B] cumulative-probability thresholds.
+    Returns (sampled probs [B, 1], ids [B, 1])."""
+    B, V = x.shape
+    if key is None:
+        if seed is not None and seed >= 0:
+            key = jax.random.PRNGKey(seed)
+        else:
+            from ..core.generator import next_key
+            key = next_key()
+    probs = x.astype(jnp.float32)
+    sorted_p, order = jax.lax.top_k(probs, V)
+    csum = jnp.cumsum(sorted_p, axis=-1)
+    # keep the smallest prefix whose mass exceeds ps (always >= 1 token)
+    keep = (csum - sorted_p) < ps[:, None]
+    masked = jnp.where(keep, sorted_p, 0.0)
+    gumbel = -jnp.log(-jnp.log(
+        jax.random.uniform(key, masked.shape, minval=1e-20, maxval=1.0)))
+    pick = jnp.argmax(jnp.where(keep, jnp.log(
+        jnp.maximum(masked, 1e-30)) + gumbel, -jnp.inf), axis=-1)
+    ids = jnp.take_along_axis(order, pick[:, None], axis=1)
+    pval = jnp.take_along_axis(probs, ids, axis=1)
+    return pval, ids.astype(jnp.int64)
+
+
+@register_op("edit_distance")
+def edit_distance(hyps, refs, hyp_lengths=None, ref_lengths=None,
+                  normalized=True):
+    """Levenshtein distance (ref: phi/kernels/impl/edit_distance_kernel_impl.h).
+    hyps: [B, L1] int tokens; refs: [B, L2]; lengths optional [B].
+    Returns (distance [B, 1] float, sequence_num [1])."""
+    B, L1 = hyps.shape
+    L2 = refs.shape[1]
+    if hyp_lengths is None:
+        hyp_lengths = jnp.full((B,), L1, jnp.int32)
+    if ref_lengths is None:
+        ref_lengths = jnp.full((B,), L2, jnp.int32)
+    hyp_lengths = hyp_lengths.astype(jnp.int32)
+    ref_lengths = ref_lengths.astype(jnp.int32)
+    big = jnp.float32(1e9)
+
+    # DP over hypothesis tokens; row = distances against ref prefix
+    row0 = jnp.broadcast_to(
+        jnp.arange(L2 + 1, dtype=jnp.float32), (B, L2 + 1))
+
+    def step(row, i):
+        h_tok = jnp.take_along_axis(
+            hyps, jnp.minimum(i, L1 - 1)[None].repeat(B)[:, None],
+            axis=1)[:, 0]
+        sub_cost = (refs != h_tok[:, None]).astype(jnp.float32)  # [B, L2]
+        # new_row[0] = i+1; new_row[j] = min(row[j]+1, new_row[j-1]+1,
+        #                                    row[j-1]+sub)
+        del_cost = row[:, 1:] + 1.0
+        sub = row[:, :-1] + sub_cost
+        base = jnp.minimum(del_cost, sub)
+        first = (i + 1).astype(jnp.float32)
+
+        def inner(carry, cols):
+            b, s = cols
+            v = jnp.minimum(b, carry + 1.0)
+            return v, v
+
+        _, rest = jax.lax.scan(
+            inner, jnp.full((B,), 0.0) + first,
+            (base.transpose(1, 0), sub.transpose(1, 0)))
+        new = jnp.concatenate(
+            [jnp.full((B, 1), first), rest.transpose(1, 0)], axis=1)
+        live = (i < hyp_lengths)[:, None]
+        return jnp.where(live, new, row), None
+
+    row, _ = jax.lax.scan(step, row0, jnp.arange(L1))
+    dist = jnp.take_along_axis(row, ref_lengths[:, None], axis=1)[:, 0]
+    if normalized:
+        dist = dist / jnp.maximum(ref_lengths.astype(jnp.float32), 1.0)
+    return dist[:, None], jnp.asarray([B], jnp.int32)
+
+
+@register_op("class_center_sample")
+def class_center_sample(label, num_classes, num_samples, seed=None):
+    """Partial-FC class-center sampling (ref: phi/kernels/gpu/
+    class_center_sample_kernel.cu): keep all positive classes, fill up to
+    num_samples with random negatives, remap labels into the sampled
+    index space. Static-shape rendering: the sampled set is always
+    exactly num_samples wide (the CUDA kernel's variable count is padded
+    with unused negatives)."""
+    from ..core.generator import next_key
+    key = jax.random.PRNGKey(seed) if seed is not None else next_key()
+    label = label.astype(jnp.int32)
+    pos = jnp.zeros((num_classes,), jnp.bool_).at[label].set(True)
+    # rank positives first (stable), then shuffled negatives
+    noise = jax.random.uniform(key, (num_classes,))
+    rank_key = jnp.where(pos, -1.0, noise)
+    order = jnp.argsort(rank_key)                   # positives lead
+    sampled = order[:num_samples]                   # [num_samples]
+    # remap: class c -> its position in `sampled` (positives only)
+    inv = jnp.full((num_classes,), -1, jnp.int32)
+    inv = inv.at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
+    remapped = inv[label]
+    return remapped, sampled.astype(jnp.int64)

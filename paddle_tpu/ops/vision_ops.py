@@ -1,0 +1,521 @@
+"""Vision / detection op long tail.
+
+TPU-native substitutions for the reference's CUDA detection kernels
+(/root/reference/paddle/phi/kernels/gpu/{roi_pool,psroi_pool,prior_box,
+yolo_box,matrix_nms,multiclass_nms3,deformable_conv}_kernel.*,
+python/paddle/vision/ops.py). Design rule: every op compiles to static
+shapes (fixed-size outputs with validity masks / -1 padding) so the whole
+pipeline stays inside one XLA program — no dynamic result counts, which is
+how the CUDA versions communicate results.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+from .nn_ops import _conv, _norm_tuple, _conv_padding
+
+
+# ======================= conv variants =======================
+
+@register_op("depthwise_conv2d", amp_policy="white")
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     data_format="NCHW"):
+    """groups == in_channels convolution (ref: phi depthwise_conv2d;
+    XLA maps feature_group_count straight onto the MXU)."""
+    channels = x.shape[-1 if data_format[-1] == "C" else 1]
+    return _conv(x, weight, bias, stride, padding, dilation, channels,
+                 data_format)
+
+
+@register_op("conv3d_transpose", amp_policy="white")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    """3-D fractionally-strided conv (ref: conv3d_transpose in ops.yaml;
+    same lhs_dilation rendering as the 2-D variant)."""
+    n = 3
+    channel_last = data_format[-1] == "C"
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _conv_padding(padding, n)
+    outpad = _norm_tuple(output_padding, n)
+    kernel = jnp.swapaxes(weight, 0, 1) if not channel_last else weight
+    if isinstance(pad, str):
+        lax_pad = pad
+    else:
+        lax_pad = []
+        for i, (lo, hi) in enumerate(pad):
+            k = (kernel.shape[2 + i] - 1) * dilation[i]
+            lax_pad.append((k - lo, k - hi + outpad[i]))
+    from .nn_ops import _conv_dn
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, kernel.shape, _conv_dn(x.ndim, channel_last))
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(kernel, (-1, -2, -3)),
+        window_strides=(1, 1, 1),
+        padding=lax_pad,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        bshape = [1] * x.ndim
+        bshape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@register_op("deformable_conv", amp_policy="white")
+def deformable_conv(x, offset, weight, mask=None, bias=None, stride=1,
+                    padding=0, dilation=1, deformable_groups=1, groups=1):
+    """Deformable conv v1/v2 (ref: phi/kernels/impl/deformable_conv_kernel_impl.h).
+
+    TPU rendering: instead of the CUDA per-pixel im2col gather, each of the
+    kh*kw kernel taps becomes one bilinear `grid_sample` over the input at
+    (base + tap + learned offset), and the weighted sum over taps is an
+    einsum — everything static-shape and MXU-friendly.
+    x: [N, C, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo];
+    mask (v2): [N, dg*kh*kw, Ho, Wo]; weight: [Co, C/groups, kh, kw].
+    """
+    from ..nn import functional as _F  # registers grid_sample
+    from .registry import OPS
+    grid_sample = OPS["grid_sample"].fn  # raw jnp fn, not the dispatcher
+    N, C, H, W = x.shape
+    Co, _, kh, kw = weight.shape
+    stride = _norm_tuple(stride, 2)
+    dilation = _norm_tuple(dilation, 2)
+    pad = _conv_padding(padding, 2)
+    Ho = (H + pad[0][0] + pad[0][1] - dilation[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (W + pad[1][0] + pad[1][1] - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+    dg = deformable_groups
+    off = offset.reshape(N, dg, kh * kw, 2, Ho, Wo)
+    base_y = (jnp.arange(Ho) * stride[0] - pad[0][0])[:, None]
+    base_x = (jnp.arange(Wo) * stride[1] - pad[1][0])[None, :]
+    cols = []
+    for t in range(kh * kw):
+        ky, kx = t // kw, t % kw
+        # sampling positions per deformable group: [N, dg, Ho, Wo]
+        py = base_y + ky * dilation[0] + off[:, :, t, 0]
+        px = base_x + kx * dilation[1] + off[:, :, t, 1]
+        # normalize to [-1, 1] for grid_sample (align_corners=True)
+        gy = 2.0 * py / jnp.maximum(H - 1, 1) - 1.0
+        gx = 2.0 * px / jnp.maximum(W - 1, 1) - 1.0
+        grid = jnp.stack([gx, gy], axis=-1)           # [N, dg, Ho, Wo, 2]
+        per_g = C // dg
+        xg = x.reshape(N, dg, per_g, H, W)
+        samp = jax.vmap(jax.vmap(
+            lambda img, g: grid_sample(img[None], g[None],
+                                       mode="bilinear",
+                                       padding_mode="zeros",
+                                       align_corners=True)[0]))(
+            xg, grid)                                  # [N, dg, per_g, Ho, Wo]
+        if mask is not None:
+            m = mask.reshape(N, dg, kh * kw, Ho, Wo)[:, :, t]
+            samp = samp * m[:, :, None]
+        cols.append(samp.reshape(N, C, Ho, Wo))
+    col = jnp.stack(cols, axis=2)                      # [N, C, kh*kw, Ho, Wo]
+    wf = weight.reshape(Co, groups, C // groups * kh * kw) \
+        if groups > 1 else weight.reshape(Co, C * kh * kw)
+    if groups == 1:
+        colf = col.reshape(N, C * kh * kw, Ho * Wo)
+        out = jnp.einsum("ok,nkp->nop", wf, colf,
+                         preferred_element_type=jnp.float32)
+    else:
+        colg = col.reshape(N, groups, (C // groups) * kh * kw, Ho * Wo)
+        wg = weight.reshape(groups, Co // groups, (C // groups) * kh * kw)
+        out = jnp.einsum("gok,ngkp->ngop", wg, colg,
+                         preferred_element_type=jnp.float32)
+    out = out.reshape(N, Co, Ho, Wo).astype(x.dtype)
+    if bias is not None:
+        out = out + bias.reshape(1, Co, 1, 1)
+    return out
+
+
+# ======================= fold / unpool =======================
+
+@register_op("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — inverse of unfold (ref: phi/kernels/impl/fold_kernel_impl.h).
+    x: [N, C*kh*kw, L] -> [N, C, H, W] via scatter-add of patch columns."""
+    kh, kw = _norm_tuple(kernel_sizes, 2)
+    sh, sw = _norm_tuple(strides, 2)
+    dh, dw = _norm_tuple(dilations, 2)
+    pad = _conv_padding(paddings, 2)
+    H, W = _norm_tuple(output_sizes, 2)
+    N, CKK, L = x.shape
+    C = CKK // (kh * kw)
+    Hp, Wp = H + pad[0][0] + pad[0][1], W + pad[1][0] + pad[1][1]
+    Lh = (Hp - dh * (kh - 1) - 1) // sh + 1
+    Lw = (Wp - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(N, C, kh, kw, Lh, Lw)
+    out = jnp.zeros((N, C, Hp, Wp), x.dtype)
+    ph = jnp.arange(Lh) * sh
+    pw = jnp.arange(Lw) * sw
+    for iy in range(kh):
+        for ix in range(kw):
+            ys = ph + iy * dh                     # [Lh]
+            xs = pw + ix * dw                     # [Lw]
+            out = out.at[:, :, ys[:, None], xs[None, :]].add(
+                cols[:, :, iy, ix])
+    return out[:, :, pad[0][0]:Hp - pad[0][1], pad[1][0]:Wp - pad[1][1]]
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    """Max pool returning flat argmax indices (ref: pool2d_with_index in
+    ops.yaml; feeds unpool). Patch-extraction rendering so the argmax is a
+    plain reduction over a static window axis."""
+    kh, kw = _norm_tuple(kernel_size, 2)
+    sh, sw = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    pad = _conv_padding(padding, 2)
+    N, C, H, W = x.shape
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    xp = jnp.pad(x, ((0, 0), (0, 0), pad[0], pad[1]), constant_values=neg)
+    Hp, Wp = xp.shape[2:]
+    Ho = (Hp - kh) // sh + 1
+    Wo = (Wp - kw) // sw + 1
+    iy = jnp.arange(Ho) * sh
+    ix = jnp.arange(Wo) * sw
+    wy = jnp.arange(kh)
+    wx = jnp.arange(kw)
+    rows = iy[:, None, None, None] + wy[None, None, :, None]  # [Ho,1,kh,1]
+    colx = ix[None, :, None, None] + wx[None, None, None, :]  # [1,Wo,1,kw]
+    patches = xp[:, :, rows, colx]              # [N, C, Ho, Wo, kh, kw]
+    flat = patches.reshape(N, C, Ho, Wo, kh * kw)
+    arg = jnp.argmax(flat, axis=-1)
+    out = jnp.max(flat, axis=-1)
+    # flat index into the UNPADDED input, matching the reference contract
+    yy = jnp.broadcast_to(rows, (Ho, Wo, kh, kw)).reshape(Ho, Wo, kh * kw)
+    xx = jnp.broadcast_to(colx, (Ho, Wo, kh, kw)).reshape(Ho, Wo, kh * kw)
+    pick = lambda grid: jnp.take_along_axis(
+        jnp.broadcast_to(grid, (N, C, Ho, Wo, kh * kw)),
+        arg[..., None], axis=-1)[..., 0]
+    gy = pick(yy) - pad[0][0]
+    gx = pick(xx) - pad[1][0]
+    idx = (gy * W + gx).astype(jnp.int64)
+    return out, idx
+
+
+@register_op("unpool")
+def unpool(x, indices, kernel_size=2, stride=None, padding=0,
+           output_size=None):
+    """max_unpool2d: scatter pooled values back to their argmax positions
+    (ref: phi/kernels/gpu/unpool_kernel.cu)."""
+    N, C, Ho, Wo = x.shape
+    if output_size is None:
+        kh, kw = _norm_tuple(kernel_size, 2)
+        sh, sw = _norm_tuple(stride if stride is not None else kernel_size, 2)
+        pad = _conv_padding(padding, 2)
+        H = (Ho - 1) * sh - pad[0][0] - pad[0][1] + kh
+        W = (Wo - 1) * sw - pad[1][0] - pad[1][1] + kw
+    else:
+        H, W = output_size[-2:]
+    flat = jnp.zeros((N, C, H * W), x.dtype)
+    idx = indices.reshape(N, C, Ho * Wo).astype(jnp.int32)
+    vals = x.reshape(N, C, Ho * Wo)
+    flat = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return flat.reshape(N, C, H, W)
+
+
+# ======================= roi pooling =======================
+
+def _img_of_roi(boxes_num, N, R):
+    if boxes_num is None:
+        return jnp.zeros((R,), jnp.int32)
+    return jnp.repeat(jnp.arange(N), boxes_num.astype(jnp.int32),
+                      total_repeat_length=R)
+
+
+@register_op("roi_pool")
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
+    """RoI max pooling (ref: phi/kernels/gpu/roi_pool_kernel.cu).
+
+    Exact quantized-bin semantics, rendered statically: instead of the CUDA
+    kernel's variable-size bin loops, every input pixel computes which bin
+    it falls in and each bin max-reduces a full-image mask — O(H*W) per
+    bin but branch-free and fully vectorized.
+    x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2) in input scale;
+    boxes_num: [N] rois per image (defaults to all rois on image 0).
+    """
+    oh, ow = _norm_tuple(output_size, 2)
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    img = _img_of_roi(boxes_num, N, R)
+    scaled = jnp.round(boxes * spatial_scale)
+    x1 = scaled[:, 0]
+    y1 = scaled[:, 1]
+    rw = jnp.maximum(scaled[:, 2] - x1 + 1, 1.0)
+    rh = jnp.maximum(scaled[:, 3] - y1 + 1, 1.0)
+
+    def one_roi(imgx, rx1, ry1, bw, bh):
+        py = jnp.arange(H, dtype=jnp.float32)
+        px = jnp.arange(W, dtype=jnp.float32)
+        # bin boundaries: pixel p belongs to bin i iff
+        # floor(i*bh/oh) <= p - y1 < ceil((i+1)*bh/oh)
+        i_idx = jnp.arange(oh, dtype=jnp.float32)
+        j_idx = jnp.arange(ow, dtype=jnp.float32)
+        y_lo = ry1 + jnp.floor(i_idx * bh / oh)
+        y_hi = ry1 + jnp.ceil((i_idx + 1) * bh / oh)
+        x_lo = rx1 + jnp.floor(j_idx * bw / ow)
+        x_hi = rx1 + jnp.ceil((j_idx + 1) * bw / ow)
+        my = (py[None, :] >= jnp.clip(y_lo, 0, H)[:, None]) & (
+            py[None, :] < jnp.clip(y_hi, 0, H)[:, None])      # [oh, H]
+        mx = (px[None, :] >= jnp.clip(x_lo, 0, W)[:, None]) & (
+            px[None, :] < jnp.clip(x_hi, 0, W)[:, None])      # [ow, W]
+        neg = jnp.asarray(-jnp.inf, imgx.dtype)
+        rows = jnp.where(my[None, :, :, None], imgx[:, None, :, :], neg)
+        rowmax = jnp.max(rows, axis=2)                        # [C, oh, W]
+        cols = jnp.where(mx[None, None, :, :], rowmax[:, :, None, :], neg)
+        out = jnp.max(cols, axis=-1)                          # [C, oh, ow]
+        return jnp.where(jnp.isneginf(out), 0.0, out)
+
+    return jax.vmap(one_roi)(x[img], x1, y1, rw, rh)
+
+
+@register_op("psroi_pool")
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
+    """Position-sensitive RoI average pooling (ref:
+    phi/kernels/gpu/psroi_pool_kernel.cu): output channel c at bin (i, j)
+    averages input channel c*oh*ow + i*ow + j over that bin. Same exact
+    masked-reduction rendering as roi_pool (sum/count instead of max)."""
+    oh, ow = _norm_tuple(output_size, 2)
+    N, C, H, W = x.shape
+    Co = C // (oh * ow)
+    R = boxes.shape[0]
+    img = _img_of_roi(boxes_num, N, R)
+    scaled = boxes * spatial_scale
+    x1 = scaled[:, 0]
+    y1 = scaled[:, 1]
+    rw = jnp.maximum(scaled[:, 2] - x1, 0.1)
+    rh = jnp.maximum(scaled[:, 3] - y1, 0.1)
+
+    def one_roi(imgx, rx1, ry1, bw, bh):
+        py = jnp.arange(H, dtype=jnp.float32)
+        px = jnp.arange(W, dtype=jnp.float32)
+        i_idx = jnp.arange(oh, dtype=jnp.float32)
+        j_idx = jnp.arange(ow, dtype=jnp.float32)
+        y_lo = jnp.floor(ry1 + i_idx * bh / oh)
+        y_hi = jnp.ceil(ry1 + (i_idx + 1) * bh / oh)
+        x_lo = jnp.floor(rx1 + j_idx * bw / ow)
+        x_hi = jnp.ceil(rx1 + (j_idx + 1) * bw / ow)
+        my = ((py[None, :] >= jnp.clip(y_lo, 0, H)[:, None]) &
+              (py[None, :] < jnp.clip(y_hi, 0, H)[:, None])).astype(
+                  imgx.dtype)                                  # [oh, H]
+        mx = ((px[None, :] >= jnp.clip(x_lo, 0, W)[:, None]) &
+              (px[None, :] < jnp.clip(x_hi, 0, W)[:, None])).astype(
+                  imgx.dtype)                                  # [ow, W]
+        ps = imgx.reshape(Co, oh, ow, H, W)
+        # pick each output bin's own channel slice, then masked average
+        sums = jnp.einsum("cijhw,ih,jw->cij", ps, my, mx)
+        cnt = jnp.maximum(jnp.einsum("ih,jw->ij", my, mx), 1.0)
+        return sums / cnt[None]
+
+    return jax.vmap(one_roi)(x[img], x1, y1, rw, rh)
+
+
+# ======================= anchors / decode =======================
+
+@register_op("prior_box")
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    """SSD prior boxes (ref: phi/kernels/impl/prior_box_kernel_impl.h) —
+    pure anchor math, no data dependence."""
+    fh, fw = input.shape[-2:]
+    ih, iw = image.shape[-2:]
+    step_h = steps[1] if steps[1] > 0 else ih / fh
+    step_w = steps[0] if steps[0] > 0 else iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes_per = []
+    for ms in min_sizes:
+        boxes_per.append((ms, ms))
+        if min_max_aspect_ratios_order and max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            boxes_per.append((float(np.sqrt(ms * mx)),) * 2)
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes_per.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes and not min_max_aspect_ratios_order:
+            mx = max_sizes[min_sizes.index(ms)]
+            boxes_per.append((float(np.sqrt(ms * mx)),) * 2)
+    wh = jnp.asarray(boxes_per, jnp.float32)          # [P, 2]
+    cx = (jnp.arange(fw) + offset) * step_w
+    cy = (jnp.arange(fh) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                   # [fh, fw]
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]      # [fh, fw, 1, 2]
+    half = wh[None, None] / 2.0                       # [1, 1, P, 2]
+    mins = (c - half) / jnp.asarray([iw, ih], jnp.float32)
+    maxs = (c + half) / jnp.asarray([iw, ih], jnp.float32)
+    out = jnp.concatenate([mins, maxs], -1)           # [fh, fw, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), out.shape)
+    return out, var
+
+
+@register_op("yolo_box")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head predictions to boxes + scores (ref:
+    phi/kernels/gpu/yolo_box_kernel.cu). Elementwise math only."""
+    N, _, H, W = x.shape
+    na = len(anchors) // 2
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    attrs = 5 + class_num + (1 if iou_aware else 0)
+    p = x.reshape(N, na, attrs, H, W)
+    if iou_aware:
+        ioup = jax.nn.sigmoid(p[:, :, 0])
+        p = p[:, :, 1:]
+    gx = jnp.arange(W, dtype=jnp.float32)
+    gy = jnp.arange(H, dtype=jnp.float32)
+    bx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+          - 0.5 * (scale_x_y - 1.0) + gx[None, None, None, :]) / W
+    by = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+          - 0.5 * (scale_x_y - 1.0) + gy[None, None, :, None]) / H
+    in_w = downsample_ratio * W
+    in_h = downsample_ratio * H
+    bw = jnp.exp(p[:, :, 2]) * aw[None, :, None, None] / in_w
+    bh = jnp.exp(p[:, :, 3]) * ah[None, :, None, None] / in_h
+    conf = jax.nn.sigmoid(p[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+    cls = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+    keep = conf > conf_thresh
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imw - 1)
+        y1 = jnp.clip(y1, 0.0, imh - 1)
+        x2 = jnp.clip(x2, 0.0, imw - 1)
+        y2 = jnp.clip(y2, 0.0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1)       # [N, na, H, W, 4]
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    cls = jnp.where(keep[:, :, None], cls, 0.0)   # [N, na, cls, H, W]
+    boxes = boxes.reshape(N, na * H * W, 4)
+    scores = cls.transpose(0, 1, 3, 4, 2).reshape(N, na * H * W, class_num)
+    return boxes, scores
+
+
+def _iou_matrix(a, b):
+    """[Na, 4] x [Nb, 4] (x1,y1,x2,y2) -> [Na, Nb] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+@register_op("matrix_nms")
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=100, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, normalized=True):
+    """SOLOv2 matrix NMS (ref: phi/kernels/impl/matrix_nms_kernel_impl.h):
+    decay each box's score by its IoU with higher-scoring same-class boxes
+    — one dense IoU matrix instead of sequential suppression.
+    bboxes: [N, M, 4]; scores: [N, C, M]. Returns [N, keep_top_k, 6]
+    (class, score, box) with -1 padding and per-image counts."""
+    N, C, M = scores.shape
+
+    def one_image(boxes, sc):
+        flat_sc = sc.reshape(C * M)
+        cls_of = jnp.arange(C * M) // M
+        box_of = jnp.arange(C * M) % M
+        top_sc, top_i = jax.lax.top_k(flat_sc, min(C * M, nms_top_k * C))
+        tcls = cls_of[top_i]
+        tbox = boxes[box_of[top_i]]
+        valid = top_sc > score_threshold
+        iou = _iou_matrix(tbox, tbox)
+        same = (tcls[:, None] == tcls[None, :])
+        # scores arrive sorted desc, so "higher-scoring than i" = j < i
+        higher = (jnp.arange(iou.shape[0])[:, None]
+                  > jnp.arange(iou.shape[0])[None, :]) & valid[None, :]
+        f = ((lambda t: jnp.exp(-(t ** 2) / gaussian_sigma))
+             if use_gaussian else (lambda t: 1.0 - t))
+        # compensation: each suppressor j's own max-IoU with ITS suppressors
+        cmax = jnp.max(jnp.where(same & higher, iou, 0.0), axis=1)
+        ratio = f(iou) / jnp.maximum(f(cmax)[None, :], 1e-10)
+        decay = jnp.min(jnp.where(same & higher, ratio, jnp.inf), axis=1)
+        decay = jnp.where(jnp.isinf(decay), 1.0, jnp.minimum(decay, 1.0))
+        dec_sc = jnp.where(valid, top_sc * decay, -1.0)
+        dec_sc = jnp.where(dec_sc > post_threshold, dec_sc, -1.0)
+        kk = min(keep_top_k, dec_sc.shape[0])
+        out_sc, keep = jax.lax.top_k(dec_sc, kk)
+        ok = out_sc > 0
+        out = jnp.concatenate([
+            jnp.where(ok, tcls[keep], -1).astype(jnp.float32)[:, None],
+            jnp.where(ok, out_sc, -1.0)[:, None],
+            jnp.where(ok[:, None], tbox[keep], -1.0)], axis=1)
+        if kk < keep_top_k:  # fixed-size contract: pad with -1 rows
+            out = jnp.concatenate(
+                [out, jnp.full((keep_top_k - kk, 6), -1.0)], axis=0)
+        return out, jnp.sum(ok)
+
+    return jax.vmap(one_image)(bboxes, scores)
+
+
+@register_op("multiclass_nms")
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=100,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=-1):
+    """Per-class hard NMS with static [N, keep_top_k, 6] output (ref:
+    multiclass_nms3 in ops.yaml; CUDA does dynamic result counts, the TPU
+    rendering pads with -1). bboxes: [N, M, 4]; scores: [N, C, M]."""
+    N, C, M = scores.shape
+
+    def nms_one_class(boxes, sc):
+        k = min(nms_top_k, M)
+        top_sc, order = jax.lax.top_k(sc, k)
+        b = boxes[order]
+        iou = _iou_matrix(b, b)
+
+        def body(i, keep):
+            sup = (iou[i] > nms_threshold) & keep[i] & (
+                jnp.arange(k) > i)
+            return keep & ~sup
+        keep = jax.lax.fori_loop(0, k, body,
+                                 top_sc > score_threshold)
+        return jnp.where(keep, top_sc, -1.0), order
+
+    def one_image(boxes, sc):
+        per_cls_sc, per_cls_ord = jax.vmap(
+            lambda s: nms_one_class(boxes, s))(sc)   # [C, k]
+        if background_label >= 0:
+            per_cls_sc = per_cls_sc.at[background_label].set(-1.0)
+        flat_sc = per_cls_sc.reshape(-1)
+        flat_ord = per_cls_ord.reshape(-1)
+        cls_of = jnp.arange(flat_sc.shape[0]) // per_cls_sc.shape[1]
+        kk = min(keep_top_k, flat_sc.shape[0])
+        out_sc, sel = jax.lax.top_k(flat_sc, kk)
+        ok = out_sc > 0
+        sel_box = boxes[flat_ord[sel]]
+        out = jnp.concatenate([
+            jnp.where(ok, cls_of[sel], -1).astype(jnp.float32)[:, None],
+            jnp.where(ok, out_sc, -1.0)[:, None],
+            jnp.where(ok[:, None], sel_box, -1.0)], axis=1)
+        if kk < keep_top_k:  # fixed-size contract: pad with -1 rows
+            out = jnp.concatenate(
+                [out, jnp.full((keep_top_k - kk, 6), -1.0)], axis=0)
+        return out, jnp.sum(ok)
+
+    return jax.vmap(one_image)(bboxes, scores)

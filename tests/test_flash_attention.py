@@ -163,3 +163,25 @@ def test_fwd_bwd_tpu_compiled():
     for a, b in zip(gp, gx):
         rel = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
         assert rel < 1e-2, rel
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled Pallas path needs TPU")
+def test_bwd_tpu_bf16_multi_kblock_partials():
+    # seq 2048 -> multiple k-blocks -> the dq partial-sum path runs with
+    # bf16-quantized partials; bound the added rounding error vs XLA
+    q, k, v = _make(b=1, s=2048, h=2, dtype=jnp.bfloat16, seed=3)
+    sc = 1.0 / np.sqrt(q.shape[-1])
+
+    def f_p(q, k, v):
+        return fa._flash_core(q, k, v, True, sc, True).astype(
+            jnp.float32).sum()
+
+    def f_x(q, k, v):
+        return fa._xla_attention(q, k, v, None, True, sc).astype(
+            jnp.float32).sum()
+
+    dq_p = jax.grad(f_p)(q, k, v)
+    dq_x = jax.grad(f_x)(q, k, v)
+    rel = float(jnp.abs(dq_p - dq_x).max() / (jnp.abs(dq_x).max() + 1e-9))
+    assert rel < 2e-2, rel

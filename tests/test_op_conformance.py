@@ -376,6 +376,16 @@ class TestRegistryCoverage:
         "fused_rotary_position_embedding", "expand", "broadcast_to",
         "slice_op", "getitem", "setitem", "full_like", "ones_like",
         "zeros_like", "assign",
+        # covered by tests/test_ops_vision_seq.py
+        "depthwise_conv2d", "conv3d_transpose", "deformable_conv", "fold",
+        "max_pool2d_with_index", "unpool", "roi_pool", "psroi_pool",
+        "prior_box", "yolo_box", "matrix_nms", "multiclass_nms",
+        "ctc_loss", "viterbi_decode", "gather_tree", "top_p_sampling",
+        "edit_distance", "class_center_sample", "huber_loss",
+        "hsigmoid_loss", "margin_cross_entropy", "logcumsumexp", "renorm",
+        "clip_by_norm", "p_norm", "add_n", "unstack", "fill_diagonal",
+        "lu", "lu_unpack", "spectral_norm", "rrelu", "bilinear",
+        "send_u_recv", "send_ue_recv", "send_uv", "segment_pool",
     }
 
     def test_coverage_accounting(self):
