@@ -64,7 +64,7 @@ def __getattr__(name):
         mod = importlib.import_module(".sparse", __name__)
         globals()["sparse"] = mod
         return mod
-    if name in ("fft", "signal", "quantization", "geometric"):
+    if name in ("fft", "signal", "quantization", "geometric", "audio", "text"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

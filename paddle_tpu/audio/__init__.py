@@ -1,0 +1,21 @@
+"""paddle.audio parity: feature extraction layers + functional.
+
+TPU-native build of the reference's audio stack
+(/root/reference/python/paddle/audio/functional/functional.py,
+features/layers.py): mel/DCT matrices are precomputed host-side once
+(numpy) and the per-utterance pipeline (STFT -> |.|^p -> fbank matmul ->
+log/dB -> DCT) is pure jnp, so whole-batch feature extraction compiles to
+a single XLA program — the matmul-with-fbank form maps onto the MXU
+instead of the reference's per-bin CUDA loops.
+
+Dataset/backends (paddle.audio.datasets, .backends) are out of scope:
+they are IO wrappers around soundfile, which this image does not ship.
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from .features import (  # noqa: F401
+    Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC,
+)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

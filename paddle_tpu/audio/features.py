@@ -1,0 +1,107 @@
+"""Audio feature layers (ref: python/paddle/audio/features/layers.py).
+
+Each layer precomputes its window / fbank / DCT matrices at construction
+(host-side numpy) and registers them as buffers; forward is pure jnp
+(STFT -> power -> matmul -> log), so batched feature extraction fuses
+into one XLA program with the fbank/DCT applications on the MXU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "fft_window", AF.get_window(window, self.win_length,
+                                        fftbins=True, dtype=dtype))
+
+    def forward(self, x):
+        from ..signal import stft
+        spec = stft(x, self.n_fft, hop_length=self.hop_length,
+                    win_length=self.win_length, window=self.fft_window,
+                    center=self.center, pad_mode=self.pad_mode)
+        data = spec._data if isinstance(spec, Tensor) else spec
+        mag = jnp.abs(data)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor._wrap(mag)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.register_buffer(
+            "fbank_matrix",
+            AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                    norm, dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)          # [..., n_bins, frames]
+        fb = self.fbank_matrix._data
+        return Tensor._wrap(jnp.einsum(
+            "mb,...bt->...mt", fb, spec._data))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, ref_value=self.ref_value,
+                              amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct_matrix",
+                             AF.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)   # [..., n_mels, frames]
+        dct = self.dct_matrix._data            # [n_mels, n_mfcc]
+        return Tensor._wrap(jnp.einsum(
+            "mk,...mt->...kt", dct, logmel._data))

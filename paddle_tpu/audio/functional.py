@@ -1,0 +1,202 @@
+"""Audio functional ops (ref: python/paddle/audio/functional/functional.py
+and window.py). Formulas are the standard (librosa/HTK) mel & DCT math."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap(x):
+    return Tensor._wrap(jnp.asarray(x))
+
+
+def hz_to_mel(freq, htk=False):
+    """Hz -> mel. htk=True: 2595*log10(1+f/700); else Slaney (linear
+    below 1 kHz, log above)."""
+    scalar = not (isinstance(freq, Tensor) or hasattr(freq, "shape"))
+    f = jnp.asarray(_unwrap(freq), jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep,
+                        mel)
+    return float(mel) if scalar else _wrap(mel)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not (isinstance(mel, Tensor) or hasattr(mel, "shape"))
+    m = jnp.asarray(_unwrap(mel), jnp.float32)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        f = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                      f)
+    return float(f) if scalar else _wrap(f)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = hz_to_mel(float(f_min), htk=htk)
+    hi = hz_to_mel(float(f_max), htk=htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return _wrap(_unwrap(mel_to_hz(_wrap(mels), htk=htk)).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return _wrap(jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = _unwrap(fft_frequencies(sr, n_fft))          # [n_bins]
+    melfreqs = _unwrap(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]           # [n_mels+2, bins]
+    lower = -ramps[:-2] / jnp.maximum(fdiff[:-1, None], 1e-10)
+    upper = ramps[2:] / jnp.maximum(fdiff[1:, None], 1e-10)
+    fb = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        fb = fb * enorm[:, None]
+    return _wrap(fb.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(S/ref) with clamping (ref functional.py:259)."""
+    s = jnp.asarray(_unwrap(spect))
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return _wrap(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (ref functional.py:303)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm is None:
+        dct = dct * 2.0
+    else:
+        if norm != "ortho":
+            raise ValueError("norm must be None or 'ortho'")
+        ortho = jnp.full((n_mfcc,), math.sqrt(2.0 / n_mels))
+        ortho = ortho.at[0].set(math.sqrt(1.0 / n_mels))
+        dct = dct * ortho[None, :]
+    return _wrap(dct.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window functions (ref: audio/functional/window.py). Supports the
+    reference's common set; periodic (fftbins=True) by default."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length + 1 if fftbins else win_length
+    x = np.arange(n, dtype=np.float64)
+
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * x / (n - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * x / (n - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * x / (n - 1))
+             + 0.08 * np.cos(4 * np.pi * x / (n - 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * x / (n - 1) - 1.0)
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones_like(x)
+    elif name == "triang":
+        m = (n + 1) // 2
+        if n % 2 == 0:
+            ramp = (2 * np.arange(1, m + 1) - 1) / n
+            w = np.concatenate([ramp, ramp[::-1]])
+        else:
+            ramp = 2 * np.arange(1, m + 1) / (n + 1)
+            w = np.concatenate([ramp, ramp[-2::-1]])
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((x - (n - 1) / 2.0) / std) ** 2)
+    elif name == "exponential":
+        center = args[0] if len(args) > 0 and args[0] is not None \
+            else (n - 1) / 2
+        tau = args[1] if len(args) > 1 else 1.0
+        w = np.exp(-np.abs(x - center) / tau)
+    elif name == "taylor":
+        # 4-term taylor (nbar=4, sll=30) simplified via chebyshev-free
+        # approximation; matches scipy for the default parameters
+        nbar, sll = (args + [4, 30])[:2] if args else (4, 30)
+        B = 10 ** (sll / 20)
+        A = np.arccosh(B) / np.pi
+        s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+        ma = np.arange(1, nbar)
+        Fm = np.empty(nbar - 1)
+        signs = np.empty_like(ma)
+        signs[::2] = 1
+        signs[1::2] = -1
+        m2 = ma ** 2
+        for mi, _ in enumerate(ma):
+            numer = signs[mi] * np.prod(
+                1 - m2[mi] / s2 / (A ** 2 + (ma - 0.5) ** 2))
+            denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(
+                1 - m2[mi] / m2[mi + 1:])
+            Fm[mi] = numer / denom
+        w = np.ones(n)
+        for mi, m in enumerate(ma):
+            w = w + 2 * Fm[mi] * np.cos(
+                2 * np.pi * m * (x - n / 2 + 0.5) / n)
+        w = w / w.max()
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = np.i0(beta * np.sqrt(1 - (2 * x / (n - 1) - 1) ** 2)) / np.i0(beta)
+    elif name == "tukey":
+        alpha = args[0] if args else 0.5
+        w = np.ones(n)
+        if alpha > 0:
+            width = int(np.floor(alpha * (n - 1) / 2.0))
+            left = x[:width + 1]
+            w[:width + 1] = 0.5 * (1 + np.cos(np.pi * (
+                -1 + 2.0 * left / alpha / (n - 1))))
+            w[-(width + 1):] = w[:width + 1][::-1]
+    elif name == "cosine":
+        w = np.sin(np.pi / n * (x + 0.5))
+    else:
+        raise ValueError(f"unsupported window {name!r}")
+
+    if fftbins:
+        w = w[:-1]
+    return _wrap(jnp.asarray(w).astype(dtype))

@@ -24,6 +24,7 @@ from . import meta_parallel  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import sharding  # noqa: F401
+from . import rpc  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
     reshard, shard_layer, shard_optimizer, ShardingStage1, ShardingStage2,

@@ -1,0 +1,252 @@
+"""paddle.distributed.rpc parity (C29).
+
+The reference builds this on brpc via pybind
+(/root/reference/python/paddle/distributed/rpc/rpc.py,
+paddle/fluid/distributed/rpc/). The TPU-native stance: RPC is a
+host-side control-plane feature (parameter queries, coordination,
+light-weight remote calls) — device data moves over ICI/DCN collectives,
+never RPC — so the transport is plain TCP sockets + pickle on the host
+NIC, with the same master-endpoint rendezvous the launch CLI uses.
+
+Surface parity: init_rpc / rpc_sync / rpc_async / get_worker_info /
+get_all_worker_infos / get_current_worker_info / shutdown.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = 120.0
+
+_server = None
+_server_thread = None
+_executor = None
+_workers: dict = {}
+_current: WorkerInfo = None
+_master_sock = None
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!Q", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-message")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _RpcHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            kind, body = _recv_msg(self.request)
+        except ConnectionError:
+            return
+        if kind == "call":
+            fn, args, kwargs = body
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back
+                result = ("err", e)
+            try:
+                _send_msg(self.request, result)
+            except Exception:
+                # unpicklable payload/exception: degrade to a summary so
+                # the caller sees the real failure, not a ConnectionError
+                import traceback
+                if result[0] == "err":
+                    summary = RuntimeError(
+                        f"remote {type(result[1]).__name__}: {result[1]}\n"
+                        + "".join(traceback.format_exception(result[1])))
+                else:
+                    summary = RuntimeError(
+                        "rpc result is not picklable: "
+                        f"{type(result[1]).__name__}")
+                _send_msg(self.request, ("err", summary))
+        elif kind == "ping":
+            _send_msg(self.request, ("ok", None))
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+# ---------------- master-side rendezvous (rank 0) ----------------
+
+class _MasterHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server
+        kind, body = _recv_msg(self.request)
+        if kind == "register":
+            with srv.lock:
+                srv.infos[body.rank] = body
+                srv.cond.notify_all()
+        elif kind == "wait_all":
+            world = body
+            with srv.lock:
+                while len(srv.infos) < world:
+                    srv.cond.wait(timeout=1.0)
+            _send_msg(self.request, ("ok", dict(srv.infos)))
+            return
+        elif kind == "barrier":
+            key, world = body
+            with srv.lock:
+                srv.barriers.setdefault(key, 0)
+                srv.barriers[key] += 1
+                srv.cond.notify_all()
+                while srv.barriers[key] % world != 0:
+                    srv.cond.wait(timeout=1.0)
+            _send_msg(self.request, ("ok", None))
+            return
+        _send_msg(self.request, ("ok", None))
+
+
+def _master_call(endpoint, kind, body, retries=60):
+    ip, port = endpoint.rsplit(":", 1)
+    last = None
+    for _ in range(retries):
+        try:
+            with socket.create_connection((ip, int(port)), timeout=30) as s:
+                _send_msg(s, (kind, body))
+                status, payload = _recv_msg(s)
+                if status != "ok":
+                    raise payload
+                return payload
+        except (ConnectionError, OSError) as e:
+            last = e
+            time.sleep(0.5)
+    raise ConnectionError(f"cannot reach rpc master at {endpoint}: {last}")
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and rendezvous with the group
+    (ref: rpc.py:73). Defaults come from the launch CLI's env
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER)."""
+    global _server, _server_thread, _executor, _workers, _current, \
+        _master_sock
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29431")
+
+    if rank == 0:
+        ip, port = master_endpoint.rsplit(":", 1)
+        master = _Server((ip, int(port)), _MasterHandler)
+        master.infos = {}
+        master.barriers = {}
+        master.lock = threading.Lock()
+        master.cond = threading.Condition(master.lock)
+        t = threading.Thread(target=master.serve_forever, daemon=True)
+        t.start()
+        _master_sock = master
+
+    _server = _Server(("0.0.0.0", 0), _RpcHandler)
+    port = _server.server_address[1]
+    _server_thread = threading.Thread(target=_server.serve_forever,
+                                      daemon=True)
+    _server_thread.start()
+    _executor = ThreadPoolExecutor(max_workers=8)
+
+    host_ip = socket.gethostbyname(socket.gethostname())
+    me = WorkerInfo(name, rank, host_ip if world_size > 1 else "127.0.0.1",
+                    port)
+    _master_call(master_endpoint, "register", me)
+    infos = _master_call(master_endpoint, "wait_all", world_size)
+    _workers = {info.name: info for info in infos.values()}
+    _current = me
+    _workers.setdefault(name, me)
+    globals()["_master_endpoint"] = master_endpoint
+    globals()["_world_size"] = world_size
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    info = _workers.get(to)
+    if info is None:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(_workers)}")
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout or None) as s:
+        _send_msg(s, ("call", (fn, tuple(args or ()), dict(kwargs or {}))))
+        status, payload = _recv_msg(s)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking remote call (ref: rpc.py:143)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+class _Future:
+    def __init__(self, fut):
+        self._fut = fut
+
+    def wait(self, timeout=None):
+        return self._fut.result(timeout=timeout)
+
+    def done(self):
+        return self._fut.done()
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Non-blocking remote call returning a future with .wait()
+    (ref: rpc.py:183)."""
+    return _Future(_executor.submit(_invoke, to, fn, args, kwargs, timeout))
+
+
+def get_worker_info(name):
+    return _workers[name]
+
+
+def get_all_worker_infos():
+    return sorted(_workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    return _current
+
+
+def shutdown():
+    """Barrier, then stop the local server (ref: rpc.py:278)."""
+    global _server, _executor, _master_sock
+    if _current is not None:
+        _master_call(globals()["_master_endpoint"], "barrier",
+                     ("shutdown", globals()["_world_size"]))
+    if _executor is not None:
+        _executor.shutdown(wait=True)
+        _executor = None
+    if _server is not None:
+        _server.shutdown()
+        _server.server_close()
+        _server = None
+    globals()["_current"] = None
+    if _master_sock is not None:
+        _master_sock.shutdown()
+        _master_sock.server_close()
+        _master_sock = None
+    _workers.clear()

@@ -87,28 +87,35 @@ def send_uv(x, y, src_index, dst_index, compute_op="add"):
 
 
 @register_op("segment_pool")
-def segment_pool(x, segment_ids, pool_type="sum"):
+def segment_pool(x, segment_ids, pool_type="sum", out_size=None):
     """ref: phi/kernels/gpu/segment_pool_kernel.cu (paddle.incubate
-    .segment_* family). segment_ids must be sorted ascending; the number
-    of segments is segment_ids.max()+1 — static under jit only if the
-    caller fixes it, so eager use computes it concretely."""
+    .segment_* family). segment_ids must be sorted ascending. Eager use
+    reads the segment count off the concrete ids (max+1); under jit the
+    count is data-dependent, so callers MUST pass out_size to pin the
+    output shape — otherwise the row count silently differs between
+    eager (num_segments) and traced (x.shape[0]) execution."""
     ids = segment_ids.astype(jnp.int32)
-    num = x.shape[0] if isinstance(ids, jax.core.Tracer) else int(ids[-1]) + 1
+    if out_size is not None:
+        num = int(out_size)
+    elif isinstance(ids, jax.core.Tracer):
+        num = x.shape[0]
+    else:
+        num = int(ids[-1]) + 1
     kind = pool_type.lower()
     return _seg_reduce(x, ids, num, "mean" if kind == "avg" else kind)
 
 
-def segment_sum(x, segment_ids):
-    return segment_pool(x, segment_ids, "sum")
+def segment_sum(x, segment_ids, out_size=None):
+    return segment_pool(x, segment_ids, "sum", out_size=out_size)
 
 
-def segment_mean(x, segment_ids):
-    return segment_pool(x, segment_ids, "mean")
+def segment_mean(x, segment_ids, out_size=None):
+    return segment_pool(x, segment_ids, "mean", out_size=out_size)
 
 
-def segment_max(x, segment_ids):
-    return segment_pool(x, segment_ids, "max")
+def segment_max(x, segment_ids, out_size=None):
+    return segment_pool(x, segment_ids, "max", out_size=out_size)
 
 
-def segment_min(x, segment_ids):
-    return segment_pool(x, segment_ids, "min")
+def segment_min(x, segment_ids, out_size=None):
+    return segment_pool(x, segment_ids, "min", out_size=out_size)

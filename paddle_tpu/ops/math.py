@@ -427,10 +427,14 @@ def diff(x, n=1, axis=-1, prepend=None, append=None):
 
 
 @register_op("logcumsumexp")
-def logcumsumexp(x, axis=-1):
+def logcumsumexp(x, axis=None):
     """Numerically-stable running logsumexp (ref: logcumsumexp in
-    ops.yaml) via an associative log-add-exp scan — O(log n) depth on the
-    VPU instead of the sequential CUDA scan."""
+    ops.yaml; axis=None flattens, matching tensor/math.py:4176) via an
+    associative log-add-exp scan — O(log n) depth on the VPU instead of
+    the sequential CUDA scan."""
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
     xf = x.astype(jnp.float32)
     # jnp.logaddexp (not a hand-rolled max+log1p) -- it guards the
     # -inf/-inf case that otherwise NaN-poisons the scan

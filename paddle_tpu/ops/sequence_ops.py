@@ -186,7 +186,7 @@ def top_p_sampling(x, ps, seed=None, key=None):
         jnp.maximum(masked, 1e-30)) + gumbel, -jnp.inf), axis=-1)
     ids = jnp.take_along_axis(order, pick[:, None], axis=1)
     pval = jnp.take_along_axis(probs, ids, axis=1)
-    return pval, ids.astype(jnp.int64)
+    return pval, ids.astype(jnp.int32)  # x32: int64 truncates
 
 
 @register_op("edit_distance")
@@ -262,4 +262,4 @@ def class_center_sample(label, num_classes, num_samples, seed=None):
     inv = jnp.full((num_classes,), -1, jnp.int32)
     inv = inv.at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
     remapped = inv[label]
-    return remapped, sampled.astype(jnp.int64)
+    return remapped, sampled.astype(jnp.int32)

@@ -196,7 +196,7 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
         arg[..., None], axis=-1)[..., 0]
     gy = pick(yy) - pad[0][0]
     gx = pick(xx) - pad[1][0]
-    idx = (gy * W + gx).astype(jnp.int64)
+    idx = (gy * W + gx).astype(jnp.int32)  # x32: int64 truncates
     return out, idx
 
 
@@ -412,15 +412,18 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
     return boxes, scores
 
 
-def _iou_matrix(a, b):
-    """[Na, 4] x [Nb, 4] (x1,y1,x2,y2) -> [Na, Nb] IoU."""
-    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(
-        a[:, 3] - a[:, 1], 0)
-    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
-        b[:, 3] - b[:, 1], 0)
+def _iou_matrix(a, b, normalized=True):
+    """[Na, 4] x [Nb, 4] (x1,y1,x2,y2) -> [Na, Nb] IoU. normalized=False
+    adds the reference's +1 pixel-coordinate correction (ref:
+    phi/kernels/funcs/detection/nms_util.h JaccardOverlap)."""
+    off = 0.0 if normalized else 1.0
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + off, 0) * jnp.maximum(
+        a[:, 3] - a[:, 1] + off, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + off, 0) * jnp.maximum(
+        b[:, 3] - b[:, 1] + off, 0)
     lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
     rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0.0)
+    wh = jnp.maximum(rb - lt + off, 0.0)
     inter = wh[..., 0] * wh[..., 1]
     return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
                                1e-10)
@@ -438,14 +441,18 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
     N, C, M = scores.shape
 
     def one_image(boxes, sc):
-        flat_sc = sc.reshape(C * M)
-        cls_of = jnp.arange(C * M) // M
-        box_of = jnp.arange(C * M) % M
-        top_sc, top_i = jax.lax.top_k(flat_sc, min(C * M, nms_top_k * C))
+        # reference semantics: top nms_top_k PER CLASS enter score decay
+        k = min(nms_top_k, M)
+        cls_sc, cls_ord = jax.vmap(lambda s: jax.lax.top_k(s, k))(sc)
+        flat_sc = cls_sc.reshape(C * k)
+        cls_of = jnp.arange(C * k) // k
+        box_of = cls_ord.reshape(C * k)
+        # global desc order so "higher-scoring" is an index comparison
+        top_sc, top_i = jax.lax.top_k(flat_sc, C * k)
         tcls = cls_of[top_i]
         tbox = boxes[box_of[top_i]]
         valid = top_sc > score_threshold
-        iou = _iou_matrix(tbox, tbox)
+        iou = _iou_matrix(tbox, tbox, normalized)
         same = (tcls[:, None] == tcls[None, :])
         # scores arrive sorted desc, so "higher-scoring than i" = j < i
         higher = (jnp.arange(iou.shape[0])[:, None]
@@ -487,14 +494,19 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=100,
         k = min(nms_top_k, M)
         top_sc, order = jax.lax.top_k(sc, k)
         b = boxes[order]
-        iou = _iou_matrix(b, b)
+        iou = _iou_matrix(b, b, normalized)
 
-        def body(i, keep):
-            sup = (iou[i] > nms_threshold) & keep[i] & (
-                jnp.arange(k) > i)
-            return keep & ~sup
-        keep = jax.lax.fori_loop(0, k, body,
-                                 top_sc > score_threshold)
+        def body(i, carry):
+            keep, thr = carry
+            sup = (iou[i] > thr) & keep[i] & (jnp.arange(k) > i)
+            # adaptive NMS (ref nms_util.h:171): decay the threshold
+            # after each surviving anchor box once it exceeds 0.5
+            thr = jnp.where((nms_eta < 1.0) & (thr > 0.5) & keep[i],
+                            thr * nms_eta, thr)
+            return keep & ~sup, thr
+        keep, _ = jax.lax.fori_loop(
+            0, k, body, (top_sc > score_threshold,
+                         jnp.float32(nms_threshold)))
         return jnp.where(keep, top_sc, -1.0), order
 
     def one_image(boxes, sc):

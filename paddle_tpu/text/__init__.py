@@ -1,0 +1,27 @@
+"""paddle.text parity (ref: python/paddle/text/viterbi_decode.py).
+
+The dataset zoo (paddle.text.datasets.*) is IO-bound downloader code with
+no TPU-relevant compute; it is out of scope (see README "Unsupported
+surface"). The compute API — ViterbiDecoder — wraps the lax.scan CRF
+decode in ops/sequence_ops.py.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..ops import viterbi_decode
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+class ViterbiDecoder(Layer):
+    """Holds the transition matrix; forward decodes (ref:
+    python/paddle/text/viterbi_decode.py:99)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -7,3 +7,12 @@ from .lenet_vgg_mobilenet import (  # noqa: F401
     LeNet, VGG, vgg11, vgg13, vgg16, vgg19, MobileNetV2, mobilenet_v2,
     AlexNet, alexnet,
 )
+from .extra import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    SqueezeNet, squeezenet1_0, squeezenet1_1,
+    MobileNetV1, mobilenet_v1,
+    MobileNetV3, mobilenet_v3_small, mobilenet_v3_large,
+    ShuffleNetV2, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+    shufflenet_v2_x2_0,
+    GoogLeNet, googlenet, InceptionV3, inception_v3,
+)

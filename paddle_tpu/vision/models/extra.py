@@ -1,0 +1,560 @@
+"""DenseNet / SqueezeNet / MobileNetV1 / MobileNetV3 / ShuffleNetV2 /
+GoogLeNet / InceptionV3 (capability match for the rest of the reference
+model zoo, python/paddle/vision/models/*.py).
+
+Constructor/attribute naming follows the reference so state_dicts map
+1:1, but the module bodies are written against this framework's nn API.
+All archs are static-shape and NCHW, which XLA lays out for the MXU.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ... import ops
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1, act="relu"):
+    layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(cout)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return nn.Sequential(*layers)
+
+
+# ======================= DenseNet =======================
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(cin)
+        self.conv1 = nn.Conv2D(cin, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        out = self.conv1(ops.relu(self.norm1(x)))
+        out = self.conv2(ops.relu(self.norm2(out)))
+        return ops.concat([x, self.dropout(out)], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(cin)
+        self.conv = nn.Conv2D(cin, cout, 1, bias_attr=False)
+
+    def forward(self, x):
+        return ops.avg_pool2d(self.conv(ops.relu(self.norm(x))), 2, 2)
+
+
+class DenseNet(nn.Layer):
+    """ref: vision/models/densenet.py (121/161/169/201/264 configs)."""
+
+    _cfgs = {121: (64, 32, (6, 12, 24, 16)),
+             161: (96, 48, (6, 12, 36, 24)),
+             169: (64, 32, (6, 12, 32, 32)),
+             201: (64, 32, (6, 12, 48, 32)),
+             264: (64, 32, (6, 12, 64, 48))}
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        init_c, growth, blocks = self._cfgs[layers]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        c = init_c
+        feats = []
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if i < len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        self.features = nn.Sequential(*feats)
+        self.final_norm = nn.BatchNorm2D(c)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = ops.relu(self.final_norm(self.features(self.stem(x))))
+        if self.with_pool:
+            x = ops.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+# ======================= SqueezeNet =======================
+
+class _Fire(nn.Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(cin, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = ops.relu(self.squeeze(x))
+        return ops.concat([ops.relu(self.expand1(s)),
+                           ops.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """ref: vision/models/squeezenet.py (1.0 / 1.1 variants)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier_conv = nn.Conv2D(512, num_classes, 1)
+        self.dropout = nn.Dropout(0.5)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = ops.relu(self.classifier_conv(self.dropout(x)))
+        if self.with_pool:
+            x = ops.adaptive_avg_pool2d(x, 1)
+        return ops.flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)
+
+
+# ======================= MobileNetV1 =======================
+
+class MobileNetV1(nn.Layer):
+    """ref: vision/models/mobilenetv1.py — depthwise-separable stacks."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + [
+               (512, 1024, 2), (1024, 1024, 1)]
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1)]
+        for cin, cout, stride in cfg:
+            layers.append(_conv_bn(c(cin), c(cin), 3, stride=stride,
+                                   padding=1, groups=c(cin)))
+            layers.append(_conv_bn(c(cin), c(cout), 1))
+        self.features = nn.Sequential(*layers)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = ops.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+# ======================= MobileNetV3 =======================
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, rd=4):
+        super().__init__()
+        self.fc1 = nn.Conv2D(ch, ch // rd, 1)
+        self.fc2 = nn.Conv2D(ch // rd, ch, 1)
+
+    def forward(self, x):
+        s = ops.adaptive_avg_pool2d(x, 1)
+        s = ops.relu(self.fc1(s))
+        s = ops.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, cin, exp, cout, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers.append(_conv_bn(cin, exp, 1, act=act))
+        layers.append(_conv_bn(exp, exp, k, stride=stride, padding=k // 2,
+                               groups=exp, act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(exp))
+        layers.append(_conv_bn(exp, cout, 1, act="none"))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3(nn.Layer):
+    """ref: vision/models/mobilenetv3.py (small / large)."""
+
+    _small = [  # k, exp, cout, se, act, stride
+        (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+        (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+        (5, 240, 40, True, "hardswish", 1),
+        (5, 240, 40, True, "hardswish", 1),
+        (5, 120, 48, True, "hardswish", 1),
+        (5, 144, 48, True, "hardswish", 1),
+        (5, 288, 96, True, "hardswish", 2),
+        (5, 576, 96, True, "hardswish", 1),
+        (5, 576, 96, True, "hardswish", 1)]
+    _large = [
+        (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+        (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+        (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+        (3, 240, 80, False, "hardswish", 2),
+        (3, 200, 80, False, "hardswish", 1),
+        (3, 184, 80, False, "hardswish", 1),
+        (3, 184, 80, False, "hardswish", 1),
+        (3, 480, 112, True, "hardswish", 1),
+        (3, 672, 112, True, "hardswish", 1),
+        (5, 672, 160, True, "hardswish", 2),
+        (5, 960, 160, True, "hardswish", 1),
+        (5, 960, 160, True, "hardswish", 1)]
+
+    def __init__(self, config="small", scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = self._small if config == "small" else self._large
+        last_exp = 576 if config == "small" else 960
+
+        def c(ch):
+            # reference _make_divisible: round to /8 but never drop below
+            # 90% of the unrounded width (vision/models/mobilenetv3.py)
+            v = ch * scale
+            new = max(8, int(v + 4) // 8 * 8)
+            if new < 0.9 * v:
+                new += 8
+            return new
+
+        layers = [_conv_bn(3, c(16), 3, stride=2, padding=1,
+                           act="hardswish")]
+        cin = c(16)
+        for k, exp, cout, se, act, stride in cfg:
+            layers.append(_MBV3Block(cin, c(exp), c(cout), k, stride, se,
+                                     act))
+            cin = c(cout)
+        layers.append(_conv_bn(cin, c(last_exp), 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(last_exp), 1280), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(1280, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = ops.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, 1))
+        return x
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3("small", scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3("large", scale=scale, **kw)
+
+
+# ======================= ShuffleNetV2 =======================
+
+def _channel_shuffle(x, groups):
+    return ops.channel_shuffle(x, groups)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            in_b = cin // 2
+        else:
+            in_b = cin
+            self.branch1 = nn.Sequential(
+                _conv_bn(in_b, in_b, 3, stride=stride, padding=1,
+                         groups=in_b, act="none"),
+                _conv_bn(in_b, branch, 1))
+        self.branch2 = nn.Sequential(
+            _conv_bn(in_b if stride > 1 else branch, branch, 1),
+            _conv_bn(branch, branch, 3, stride=stride, padding=1,
+                     groups=branch, act="none"),
+            _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = ops.split(x, 2, axis=1)
+            out = ops.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """ref: vision/models/shufflenetv2.py."""
+
+    _stage_out = {0.25: (24, 24, 48, 96, 512),
+                  0.33: (24, 32, 64, 128, 512),
+                  0.5: (24, 48, 96, 192, 1024),
+                  1.0: (24, 116, 232, 464, 1024),
+                  1.5: (24, 176, 352, 704, 1024),
+                  2.0: (24, 244, 488, 976, 2048)}
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        c0, c1, c2, c3, c4 = self._stage_out[scale]
+        self.stem = nn.Sequential(_conv_bn(3, c0, 3, stride=2, padding=1),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        cin = c0
+        for cout, repeat in zip((c1, c2, c3), (4, 8, 4)):
+            stages.append(_ShuffleUnit(cin, cout, 2))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(cout, cout, 1))
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn(cin, c4, 1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c4, num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = ops.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+# ======================= GoogLeNet / InceptionV3 =======================
+
+class _InceptionA(nn.Layer):
+    """GoogLeNet inception block (v1 style with 1x1/3x3/5x5/pool)."""
+
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, cp):
+        super().__init__()
+        self.b1 = _conv_bn(cin, c1, 1)
+        self.b3 = nn.Sequential(_conv_bn(cin, c3r, 1),
+                                _conv_bn(c3r, c3, 3, padding=1))
+        self.b5 = nn.Sequential(_conv_bn(cin, c5r, 1),
+                                _conv_bn(c5r, c5, 5, padding=2))
+        self.bp = _conv_bn(cin, cp, 1)
+
+    def forward(self, x):
+        pooled = ops.max_pool2d(x, 3, stride=1, padding=1)
+        return ops.concat([self.b1(x), self.b3(x), self.b5(x),
+                           self.bp(pooled)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """ref: vision/models/googlenet.py (aux heads omitted at inference;
+    kept as attributes for state_dict parity when training)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _conv_bn(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _conv_bn(64, 64, 1), _conv_bn(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc3 = nn.Sequential(
+            _InceptionA(192, 64, 96, 128, 16, 32, 32),
+            _InceptionA(256, 128, 128, 192, 32, 96, 64))
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc4 = nn.Sequential(
+            _InceptionA(480, 192, 96, 208, 16, 48, 64),
+            _InceptionA(512, 160, 112, 224, 24, 64, 64),
+            _InceptionA(512, 128, 128, 256, 24, 64, 64),
+            _InceptionA(512, 112, 144, 288, 32, 64, 64),
+            _InceptionA(528, 256, 160, 320, 32, 128, 128))
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc5 = nn.Sequential(
+            _InceptionA(832, 256, 160, 320, 32, 128, 128),
+            _InceptionA(832, 384, 192, 384, 48, 128, 128))
+        self.dropout = nn.Dropout(0.2)
+        if num_classes > 0:
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.pool4(self.inc4(self.pool3(self.inc3(
+            self.stem(x))))))
+        if self.with_pool:
+            x = ops.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(ops.flatten(x, 1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+class _InceptionV3A(nn.Layer):
+    def __init__(self, cin, pool_feat):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 64, 1)
+        self.b5 = nn.Sequential(_conv_bn(cin, 48, 1),
+                                _conv_bn(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_conv_bn(cin, 64, 1),
+                                _conv_bn(64, 96, 3, padding=1),
+                                _conv_bn(96, 96, 3, padding=1))
+        self.bp = _conv_bn(cin, pool_feat, 1)
+
+    def forward(self, x):
+        p = ops.avg_pool2d(x, 3, stride=1, padding=1)
+        return ops.concat([self.b1(x), self.b5(x), self.b3(x),
+                           self.bp(p)], axis=1)
+
+
+class _InceptionV3Reduce(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _conv_bn(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_conv_bn(cin, 64, 1),
+                                 _conv_bn(64, 96, 3, padding=1),
+                                 _conv_bn(96, 96, 3, stride=2))
+
+    def forward(self, x):
+        p = ops.max_pool2d(x, 3, stride=2)
+        return ops.concat([self.b3(x), self.b3d(x), p], axis=1)
+
+
+class _InceptionV3C(nn.Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _conv_bn(cin, c7, 1),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _conv_bn(cin, c7, 1),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = _conv_bn(cin, 192, 1)
+
+    def forward(self, x):
+        p = ops.avg_pool2d(x, 3, stride=1, padding=1)
+        return ops.concat([self.b1(x), self.b7(x), self.b7d(x),
+                           self.bp(p)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """ref: vision/models/inceptionv3.py — the 299x299 v3 trunk with the
+    A (35x35), reduction, C (17x17) stages and a simplified final stage
+    (3x3-split E blocks rendered as dense 3x3s for static shapes)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _conv_bn(3, 32, 3, stride=2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.inc_a = nn.Sequential(
+            _InceptionV3A(192, 32), _InceptionV3A(256, 64),
+            _InceptionV3A(288, 64))
+        self.reduce1 = _InceptionV3Reduce(288)
+        self.inc_c = nn.Sequential(
+            _InceptionV3C(768, 128), _InceptionV3C(768, 160),
+            _InceptionV3C(768, 160), _InceptionV3C(768, 192))
+        self.tail = nn.Sequential(
+            _conv_bn(768, 1280, 3, stride=2),
+            _conv_bn(1280, 2048, 1))
+        self.dropout = nn.Dropout(0.5)
+        if num_classes > 0:
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.inc_c(self.reduce1(self.inc_a(self.stem(x)))))
+        if self.with_pool:
+            x = ops.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(ops.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)

@@ -1,0 +1,135 @@
+"""paddle.audio / paddle.text conformance.
+
+Window functions and mel/DCT matrices check against scipy/librosa-style
+formulas computed in numpy; the layer pipeline checks against a
+straightforward numpy STFT feature extraction.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def npy(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+class TestFunctional:
+    def test_hz_mel_roundtrip(self):
+        from paddle_tpu.audio import functional as AF
+        for htk in (False, True):
+            f = np.array([0.0, 440.0, 1000.0, 4000.0, 11025.0], np.float32)
+            mel = AF.hz_to_mel(pt.to_tensor(f), htk=htk)
+            back = AF.mel_to_hz(mel, htk=htk)
+            np.testing.assert_allclose(npy(back), f, rtol=1e-4, atol=1e-2)
+
+    def test_hz_to_mel_scalar_and_known_values(self):
+        from paddle_tpu.audio import functional as AF
+        # HTK formula at 1000 Hz: 2595*log10(1+1000/700) ≈ 999.99
+        assert abs(AF.hz_to_mel(1000.0, htk=True) - 999.9855) < 1e-2
+        # slaney is linear below 1 kHz: f / (200/3)
+        assert abs(AF.hz_to_mel(500.0) - 7.5) < 1e-4
+
+    def test_fbank_matrix_properties(self):
+        from paddle_tpu.audio import functional as AF
+        fb = npy(AF.compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # every filter has some support except possibly edge cases
+        assert (fb.sum(axis=1) > 0).sum() >= 38
+
+    def test_window_matches_scipy(self):
+        from paddle_tpu.audio import functional as AF
+        import scipy.signal as ss
+        for name in ("hann", "hamming", "blackman", "bartlett", "boxcar",
+                     "triang", "cosine"):
+            got = npy(AF.get_window(name, 64))
+            ref = ss.get_window(name, 64, fftbins=True)
+            np.testing.assert_allclose(got, ref, atol=1e-6, err_msg=name)
+        got = npy(AF.get_window(("gaussian", 7.0), 64))
+        ref = ss.get_window(("gaussian", 7.0), 64, fftbins=True)
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+        got = npy(AF.get_window(("kaiser", 12.0), 64))
+        ref = ss.get_window(("kaiser", 12.0), 64, fftbins=True)
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_windows_numerics(self):
+        from paddle_tpu.audio import functional as AF
+        for name in ("hann", "hamming", "blackman", "bartlett", "boxcar",
+                     "triang", "gaussian", "exponential", "kaiser",
+                     "tukey", "cosine", "taylor"):
+            w = npy(AF.get_window(name, 64))
+            assert w.shape == (64,), name
+            assert np.isfinite(w).all(), name
+            assert w.max() <= 1.0 + 1e-6, name
+        # periodic hann: w[0] == 0, symmetric interior
+        w = npy(AF.get_window("hann", 8))
+        np.testing.assert_allclose(w[0], 0.0, atol=1e-12)
+        hann_ref = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(8) / 8)
+        np.testing.assert_allclose(w, hann_ref, atol=1e-6)
+
+    def test_power_to_db(self):
+        from paddle_tpu.audio import functional as AF
+        s = np.array([1.0, 0.1, 0.01], np.float32)
+        db = npy(AF.power_to_db(pt.to_tensor(s), top_db=None))
+        np.testing.assert_allclose(db, [0.0, -10.0, -20.0], atol=1e-4)
+        db = npy(AF.power_to_db(pt.to_tensor(s), top_db=15.0))
+        np.testing.assert_allclose(db, [0.0, -10.0, -15.0], atol=1e-4)
+
+    def test_create_dct_ortho(self):
+        from paddle_tpu.audio import functional as AF
+        d = npy(AF.create_dct(13, 40))
+        assert d.shape == (40, 13)
+        # orthonormal columns
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+
+class TestFeatureLayers:
+    def test_spectrogram_matches_numpy(self):
+        import paddle_tpu.audio as audio
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 2048)).astype(np.float32)
+        layer = audio.Spectrogram(n_fft=256, hop_length=128, center=False)
+        got = npy(layer(pt.to_tensor(x)))
+        win = npy(audio.functional.get_window("hann", 256))
+        frames = np.stack([x[:, i * 128:i * 128 + 256]
+                           for i in range((2048 - 256) // 128 + 1)], -1)
+        ref = np.abs(np.fft.rfft(frames * win[None, :, None], axis=1)) ** 2
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_melspectrogram_is_fbank_of_spectrogram(self):
+        import paddle_tpu.audio as audio
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 1024)).astype(np.float32)
+        mel = audio.MelSpectrogram(sr=16000, n_fft=256, n_mels=20,
+                                   center=False)
+        got = npy(mel(pt.to_tensor(x)))
+        spec = npy(mel._spectrogram(pt.to_tensor(x)))
+        fb = npy(mel.fbank_matrix)
+        np.testing.assert_allclose(got, np.einsum("mb,nbt->nmt", fb, spec),
+                                   rtol=1e-4, atol=1e-5)
+        assert got.shape[1] == 20
+
+    def test_mfcc_shape_and_finite(self):
+        import paddle_tpu.audio as audio
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 4096)).astype(np.float32)
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+        out = npy(mfcc(pt.to_tensor(x)))
+        assert out.shape[0] == 2 and out.shape[1] == 13
+        assert np.isfinite(out).all()
+
+
+class TestText:
+    def test_viterbi_decoder_layer(self):
+        import paddle_tpu.text as text
+        rng = np.random.default_rng(3)
+        pots = pt.to_tensor(rng.standard_normal((2, 6, 5)).astype(np.float32))
+        trans = pt.to_tensor(rng.standard_normal((5, 5)).astype(np.float32))
+        lens = pt.to_tensor(np.array([6, 4], np.int64))
+        dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        scores, paths = dec(pots, lens)
+        assert npy(scores).shape == (2,)
+        assert npy(paths).shape == (2, 6)
+        # path entries past the length are zero-padded
+        assert (npy(paths)[1, 4:] == 0).all()

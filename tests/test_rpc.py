@@ -1,0 +1,86 @@
+"""paddle.distributed.rpc conformance: in-process single-worker RPC and a
+real two-process group over the master rendezvous (ref API:
+python/paddle/distributed/rpc/rpc.py; test style: test/rpc/)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _double(x):
+    return 2 * x
+
+
+def _boom():
+    return 1 // 0
+
+
+def test_single_worker_rpc_roundtrip():
+    from paddle_tpu.distributed import rpc
+    port = _free_port()
+    rpc.init_rpc("solo", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        assert rpc.rpc_sync("solo", _double, args=(21,)) == 42
+        fut = rpc.rpc_async("solo", _double, args=(5,))
+        assert fut.wait(timeout=30) == 10
+        info = rpc.get_worker_info("solo")
+        assert info.name == "solo" and info.rank == 0
+        assert rpc.get_current_worker_info() == info
+        assert [w.name for w in rpc.get_all_worker_infos()] == ["solo"]
+        # remote exceptions propagate
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("solo", _boom)
+    finally:
+        rpc.shutdown()
+
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from paddle_tpu.distributed import rpc
+
+    def mul(a, b):
+        return a * b
+
+    rank = int(sys.argv[1])
+    rpc.init_rpc(f"worker{{rank}}".format(rank=rank), rank=rank,
+                 world_size=2, master_endpoint=sys.argv[2])
+    if rank == 0:
+        out = rpc.rpc_sync("worker1", mul, args=(6, 7))
+        assert out == 42, out
+        futs = [rpc.rpc_async("worker1", mul, args=(i, i)) for i in range(4)]
+        assert [f.wait() for f in futs] == [0, 1, 4, 9]
+        print("RPC_OK")
+    rpc.shutdown()
+""")
+
+
+def test_two_process_rpc():
+    port = _free_port()
+    endpoint = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    script = WORKER.format(repo=REPO)
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(r),
+                               endpoint],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=env, text=True)
+             for r in (0, 1)]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    assert "RPC_OK" in outs[0]

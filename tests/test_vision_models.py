@@ -1,0 +1,61 @@
+"""Vision model zoo: forward shapes, train/eval modes, and gradient flow
+(ref test style: test/legacy_test/test_vision_models.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+from paddle_tpu.vision import models
+
+
+def _x(size=64, b=2):
+    rng = np.random.default_rng(0)
+    return pt.to_tensor(rng.standard_normal((b, 3, size, size))
+                        .astype(np.float32))
+
+
+ZOO = [
+    ("densenet121", models.densenet121, 64),
+    ("squeezenet1_0", models.squeezenet1_0, 64),
+    ("squeezenet1_1", models.squeezenet1_1, 64),
+    ("mobilenet_v1", models.mobilenet_v1, 64),
+    ("mobilenet_v3_small", models.mobilenet_v3_small, 64),
+    ("mobilenet_v3_large", models.mobilenet_v3_large, 64),
+    ("shufflenet_v2_x1_0", models.shufflenet_v2_x1_0, 64),
+    ("googlenet", models.googlenet, 64),
+    ("inception_v3", models.inception_v3, 299),
+]
+
+
+@pytest.mark.parametrize("name,ctor,size", ZOO,
+                         ids=[z[0] for z in ZOO])
+def test_forward_shape(name, ctor, size):
+    m = ctor(num_classes=10)
+    m.eval()
+    out = m(_x(size, b=2))
+    assert list(out.shape) == [2, 10]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_densenet_train_grad_flows():
+    m = models.densenet121(num_classes=4)
+    m.train()
+    out = m(_x(64))
+    loss = ops.mean(out * out)
+    loss.backward()
+    grads = [p.grad for p in m.parameters() if p.grad is not None]
+    assert len(grads) > 100
+    assert all(np.isfinite(g.numpy()).all() for g in grads[:5])
+
+
+def test_shufflenet_channels_even_split():
+    m = models.shufflenet_v2_x0_5(num_classes=10)
+    m.eval()
+    out = m(_x(64))
+    assert list(out.shape) == [2, 10]
+
+
+def test_mobilenet_v3_scale():
+    m = models.mobilenet_v3_small(scale=0.5, num_classes=10)
+    m.eval()
+    assert list(m(_x(64)).shape) == [2, 10]
