@@ -49,6 +49,9 @@ from . import profiler  # noqa: F401
 from . import inference  # noqa: F401
 from . import utils  # noqa: F401
 from .framework_io import save, load  # noqa: F401
+from .tensor_array import (  # noqa: F401
+    create_array, array_write, array_read, array_length,
+)
 from .hapi.model_api import Model, summary  # noqa: F401
 
 

@@ -104,3 +104,68 @@ class cuda:  # namespace shim: paddle.device.cuda.*
 
 cuda.Stream = Stream
 cuda.Event = Event
+
+
+# ======================= vendor plugins (C5) =======================
+# The reference's CustomDevice path loads vendor runtimes via a C plugin
+# ABI (/root/reference/paddle/phi/backends/custom/custom_device.cc,
+# device/__init__.py get_all_custom_device_type). The TPU-native analog
+# IS PJRT: a vendor ships a PJRT plugin .so and registers it here; every
+# op then lowers through StableHLO to that backend with no per-vendor
+# kernel work in this framework — the plugin boundary sits below the
+# compiler instead of at the kernel registry.
+
+_registered_plugins = {}
+
+
+def register_pjrt_plugin(platform_name, library_path, options=None,
+                         priority=400, make_default=False):
+    """Register a vendor PJRT plugin (CustomDevice analog).
+
+    platform_name: backend name as it will appear in device lists;
+    library_path: path to the vendor's PJRT plugin shared object.
+    """
+    from jax._src import xla_bridge
+    if getattr(xla_bridge, "backends_are_initialized",
+               lambda: False)():
+        import warnings
+        warnings.warn(
+            "register_pjrt_plugin called after jax backends initialized: "
+            "the plugin registers but this process's device list is "
+            "already fixed. Register before the first jax computation "
+            "(or set PJRT_NAMES_AND_LIBRARY_PATHS before launch).",
+            RuntimeWarning, stacklevel=2)
+    try:
+        xla_bridge.register_plugin(platform_name,
+                                   library_path=str(library_path),
+                                   options=options, priority=priority)
+    except Exception as e:
+        raise RuntimeError(
+            f"PJRT plugin {platform_name!r} failed to load from "
+            f"{library_path}: {e}") from e
+    _registered_plugins[platform_name] = str(library_path)
+    if make_default:
+        jax.config.update("jax_platforms", platform_name)
+    return platform_name
+
+
+def get_all_custom_device_type():
+    """Registered vendor (non-builtin) backend names
+    (ref: device/__init__.py:get_all_custom_device_type)."""
+    return sorted(_registered_plugins)
+
+
+def get_available_custom_device():
+    out = []
+    for name in _registered_plugins:
+        try:
+            out.extend(f"{name}:{d.id}" for d in jax.devices(name))
+        except RuntimeError:
+            pass  # registered but not initializable on this host
+    return out
+
+
+def is_compiled_with_custom_device(device_type):
+    """Parity API: with PJRT the framework needs no per-vendor compile —
+    support is a runtime plugin question, so this reports registration."""
+    return device_type in _registered_plugins

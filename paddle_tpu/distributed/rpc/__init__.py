@@ -111,12 +111,13 @@ class _MasterHandler(socketserver.BaseRequestHandler):
             _send_msg(self.request, ("ok", dict(srv.infos)))
             return
         elif kind == "barrier":
-            key, world = body
+            # rank-keyed set, NOT a counter: _master_call retries after a
+            # socket timeout, and a re-sent arrival must be idempotent
+            key, world, rank = body
             with srv.lock:
-                srv.barriers.setdefault(key, 0)
-                srv.barriers[key] += 1
+                srv.barriers.setdefault(key, set()).add(rank)
                 srv.cond.notify_all()
-                while srv.barriers[key] % world != 0:
+                while len(srv.barriers[key]) < world:
                     srv.cond.wait(timeout=1.0)
             _send_msg(self.request, ("ok", None))
             return
@@ -236,7 +237,7 @@ def shutdown():
     global _server, _executor, _master_sock
     if _current is not None:
         _master_call(globals()["_master_endpoint"], "barrier",
-                     ("shutdown", globals()["_world_size"]))
+                     ("shutdown", globals()["_world_size"], _current.rank))
     if _executor is not None:
         _executor.shutdown(wait=True)
         _executor = None

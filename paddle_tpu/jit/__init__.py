@@ -183,13 +183,22 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
     def decorate(fn):
         if isinstance(fn, Layer):
-            sf = StaticFunction(fn.forward, layer=fn,
+            fwd = fn.forward
+            if full_graph:
+                from .dy2static import ast_transform
+                fwd = ast_transform(fwd) or fwd
+            sf = StaticFunction(fwd, layer=fn,
                                 input_spec=input_spec,
                                 full_graph=full_graph)
             fn.forward = sf
             return fn
         layer = getattr(fn, "__self__", None)
         layer = layer if isinstance(layer, Layer) else None
+        if full_graph:
+            # AST control-flow conversion (the SOT/AST dy2static path):
+            # tensor-predicate if/while stage into lax.cond/while_loop
+            from .dy2static import ast_transform
+            fn = ast_transform(fn) or fn
         return StaticFunction(fn, layer=layer, input_spec=input_spec,
                               full_graph=full_graph)
 

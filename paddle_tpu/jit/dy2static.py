@@ -1,0 +1,419 @@
+"""AST dygraph-to-static conversion (the SOT/AST path, L5).
+
+The reference stages data-dependent Python control flow two ways: an AST
+transformer (python/paddle/jit/dy2static/, e.g. ifelse_transformer.py /
+loop_transformer.py rewriting `if`/`while` into cond/while_loop ops) and
+a bytecode translator (sot/opcode_translator/executor/opcode_executor.py).
+The TPU-native analog is source-level: `ast_transform` rewrites
+
+    if <tensor-valued test>: ...      ->  _jst.convert_ifelse(...)
+    while <tensor-valued test>: ...   ->  _jst.convert_while(...)
+
+where the convert_* helpers dispatch AT RUNTIME — a concrete (python or
+eager-Tensor) predicate keeps exact Python semantics, and a traced
+predicate lowers to `lax.cond` / `lax.while_loop`, which is precisely
+the XLA-native form of the reference's conditional_block/while ops.
+
+Conversion contract (a documented subset of the reference's):
+  * `if`/`while` bodies containing `return`, or `break`/`continue` bound
+    to an enclosing loop, are left as plain Python — under
+    full_graph=True tracing they still produce the loud staging error.
+  * variables assigned in only ONE branch of a tensor-predicate `if`
+    cannot be threaded through `lax.cond` (both branches must yield the
+    same carry structure) — detected at runtime with a clear error.
+  * non-Tensor loop carries must be loop-invariant under a traced
+    `while` (XLA requires a fixed carry structure).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class _Undefined:
+    """Placeholder for a name unbound at the convert-point. Mirrors plain
+    Python's behavior at USE time: any operation on it raises
+    UnboundLocalError (repr stays safe for debugging)."""
+    __slots__ = ("name",)
+
+    def __init__(self, name="<var>"):
+        object.__setattr__(self, "name", name)
+
+    def __repr__(self):
+        return f"<undefined {object.__getattribute__(self, 'name')}>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "dy2static: local variable "
+            f"{object.__getattribute__(self, 'name')!r} referenced "
+            "before assignment (it was bound in only one conditional "
+            "path)")
+
+    __bool__ = __iter__ = __len__ = __call__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __eq__ = __ne__ = __lt__ = __gt__ = _raise
+    __le__ = __ge__ = __getitem__ = __array__ = __float__ = __int__ = _raise
+
+    def __getattr__(self, item):
+        self._raise()
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+
+UNDEF = _Undefined()
+
+
+def pack(*getters):
+    """Snapshot possibly-unbound locals: each getter is `lambda: name`;
+    an unbound name raises NameError and packs as an _Undefined that
+    raises UnboundLocalError on use."""
+    out = []
+    for g in getters:
+        try:
+            out.append(g())
+        except NameError as e:
+            name = str(e).split("'")[1] if "'" in str(e) else "<var>"
+            out.append(_Undefined(name))
+    return tuple(out)
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _pred_value(cond):
+    return cond._data if isinstance(cond, Tensor) else cond
+
+
+def _flatten_vars(vs):
+    arrs, statics, spec = [], [], []
+    for v in vs:
+        if isinstance(v, Tensor):
+            spec.append("t")
+            arrs.append(v._data)
+        elif isinstance(v, jax.Array) or _is_traced(v):
+            spec.append("a")
+            arrs.append(v)
+        else:
+            spec.append("s")
+            statics.append(v)
+    return arrs, statics, spec
+
+
+def _static_differs(a, b):
+    """Structure check for non-Tensor carries; must not trip on numpy
+    arrays (ambiguous truth value) or _Undefined (raising __eq__)."""
+    if a is b:
+        return False
+    if isinstance(a, _Undefined) and isinstance(b, _Undefined):
+        return False
+    if isinstance(a, _Undefined) or isinstance(b, _Undefined):
+        return True
+    import numpy as np
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        try:
+            return not np.array_equal(a, b)
+        except Exception:
+            return True
+    try:
+        return bool(a != b)
+    except Exception:
+        return True
+
+
+def _rebuild(spec, arrs, statics):
+    out, ia, istat = [], 0, 0
+    for k in spec:
+        if k == "t":
+            out.append(Tensor._wrap(arrs[ia]))
+            ia += 1
+        elif k == "a":
+            out.append(arrs[ia])
+            ia += 1
+        else:
+            out.append(statics[istat])
+            istat += 1
+    return tuple(out)
+
+
+def convert_ifelse(cond, true_fn, false_fn, vars, names=()):
+    """Runtime `if` dispatch (ref: dy2static convert_operators
+    convert_ifelse). Concrete predicate -> plain Python; traced
+    predicate -> lax.cond over the Tensor/array carries."""
+    pred = _pred_value(cond)
+    if not _is_traced(pred):
+        return true_fn(*vars) if bool(pred) else false_fn(*vars)
+    # UNDEF inputs ride as statics: a variable assigned in BOTH branches
+    # never reads its (meaningless) carry-in. One-sided assignment shows
+    # up as an output-structure mismatch below.
+    arrs, statics, spec = _flatten_vars(vars)
+    recorded = {}
+
+    def _mk(fn, tag):
+        def g(a):
+            out = fn(*_rebuild(spec, list(a), statics))
+            oarrs, ostat, ospec = _flatten_vars(out)
+            recorded[tag] = (ostat, ospec)
+            return tuple(oarrs)
+        return g
+
+    try:
+        outs = jax.lax.cond(jnp.asarray(pred, jnp.bool_),
+                            _mk(true_fn, "t"), _mk(false_fn, "f"),
+                            tuple(arrs))
+    except TypeError as e:
+        one_sided = [n for n, (a, b) in zip(
+            names, zip(recorded.get("t", ((), ()))[1],
+                       recorded.get("f", ((), ()))[1])) if a != b] \
+            if recorded.get("t") and recorded.get("f") else list(names)
+        raise RuntimeError(
+            "to_static: the two branches of a traced `if` produced "
+            f"different variable structures (check {one_sided}) — a "
+            "variable assigned in only one branch cannot stage through "
+            "lax.cond. Initialize it before the `if`. Underlying: "
+            f"{e}") from e
+    tstat, tspec = recorded["t"]
+    fstat, fspec = recorded["f"]
+    if tspec != fspec or any(_static_differs(a, b)
+                             for a, b in zip(tstat, fstat)):
+        raise RuntimeError(
+            "to_static: the two branches of a traced `if` produced "
+            "different non-Tensor values or structures "
+            f"({tspec}/{tstat} vs {fspec}/{fstat}) — only Tensor "
+            "carries may differ between branches under lax.cond. A "
+            "variable assigned in only one branch must be initialized "
+            "before the `if`.")
+    return _rebuild(tspec, list(outs), tstat)
+
+
+def convert_while(cond_fn, body_fn, vars, names=()):
+    """Runtime `while` dispatch. Concrete predicate -> Python loop
+    (eager); traced -> lax.while_loop with the Tensor carries."""
+    c = _pred_value(cond_fn(*vars))
+    if not _is_traced(c):
+        while bool(c):
+            vars = body_fn(*vars)
+            c = _pred_value(cond_fn(*vars))
+        return tuple(vars)
+    arrs, statics, spec = _flatten_vars(vars)
+
+    def cf(a):
+        r = _pred_value(cond_fn(*_rebuild(spec, list(a), statics)))
+        return jnp.asarray(r, jnp.bool_)
+
+    def bf(a):
+        out = body_fn(*_rebuild(spec, list(a), statics))
+        oarrs, ostat, ospec = _flatten_vars(out)
+        if ospec != spec or any(_static_differs(x, y)
+                                for x, y in zip(ostat, statics)):
+            raise RuntimeError(
+                "to_static: a traced `while` body changed a non-Tensor "
+                "loop variable (XLA needs a fixed carry structure). "
+                "Initialize loop variables as Tensors before the loop "
+                "and keep python values loop-invariant.")
+        return tuple(oarrs)
+
+    outs = jax.lax.while_loop(cf, bf, tuple(arrs))
+    return _rebuild(spec, list(outs), statics)
+
+
+# ======================= AST transformation =======================
+
+_SKIP_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda, ast.ListComp, ast.SetComp, ast.DictComp,
+                ast.GeneratorExp)
+
+
+def _assigned_names(body):
+    """Names bound by a statement list, not descending into new scopes."""
+    names = []
+
+    def walk(node):
+        if isinstance(node, _SKIP_SCOPES):
+            # the def's NAME binds in this scope
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                names.append(node.name)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.append(node.id)
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                names.append(bound)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for stmt in body:
+        walk(stmt)
+    seen, out = set(), []
+    for n in names:
+        if n not in seen and not n.startswith("__jst_"):
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def _escapes_control_flow(body):
+    """True if the statements contain a `return`, or a `break`/`continue`
+    bound to an ENCLOSING loop (i.e. not inside a nested loop here)."""
+    found = False
+
+    def walk(node, in_loop):
+        nonlocal found
+        if found or isinstance(node, _SKIP_SCOPES):
+            return
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom,
+                             ast.Await)):
+            found = True
+            return
+        if isinstance(node, (ast.Break, ast.Continue)) and not in_loop:
+            found = True
+            return
+        inner = in_loop or isinstance(node, (ast.For, ast.While,
+                                             ast.AsyncFor))
+        for child in ast.iter_child_nodes(node):
+            walk(child, inner)
+
+    for stmt in body:
+        walk(stmt, False)
+    return found
+
+
+def _stmt(src):
+    """Parse one statement from template source (version-correct AST
+    field defaults come from the parser, not hand-built nodes)."""
+    return ast.parse(textwrap.dedent(src)).body[0]
+
+
+def _fndef(name, params, body, tail_return=None):
+    f = _stmt(f"def {name}({', '.join(params)}):\n    pass")
+    f.body = list(body)
+    if tail_return is not None:
+        f.body.append(_stmt(f"return ({', '.join(tail_return)},)"
+                            if tail_return else "return ()"))
+    if not f.body:
+        f.body = [ast.Pass()]
+    return f
+
+
+def _pack_stmt(var_name, names):
+    getters = ", ".join(f"lambda: {n}" for n in names)
+    return _stmt(f"{var_name} = _jst.pack({getters})")
+
+
+def _call_stmt(names, helper, call_args):
+    call = f"_jst.{helper}({', '.join(call_args)})"
+    if names:
+        return _stmt(f"({', '.join(names)},) = {call}")
+    return _stmt(call)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.count = 0
+        self.converted = 0
+
+    # new scopes keep their own control flow untouched only at THEIR
+    # level — but we do transform nested defs' bodies too (they may be
+    # helper closures called under trace)
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _escapes_control_flow(node.body) or _escapes_control_flow(
+                node.orelse):
+            return node
+        n = self.count
+        self.count += 1
+        names = sorted(set(_assigned_names(node.body))
+                       | set(_assigned_names(node.orelse)))
+        in_var = f"__jst_in_{n}"
+        tfn = _fndef(f"__jst_true_{n}", names, node.body,
+                     tail_return=names)
+        ffn = _fndef(f"__jst_false_{n}", names, node.orelse,
+                     tail_return=names)
+        out = _call_stmt(names, "convert_ifelse", [
+            ast.unparse(node.test), tfn.name, ffn.name, in_var,
+            repr(tuple(names))])
+        self.converted += 1
+        return [_pack_stmt(in_var, names), tfn, ffn, out]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _escapes_control_flow(node.body):
+            return node
+        n = self.count
+        self.count += 1
+        names = sorted(set(_assigned_names(node.body)))
+        in_var = f"__jst_in_{n}"
+        cfn = _fndef(f"__jst_cond_{n}", names,
+                     [_stmt(f"return {ast.unparse(node.test)}")])
+        bfn = _fndef(f"__jst_body_{n}", names, node.body,
+                     tail_return=names)
+        out = _call_stmt(names, "convert_while", [
+            cfn.name, bfn.name, in_var, repr(tuple(names))])
+        self.converted += 1
+        return [_pack_stmt(in_var, names), cfn, bfn, out]
+
+
+def ast_transform(fn: Callable) -> Optional[Callable]:
+    """Rewrite fn's `if`/`while` statements into convert_* calls.
+    Returns the converted function, or None when conversion is not
+    possible (no source) or not needed (no control flow converted)."""
+    if inspect.ismethod(fn):
+        converted = ast_transform(fn.__func__)
+        return None if converted is None else converted.__get__(
+            fn.__self__)
+    if hasattr(fn, "__wrapped__"):
+        # functools-wrapped: getsource returns the INNER def; recompiling
+        # it would silently drop the wrapper's behavior. Bail to tracing.
+        return None
+    if "__class__" in fn.__code__.co_freevars:
+        # zero-arg super() needs the compiler-provided __class__ cell,
+        # which a module-level re-exec cannot recreate. Bail to tracing.
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []
+    tr = _ControlFlowTransformer()
+    tr.visit(tree)
+    if tr.converted == 0:
+        return None
+    ast.fix_missing_locations(tree)
+    glb = dict(fn.__globals__)
+    import sys
+    glb["_jst"] = sys.modules[__name__]
+    # re-executed source loses real closure cells; snapshot their values
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                return None  # unfilled cell (e.g. recursive def): bail
+    loc: dict = {}
+    try:
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, glb, loc)
+    except Exception:
+        return None
+    new_fn = loc.get(fdef.name)
+    if new_fn is None:
+        return None
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__wrapped_dy2static__ = fn
+    return new_fn

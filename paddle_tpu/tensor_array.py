@@ -1,0 +1,61 @@
+"""TensorArray parity (ref: python/paddle/tensor/array.py — create_array,
+array_write, array_read, array_length; backed by the C++ TensorArray in
+the reference's static graphs).
+
+TPU-native stance: the reference needs TensorArray as a graph-level
+dynamic list for while-loop bodies; here dynamic-length collection is a
+Python list in eager code, and inside `to_static`-staged loops the
+fixed-shape equivalent is a preallocated Tensor carried through
+lax.while_loop / lax.scan (see jit/dy2static.py). This module keeps the
+four reference APIs working in eager/dygraph code.
+"""
+from __future__ import annotations
+
+from .core.tensor import Tensor
+from . import ops
+
+
+class TensorArray(list):
+    """A list of Tensors with the reference's array semantics."""
+
+    def stack(self, axis=0):
+        return ops.stack(list(self), axis=axis)
+
+    def concat(self, axis=0):
+        return ops.concat(list(self), axis=axis)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = TensorArray()
+    for t in initialized_list or ():
+        arr.append(t if isinstance(t, Tensor) else Tensor(t))
+    return arr
+
+
+def _index(i):
+    if isinstance(i, Tensor):
+        return int(i.numpy())
+    return int(i)
+
+
+def array_write(x, i, array=None):
+    """Write x at index i, growing the array as the reference does."""
+    if array is None:
+        array = create_array()
+    i = _index(i)
+    if i < len(array):
+        array[i] = x
+    elif i == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {i} beyond array length {len(array)}")
+    return array
+
+
+def array_read(array, i):
+    return array[_index(i)]
+
+
+def array_length(array):
+    return Tensor(len(array))

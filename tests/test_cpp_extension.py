@@ -1,0 +1,110 @@
+"""Custom C++ host-op loading (paddle.utils.cpp_extension parity).
+
+Compiles a real C++ source with g++ and drives it eagerly and under jit
+(ref test style: test/custom_op/test_custom_relu_op_jit.py).
+"""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.utils import cpp_extension
+
+SRC = textwrap.dedent("""
+    extern "C" void square_add_f32(
+        const void* const* inputs, const long long* sizes, int n_inputs,
+        void* output, long long out_elems) {
+        const float* x = static_cast<const float*>(inputs[0]);
+        const float* y = static_cast<const float*>(inputs[1]);
+        float* out = static_cast<float*>(output);
+        for (long long i = 0; i < out_elems; ++i) {
+            out[i] = x[i] * x[i] + y[i];
+        }
+    }
+
+    extern "C" void negate_f32(
+        const void* const* inputs, const long long* sizes, int n_inputs,
+        void* output, long long out_elems) {
+        const float* x = static_cast<const float*>(inputs[0]);
+        float* out = static_cast<float*>(output);
+        for (long long i = 0; i < out_elems; ++i) out[i] = -x[i];
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    src = tmp_path_factory.mktemp("csrc") / "ops.cc"
+    src.write_text(SRC)
+    return cpp_extension.load("test_ops", [str(src)], verbose=False)
+
+
+def test_discovers_both_ops(ext):
+    assert callable(ext.square_add_f32)
+    assert callable(ext.negate_f32)
+    with pytest.raises(AttributeError, match="loaded ops"):
+        ext.missing_op
+
+
+def test_eager_matches_numpy(ext):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(64).astype(np.float32)
+    y = rng.standard_normal(64).astype(np.float32)
+    out = ext.square_add_f32(pt.to_tensor(x), pt.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), x * x + y, rtol=1e-6)
+    np.testing.assert_allclose(ext.negate_f32(pt.to_tensor(x)).numpy(),
+                               -x, rtol=1e-6)
+
+
+def test_under_jit(ext):
+    import jax
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+
+    @jax.jit
+    def f(a):
+        t = ext.square_add_f32(pt.Tensor(a), pt.Tensor(a))
+        return t._data
+
+    np.testing.assert_allclose(np.asarray(f(x)), x * x + x, rtol=1e-6)
+
+
+def test_build_cache_reuses_so(ext, tmp_path):
+    src = tmp_path / "ops2.cc"
+    src.write_text(SRC)
+    m1 = cpp_extension.load("cache_probe", [str(src)])
+    lib1 = m1._lib._name
+    m2 = cpp_extension.load("cache_probe", [str(src)])
+    assert m2._lib._name == lib1          # same hashed artifact
+
+def test_cuda_extension_refused():
+    with pytest.raises(RuntimeError, match="Pallas"):
+        cpp_extension.CUDAExtension()
+    with pytest.raises(RuntimeError, match="Pallas"):
+        cpp_extension.load("x", ["a.cc"], extra_cuda_cflags=["-O2"])
+
+
+class TestVendorPluginRegistry:
+    """C5: PJRT plugin registration is the CustomDevice analog."""
+
+    def test_bogus_plugin_fails_cleanly_without_registration(self):
+        from paddle_tpu import device
+        with pytest.raises(RuntimeError, match="failed to load"):
+            device.register_pjrt_plugin(
+                "fakevendor", "/nonexistent/libfake_pjrt.so")
+        assert "fakevendor" not in device.get_all_custom_device_type()
+        assert not device.is_compiled_with_custom_device("fakevendor")
+
+    def test_non_pjrt_library_rejected(self, tmp_path):
+        # a real .so that is not a PJRT plugin must also fail cleanly
+        src = tmp_path / "notpjrt.cc"
+        src.write_text('extern "C" int nothing() { return 0; }')
+        import subprocess, sys
+        lib = tmp_path / "libnotpjrt.so"
+        subprocess.run(["g++", "-shared", "-fPIC", str(src), "-o",
+                        str(lib)], check=True)
+        from paddle_tpu import device
+        with pytest.raises(RuntimeError, match="failed to load"):
+            device.register_pjrt_plugin("notpjrt", str(lib))
