@@ -65,7 +65,7 @@ def _pallas_available():
     return _pallas_ok
 
 
-def _ln_pallas(x2d, w, b, eps):
+def _ln_pallas(x2d, w, b, eps, interpret=False):
     n, h = x2d.shape
     rows = _rows_block(n, h, x2d.dtype)
     grid = (n // rows,)
@@ -81,10 +81,11 @@ def _ln_pallas(x2d, w, b, eps):
         kernel, grid=grid, in_specs=in_specs,
         out_specs=pl.BlockSpec((rows, h), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
+        interpret=interpret,
     )(*args)
 
 
-def _rms_pallas(x2d, w, eps):
+def _rms_pallas(x2d, w, eps, interpret=False):
     n, h = x2d.shape
     rows = _rows_block(n, h, x2d.dtype)
     grid = (n // rows,)
@@ -97,6 +98,7 @@ def _rms_pallas(x2d, w, eps):
         kernel, grid=grid, in_specs=in_specs,
         out_specs=pl.BlockSpec((rows, h), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
+        interpret=interpret,
     )(*args)
 
 

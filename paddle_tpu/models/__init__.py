@@ -17,3 +17,4 @@ from .llama import (  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForMaskedLM, bert_tiny, bert_base,
 )
+from .generation import generate  # noqa: F401

@@ -1,0 +1,158 @@
+"""Autoregressive decoding (generate) for the causal-LM families.
+
+Capability match for the reference's decoding stack (beam-search /
+sampling ops: gather_tree, top_p_sampling in ops.yaml; fluid inference's
+decoder loops). TPU-native design: the KV cache is PREALLOCATED at
+[b, max_len, heads, head_dim] and written in place with
+`dynamic_update_slice` each step, so every decode step has identical
+static shapes — one compiled program per model instead of the
+shape-per-length recompiles a concat-grown cache causes. Attention over
+the padded cache is masked by position, which routes through the masked
+XLA attention path (a 1-token query never needs the Pallas kernel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..core.tensor import Tensor
+
+
+def _static_cache(model, batch, max_len, dtype):
+    cfg = model.config
+    shape = (batch, max_len, cfg.num_heads, cfg.head_dim)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(cfg.num_layers)
+    ]
+
+
+def _decode_attention(attn, x, cache, pos):
+    """One-token (or prefill-chunk) attention against the static cache.
+    x: [b, s, hidden]; cache k/v: [b, max_len, h, d]; pos: int32 scalar
+    (tokens already in the cache)."""
+    b, s, _ = x.shape
+    qkv = attn.qkv_proj(x)
+    qkv = ops.reshape(qkv, (b, s, 3, attn.num_heads, attn.head_dim))
+    q, k, v = ops.unbind(qkv, axis=2)
+    kc = jax.lax.dynamic_update_slice(
+        cache["k"], k._data.astype(cache["k"].dtype), (0, pos, 0, 0))
+    vc = jax.lax.dynamic_update_slice(
+        cache["v"], v._data.astype(cache["v"].dtype), (0, pos, 0, 0))
+    max_len = kc.shape[1]
+    # causal-within-chunk + no-peeking-past-(pos+s) mask: [1,1,s,max_len]
+    kpos = jnp.arange(max_len)[None, :]
+    qpos = pos + jnp.arange(s)[:, None]
+    mask = (kpos <= qpos)[None, None]
+    out = ops.scaled_dot_product_attention(
+        q, Tensor._wrap(kc), Tensor._wrap(vc),
+        attn_mask=Tensor._wrap(mask), dropout_p=0.0, training=False)
+    out = ops.reshape(out, (b, s, attn.hidden_size))
+    return attn.out_proj(out), {"k": kc, "v": vc}
+
+
+def _forward_with_cache(model, input_ids, caches, pos):
+    """GPT trunk forward writing into the static caches at `pos`.
+    Only the LAST position's logits are returned — decode never reads
+    the rest, and skipping them makes prefill's vocab projection
+    O(1) in prompt length instead of O(s)."""
+    gpt = model.gpt
+    s = input_ids.shape[-1]
+    position_ids = Tensor._wrap(pos + jnp.arange(s, dtype=jnp.int32))
+    x = gpt.embeddings(input_ids, position_ids)
+    new_caches = []
+    for layer, cache in zip(gpt.layers, caches):
+        h = layer.ln1(x)
+        h, cache = _decode_attention(layer.attn, h, cache, pos)
+        x = x + h
+        x = x + layer.mlp(layer.ln2(x))
+        new_caches.append(cache)
+    x = gpt.final_norm(x)
+    last_logits = model.lm_logits(x[:, -1:])
+    return last_logits, new_caches
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_p=1.0, eos_token_id=None, seed=None):
+    """Greedy / nucleus-sampling decode for GPT-family causal LMs.
+
+    input_ids: [b, prompt_len] int Tensor/array. Returns [b, prompt_len +
+    max_new_tokens] int32 (positions after an eos stay eos).
+    """
+    if not hasattr(model, "gpt"):
+        raise NotImplementedError(
+            "generate() currently supports the GPT family (a model with "
+            "a .gpt trunk and learned position embeddings); for other "
+            "families decode through their own cache path")
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    b, prompt_len = ids.shape
+    cfg = model.config
+    max_len = prompt_len + max_new_tokens
+    if max_len > cfg.max_position_embeddings:
+        raise ValueError(
+            f"generate: {max_len} tokens exceed max_position_embeddings "
+            f"({cfg.max_position_embeddings})")
+    was_training = model.training
+    model.eval()
+    dtype = model.gpt.embeddings.word_embeddings.weight._data.dtype
+    caches = _static_cache(model, b, max_len, dtype)
+
+    if not do_sample:
+        key = None          # greedy must not touch the global RNG state
+    elif seed is not None:
+        key = jax.random.PRNGKey(seed)
+    else:
+        from ..core.generator import next_key
+        key = next_key()
+
+    def pick(logits_last, key):
+        lf = logits_last.astype(jnp.float32)
+        if not do_sample:
+            return jnp.argmax(lf, axis=-1).astype(jnp.int32)
+        lf = lf / max(temperature, 1e-6)
+        probs = jax.nn.softmax(lf, axis=-1)
+        if top_p < 1.0:
+            pv, nxt = ops.top_p_sampling(
+                Tensor._wrap(probs),
+                Tensor._wrap(jnp.full((b,), top_p, jnp.float32)),
+                key=key)
+            return nxt._data.reshape(b).astype(jnp.int32)
+        return jax.random.categorical(key, jnp.log(
+            jnp.maximum(probs, 1e-30)), axis=-1).astype(jnp.int32)
+
+    def split(key):
+        if key is None:
+            return None, None
+        return jax.random.split(key)
+
+    try:
+        # prefill: one chunked pass over the prompt
+        logits, caches = _forward_with_cache(
+            model, Tensor._wrap(ids), caches, 0)
+        key, sub = split(key)
+        nxt = pick(logits._data[:, -1], sub)
+
+        out = jnp.concatenate(
+            [ids, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1)
+        out = out.at[:, prompt_len].set(nxt)
+        finished = jnp.zeros((b,), jnp.bool_) \
+            if eos_token_id is not None else None
+        # decode: identical static shapes per step -> per-op caches hit
+        for step in range(1, max_new_tokens):
+            pos = prompt_len + step - 1
+            if finished is not None:
+                finished = finished | (nxt == eos_token_id)
+            logits, caches = _forward_with_cache(
+                model, Tensor._wrap(nxt[:, None]), caches, pos)
+            key, sub = split(key)
+            nxt = pick(logits._data[:, -1], sub)
+            if finished is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+            out = out.at[:, prompt_len + step].set(nxt)
+    finally:
+        if was_training:
+            model.train()
+    return Tensor._wrap(out)

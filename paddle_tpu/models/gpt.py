@@ -194,6 +194,11 @@ class GPTModel(Layer):
 class GPTForCausalLM(Layer):
     """GPT with a (tied) LM head producing [b, s, vocab] logits."""
 
+    def generate(self, input_ids, **kwargs):
+        """Static-shape KV-cache decoding (see models/generation.py)."""
+        from .generation import generate
+        return generate(self, input_ids, **kwargs)
+
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -206,17 +211,21 @@ class GPTForCausalLM(Layer):
                                       std=config.initializer_range),
                                   bias_attr=False)
 
+    def lm_logits(self, hidden):
+        """Project hidden states to vocab logits (tied or untied head) —
+        shared by forward() and the decode path (models/generation.py)."""
+        if self.lm_head is None:
+            w = self.gpt.embeddings.word_embeddings.weight
+            return ops.matmul(hidden, w, transpose_y=True)
+        return self.lm_head(hidden)
+
     def forward(self, input_ids, position_ids=None, caches=None):
         out = self.gpt(input_ids, position_ids, caches)
         if caches is not None:
             hidden, new_caches = out
         else:
             hidden = out
-        if self.lm_head is None:
-            w = self.gpt.embeddings.word_embeddings.weight
-            logits = ops.matmul(hidden, w, transpose_y=True)
-        else:
-            logits = self.lm_head(hidden)
+        logits = self.lm_logits(hidden)
         return (logits, new_caches) if caches is not None else logits
 
 
