@@ -384,3 +384,11 @@ class DataLoader:
             stop.set()
             for nq in queues:
                 nq.close()
+
+
+def get_worker_info():
+    """ref: io/dataloader/worker.py get_worker_info. The native loader
+    collates in C++ threads inside one process (io/native), so from
+    Python's view there is no forked worker context — None, exactly what
+    the reference returns outside a worker process."""
+    return None

@@ -9,14 +9,17 @@ from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
 )
 
+from . import utils  # noqa: F401
 from .layers.common import (  # noqa: F401
     Linear, Identity, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,
+    Fold,
     Flatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D,
     Pad2D, Pad3D, ZeroPad2D, Bilinear, CosineSimilarity, PairwiseDistance,
     PixelShuffle, PixelUnshuffle, ChannelShuffle, Unfold,
 )
 from .layers.conv import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose,
+    Conv3DTranspose,
 )
 from .layers.norm import (  # noqa: F401
     LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
@@ -28,10 +31,13 @@ from .layers.activation import (  # noqa: F401
     ELU, SELU, CELU, Silu, Swish, Mish, Hardswish, Hardsigmoid, Hardtanh,
     Hardshrink, Softshrink, Tanhshrink, Softplus, Softsign, ThresholdedReLU,
     LogSigmoid, Maxout, GLU,
+    SiLU, Softmax2D,
 )
 from .layers.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+    AdaptiveAvgPool3D, AdaptiveMaxPool1D, AdaptiveMaxPool3D,
+    MaxUnPool2D,
 )
 from .layers.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
@@ -47,4 +53,5 @@ from .layers.transformer import (  # noqa: F401
 )
 from .layers.rnn import (  # noqa: F401
     LSTM, GRU, SimpleRNN, LSTMCell, GRUCell,
+    RNN, BiRNN, RNNCellBase, SimpleRNNCell,
 )

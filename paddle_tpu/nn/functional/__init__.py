@@ -15,6 +15,7 @@ from ...ops import (  # noqa: F401
     conv1d, conv2d, conv3d, conv2d_transpose,
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool2d,
+    adaptive_avg_pool3d, adaptive_max_pool1d, adaptive_max_pool3d,
     layer_norm, rms_norm, batch_norm, group_norm, instance_norm,
     local_response_norm,
     mse_loss, l1_loss, smooth_l1_loss, cross_entropy,

@@ -250,3 +250,16 @@ class GLU(Layer):
 
     def forward(self, x):
         return ops.glu(x, self.axis)
+
+
+SiLU = Silu  # reference exports both spellings
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW inputs
+    (ref: nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3D or 4D input")
+        return ops.softmax(x, axis=-3)

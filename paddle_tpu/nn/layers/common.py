@@ -244,3 +244,16 @@ class Unfold(Layer):
 
     def forward(self, x):
         return ops.unfold_im2col(x, *self.args)
+
+
+class Fold(Layer):
+    """col2im layer over ops.fold (ref: nn/layer/common.py Fold)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        return ops.fold(x, *self.args)

@@ -147,3 +147,19 @@ class Conv1DTranspose(Conv2DTranspose):
             self.dilation,
             self.groups)
         return ops.squeeze(out, 2)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return ops.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                    self.padding, self.output_padding,
+                                    self.dilation, self.groups,
+                                    self.data_format)

@@ -247,3 +247,88 @@ class GRUCell(Layer):
         out, hT = _gru_scan(seq, states, self.weight_ih, self.weight_hh,
                                self.bias_ih, self.bias_hh)
         return hT, hT
+
+
+class RNNCellBase(Layer):
+    """Base for single-step cells (ref: nn/layer/rnn.py RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return ops.full((b, self.hidden_size), init_value, dtype=dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (hidden_size, input_size), default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (hidden_size, hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (hidden_size,), is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            (hidden_size,), is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = ops.zeros((inputs.shape[0], self.hidden_size))
+        pre = (ops.matmul(inputs, self.weight_ih, transpose_y=True)
+               + self.bias_ih
+               + ops.matmul(states, self.weight_hh, transpose_y=True)
+               + self.bias_hh)
+        h = ops.tanh(pre) if self.activation == "tanh" else ops.relu(pre)
+        return h, h
+
+
+class RNN(Layer):
+    """Run any cell over the time axis (ref: nn/layer/rnn.py RNN).
+    Python-loop over steps: eager semantics match the reference; staged
+    code should prefer the fused LSTM/GRU/SimpleRNN layers (lax.scan)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else ops.transpose(
+            inputs, (1, 0, 2))
+        steps = range(x.shape[0])
+        if self.is_reverse:
+            steps = reversed(list(steps))
+        states = initial_states
+        outs = [None] * x.shape[0]
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs[t] = out
+        seq = ops.stack(outs, axis=0)
+        if not self.time_major:
+            seq = ops.transpose(seq, (1, 0, 2))
+        return seq, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, concatenated features
+    (ref: nn/layer/rnn.py BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            fw0 = bw0 = None
+        else:
+            fw0, bw0 = initial_states
+        out_f, st_f = self.rnn_fw(inputs, fw0)
+        out_b, st_b = self.rnn_bw(inputs, bw0)
+        return ops.concat([out_f, out_b], axis=-1), (st_f, st_b)

@@ -1,0 +1,116 @@
+"""nn.utils parity (ref: python/paddle/nn/utils/): weight/spectral norm
+reparameterizations and gradient/parameter vector helpers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+
+__all__ = ["spectral_norm", "weight_norm", "remove_weight_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Wrap a layer so `name` is spectrally normalized each forward
+    (ref: nn/utils/spectral_norm_hook.py). Implemented as a forward
+    pre-hook recomputing W / sigma via power iteration."""
+    if dim is None:
+        dim = 0
+    orig = getattr(layer, name)
+    setattr(layer, name + "_orig", orig)
+    # the raw weight must leave the parameter set: weight_orig is the
+    # trainable one, `name` becomes a derived plain attribute
+    layer._parameters.pop(name, None)
+
+    real_forward = layer.forward
+
+    def hooked(*args, **kwargs):
+        w = getattr(layer, name + "_orig")
+        wn = ops.spectral_norm(w, dim=dim,
+                               power_iters=n_power_iterations, eps=eps)
+        object.__setattr__(layer, name, wn)
+        return real_forward(*args, **kwargs)
+
+    layer.forward = hooked
+    return layer
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """w = g * v / ||v|| reparameterization (ref: nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    wd = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    axes = tuple(i for i in range(wd.ndim) if i != dim % wd.ndim)
+    g = jnp.linalg.norm(wd.astype(jnp.float32), axis=axes, keepdims=True)
+    layer.add_parameter(name + "_g", Tensor._wrap(
+        g.astype(wd.dtype), stop_gradient=False))
+    layer.add_parameter(name + "_v", Tensor._wrap(wd, stop_gradient=False))
+    layer._parameters.pop(name, None)
+
+    real_forward = layer.forward
+
+    def hooked(*args, **kwargs):
+        v = getattr(layer, name + "_v")
+        gg = getattr(layer, name + "_g")
+        vf = v._data.astype(jnp.float32)
+        norm = jnp.linalg.norm(vf, axis=axes, keepdims=True)
+        wnew = (vf / jnp.maximum(norm, 1e-12) *
+                gg._data.astype(jnp.float32)).astype(v._data.dtype)
+        object.__setattr__(layer, name, Tensor._wrap(wnew))
+        return real_forward(*args, **kwargs)
+
+    layer._wn_orig_forward = real_forward
+    layer.forward = hooked
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if hasattr(layer, "_wn_orig_forward"):
+        layer.forward = layer._wn_orig_forward
+        del layer._wn_orig_forward
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip (ref: nn/utils/clip_grad.py)."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(0.0)
+    if norm_type == float("inf"):
+        total = max(float(jnp.max(jnp.abs(p.grad._data))) for p in params)
+        total = jnp.asarray(total)
+    else:
+        total = jnp.sum(jnp.stack([
+            jnp.sum(jnp.abs(p.grad._data.astype(jnp.float32))
+                    ** norm_type) for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("gradient norm is non-finite")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._set_data((p.grad._data.astype(jnp.float32)
+                          * scale).astype(p.grad._data.dtype))
+    return Tensor._wrap(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._set_data(jnp.clip(p.grad._data, -clip_value,
+                                      clip_value))
+
+
+def parameters_to_vector(parameters):
+    return ops.concat([ops.reshape(p, (-1,)) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        chunk = vec._data[offset:offset + n].reshape(tuple(p.shape))
+        p._set_data(chunk.astype(p._data.dtype))
+        offset += n

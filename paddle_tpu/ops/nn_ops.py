@@ -1124,3 +1124,56 @@ def bilinear(x1, x2, weight, bias=None):
     if bias is not None:
         out = out + bias.reshape(1, -1)
     return out
+
+
+@register_op("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size):
+    """ref: max_pool2d_with_index family, 1-D adaptive variant."""
+    L = x.shape[-1]
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    cols = []
+    for i in range(o):
+        lo, hi = (i * L) // o, -(-((i + 1) * L) // o)
+        cols.append(jnp.max(x[..., lo:hi], axis=-1))
+    return jnp.stack(cols, axis=-1)
+
+
+@register_op("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool3d(x, output_size, jnp.mean, data_format)
+
+
+@register_op("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool3d(x, output_size, jnp.max, data_format)
+
+
+def _adaptive_pool3d(x, output_size, reducer, data_format):
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    channel_last = data_format[-1] == "C"
+    axes = (1, 2, 3) if channel_last else (2, 3, 4)
+    dims = [x.shape[a] for a in axes]
+    planes = []
+    for i in range(output_size[0]):
+        d0, d1 = (i * dims[0]) // output_size[0], \
+            -(-((i + 1) * dims[0]) // output_size[0])
+        rows = []
+        for j in range(output_size[1]):
+            h0, h1 = (j * dims[1]) // output_size[1], \
+                -(-((j + 1) * dims[1]) // output_size[1])
+            cols = []
+            for k in range(output_size[2]):
+                w0, w1 = (k * dims[2]) // output_size[2], \
+                    -(-((k + 1) * dims[2]) // output_size[2])
+                sl = [slice(None)] * x.ndim
+                sl[axes[0]] = slice(d0, d1)
+                sl[axes[1]] = slice(h0, h1)
+                sl[axes[2]] = slice(w0, w1)
+                cols.append(reducer(x[tuple(sl)], axis=axes))
+            rows.append(jnp.stack(cols, axis=-1))
+        planes.append(jnp.stack(rows, axis=-2))
+    stacked = jnp.stack(planes, axis=-3)
+    if channel_last:
+        return jnp.moveaxis(stacked, 1, -1)
+    return stacked

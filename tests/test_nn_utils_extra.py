@@ -1,0 +1,120 @@
+"""nn.utils + new layer surface (ref: python/paddle/nn/utils/,
+nn/layer/{rnn,pooling,conv,common}.py parity additions)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import ops
+
+
+def t(x):
+    return pt.to_tensor(np.asarray(x, np.float32))
+
+
+class TestNnUtils:
+    def test_weight_norm_preserves_function_and_reparam(self):
+        lin = nn.Linear(4, 3)
+        w0 = np.array(lin.weight.numpy())
+        nn.utils.weight_norm(lin)
+        assert sorted(lin._parameters) == ["bias", "weight_g", "weight_v"]
+        x = t(np.random.default_rng(0).standard_normal((2, 4)))
+        y = lin(x).numpy()
+        ref = x.numpy() @ w0 + lin.bias.numpy()
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+        nn.utils.remove_weight_norm(lin)
+
+    def test_clip_grad_norm_scales_to_max(self):
+        lin = nn.Linear(3, 3)
+        x = t(np.ones((2, 3)))
+        loss = ops.sum(lin(x) ** 2.0)
+        loss.backward()
+        nn.utils.clip_grad_norm_(lin.parameters(), 0.5)
+        total = np.sqrt(sum(
+            float((np.asarray(p.grad.numpy(), np.float64) ** 2).sum())
+            for p in lin.parameters()))
+        assert total <= 0.5 + 1e-4
+
+    def test_clip_grad_value(self):
+        lin = nn.Linear(3, 3)
+        loss = ops.sum(lin(t(np.ones((2, 3)))) * 10.0)
+        loss.backward()
+        nn.utils.clip_grad_value_(lin.parameters(), 0.1)
+        for p in lin.parameters():
+            assert float(np.abs(p.grad.numpy()).max()) <= 0.1 + 1e-6
+
+    def test_parameter_vector_roundtrip(self):
+        lin = nn.Linear(4, 2)
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        before = [np.array(p.numpy()) for p in lin.parameters()]
+        nn.utils.vector_to_parameters(vec * 0.0 + 1.0, lin.parameters())
+        for p in lin.parameters():
+            np.testing.assert_allclose(p.numpy(), np.ones(p.shape))
+        assert vec.shape[0] == sum(b.size for b in before)
+
+    def test_spectral_norm_bounds_sigma(self):
+        lin = nn.Linear(8, 4)
+        nn.utils.spectral_norm(lin, n_power_iterations=30)
+        lin(t(np.ones((1, 8))))
+        s = np.linalg.svd(np.asarray(lin.weight.numpy()),
+                          compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, atol=5e-2)
+
+
+class TestNewLayers:
+    def test_rnn_over_cell_matches_manual(self):
+        pt.seed(0)
+        cell = nn.SimpleRNNCell(3, 5)
+        rnn = nn.RNN(cell)
+        x = t(np.random.default_rng(1).standard_normal((2, 4, 3)))
+        out, last = rnn(x)
+        h = None
+        for step in range(4):
+            o, h = cell(pt.to_tensor(x.numpy()[:, step]), h)
+        np.testing.assert_allclose(out.numpy()[:, -1], o.numpy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(last.numpy(), h.numpy(), rtol=1e-5)
+
+    def test_birnn_concat_dims(self):
+        bi = nn.BiRNN(nn.SimpleRNNCell(3, 5), nn.SimpleRNNCell(3, 5))
+        out, _ = bi(t(np.ones((2, 4, 3))))
+        assert list(out.shape) == [2, 4, 10]
+
+    def test_conv3d_transpose_layer(self):
+        layer = nn.Conv3DTranspose(2, 3, 3, stride=2, padding=1)
+        out = layer(t(np.ones((1, 2, 4, 4, 4))))
+        ref = TF.conv_transpose3d(
+            torch.ones(1, 2, 4, 4, 4),
+            torch.tensor(np.asarray(layer.weight.numpy())),
+            torch.tensor(np.asarray(layer.bias.numpy())),
+            stride=2, padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.detach().numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_adaptive_pools(self):
+        x = t(np.arange(2 * 3 * 8, dtype=np.float32).reshape(2, 3, 8))
+        o = F.adaptive_max_pool1d(x, 4)
+        ref = TF.adaptive_max_pool1d(torch.tensor(x.numpy()), 4)
+        np.testing.assert_allclose(o.numpy(), ref.numpy())
+        x3 = t(np.random.default_rng(2).standard_normal((1, 2, 4, 6, 8)))
+        o3 = nn.AdaptiveAvgPool3D((2, 3, 4))(x3)
+        ref3 = TF.adaptive_avg_pool3d(torch.tensor(x3.numpy()), (2, 3, 4))
+        np.testing.assert_allclose(o3.numpy(), ref3.numpy(), rtol=1e-5)
+        om = nn.AdaptiveMaxPool3D(2)(x3)
+        refm = TF.adaptive_max_pool3d(torch.tensor(x3.numpy()), 2)
+        np.testing.assert_allclose(om.numpy(), refm.numpy(), rtol=1e-5)
+
+    def test_softmax2d(self):
+        x = t(np.random.default_rng(3).standard_normal((2, 3, 4, 4)))
+        out = nn.Softmax2D()(x)
+        np.testing.assert_allclose(out.numpy().sum(axis=1),
+                                   np.ones((2, 4, 4)), rtol=1e-5)
+
+    def test_fold_layer_and_get_worker_info(self):
+        import paddle_tpu.io as io
+        assert io.get_worker_info() is None
+        f = nn.Fold(output_sizes=(4, 4), kernel_sizes=2)
+        assert list(f(t(np.ones((1, 12, 9)))).shape) == [1, 3, 4, 4]
