@@ -107,8 +107,12 @@ class Conv2DTranspose(_ConvNd):
                          output_padding=output_padding)
 
     def forward(self, x, output_size=None):
+        outpad = (_outpad_from_size(x, output_size, self.kernel_size,
+                                    self.stride, self.padding,
+                                    self.dilation, 2)
+                  if output_size is not None else self.output_padding)
         return ops.conv2d_transpose(x, self.weight, self.bias, self.stride,
-                                    self.padding, self.output_padding,
+                                    self.padding, outpad,
                                     self.dilation, self.groups,
                                     self.data_format)
 
@@ -159,7 +163,36 @@ class Conv3DTranspose(_ConvNd):
                          output_padding=output_padding)
 
     def forward(self, x, output_size=None):
+        outpad = (_outpad_from_size(x, output_size, self.kernel_size,
+                                    self.stride, self.padding,
+                                    self.dilation, 3)
+                  if output_size is not None else self.output_padding)
         return ops.conv3d_transpose(x, self.weight, self.bias, self.stride,
-                                    self.padding, self.output_padding,
+                                    self.padding, outpad,
                                     self.dilation, self.groups,
                                     self.data_format)
+
+
+def _outpad_from_size(x, output_size, kernel, stride, padding, dilation, n):
+    """Derive output_padding so the transpose conv lands exactly on the
+    requested output_size (ref: nn/layer/conv.py _ConvTranspose shape
+    disambiguation)."""
+    from ...ops.nn_ops import _norm_tuple, _conv_padding
+    output_size = _norm_tuple(output_size[-n:] if len(output_size) > n
+                              else output_size, n)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _conv_padding(padding, n)
+    kernel = _norm_tuple(kernel, n)
+    spatial = x.shape[2:2 + n]
+    outpad = []
+    for i in range(n):
+        base = ((spatial[i] - 1) * stride[i] - pad[i][0] - pad[i][1]
+                + dilation[i] * (kernel[i] - 1) + 1)
+        op_i = int(output_size[i]) - base
+        if not (0 <= op_i < stride[i] + dilation[i]):
+            raise ValueError(
+                f"output_size {output_size} unreachable for input "
+                f"{tuple(spatial)} with stride {stride}")
+        outpad.append(op_i)
+    return tuple(outpad)

@@ -114,6 +114,11 @@ class AdaptiveAvgPool3D(Layer):
 class AdaptiveMaxPool1D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool1D(return_mask=True) is not supported "
+                "yet; use max_pool2d_with_index for index-producing "
+                "pooling")
         self.output_size = output_size
 
     def forward(self, x):
@@ -123,6 +128,10 @@ class AdaptiveMaxPool1D(Layer):
 class AdaptiveMaxPool3D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool3D(return_mask=True) is not supported "
+                "yet")
         self.output_size = output_size
 
     def forward(self, x):
@@ -135,6 +144,9 @@ class MaxUnPool2D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0,
                  data_format="NCHW", output_size=None, name=None):
         super().__init__()
+        if data_format != "NCHW":
+            raise NotImplementedError(
+                "MaxUnPool2D currently supports NCHW only")
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding

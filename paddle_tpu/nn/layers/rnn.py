@@ -306,9 +306,32 @@ class RNN(Layer):
             steps = reversed(list(steps))
         states = initial_states
         outs = [None] * x.shape[0]
+
+        def _mask_step(t, new, old):
+            # positions past a sequence's length keep the old state and
+            # emit zero output (ref: rnn.py _maybe_copy / sequence mask)
+            if sequence_length is None:
+                return new, new
+            live = ops.unsqueeze(
+                ops.cast(sequence_length > t, "float32"), -1)
+            def mix(n, o):
+                if o is None:
+                    return n * live
+                return n * live + o * (1.0 - live)
+            if isinstance(new, tuple):
+                old = old if isinstance(old, tuple) else (None,) * len(new)
+                return None, tuple(mix(n, o) for n, o in zip(new, old))
+            return None, mix(new, old)
+
         for t in steps:
-            out, states = self.cell(x[t], states)
+            out, new_states = self.cell(x[t], states)
+            if sequence_length is not None:
+                live = ops.unsqueeze(
+                    ops.cast(sequence_length > t, out.dtype), -1)
+                out = out * live
+                _, new_states = _mask_step(t, new_states, states)
             outs[t] = out
+            states = new_states
         seq = ops.stack(outs, axis=0)
         if not self.time_major:
             seq = ops.transpose(seq, (1, 0, 2))

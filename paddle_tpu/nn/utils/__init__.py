@@ -16,8 +16,10 @@ __all__ = ["spectral_norm", "weight_norm", "remove_weight_norm",
 def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
                   dim=None):
     """Wrap a layer so `name` is spectrally normalized each forward
-    (ref: nn/utils/spectral_norm_hook.py). Implemented as a forward
-    pre-hook recomputing W / sigma via power iteration."""
+    (ref: nn/utils/spectral_norm_hook.py). The left singular vector u
+    PERSISTS across calls (as in the reference's buffer) so the default
+    single power iteration converges over training instead of
+    re-estimating from scratch each call."""
     if dim is None:
         dim = 0
     orig = getattr(layer, name)
@@ -30,9 +32,23 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
 
     def hooked(*args, **kwargs):
         w = getattr(layer, name + "_orig")
-        wn = ops.spectral_norm(w, dim=dim,
-                               power_iters=n_power_iterations, eps=eps)
-        object.__setattr__(layer, name, wn)
+        wd = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+        mat = jnp.moveaxis(wd, dim, 0)
+        mat2 = mat.reshape(mat.shape[0], -1).astype(jnp.float32)
+        u = getattr(layer, name + "_u", None)
+        if u is None:
+            u = jnp.ones((mat2.shape[0],), jnp.float32) / np.sqrt(
+                mat2.shape[0])
+        for _ in range(max(n_power_iterations, 1)):
+            v = mat2.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat2 @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        object.__setattr__(layer, name + "_u", u)
+        sigma = u @ mat2 @ v
+        wn = (wd.astype(jnp.float32) / jnp.maximum(sigma, eps)).astype(
+            wd.dtype)
+        object.__setattr__(layer, name, Tensor._wrap(wn))
         return real_forward(*args, **kwargs)
 
     layer.forward = hooked
@@ -40,10 +56,12 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
 
 
 def weight_norm(layer, name="weight", dim=0):
-    """w = g * v / ||v|| reparameterization (ref: nn/utils/weight_norm_hook.py)."""
+    """w = g * v / ||v|| reparameterization (ref: nn/utils/weight_norm_hook.py).
+    dim=None norms over the whole tensor (scalar g), as the reference does."""
     w = getattr(layer, name)
     wd = w._data if isinstance(w, Tensor) else jnp.asarray(w)
-    axes = tuple(i for i in range(wd.ndim) if i != dim % wd.ndim)
+    axes = (tuple(range(wd.ndim)) if dim is None else
+            tuple(i for i in range(wd.ndim) if i != dim % wd.ndim))
     g = jnp.linalg.norm(wd.astype(jnp.float32), axis=axes, keepdims=True)
     layer.add_parameter(name + "_g", Tensor._wrap(
         g.astype(wd.dtype), stop_gradient=False))
@@ -68,32 +86,39 @@ def weight_norm(layer, name="weight", dim=0):
 
 
 def remove_weight_norm(layer, name="weight"):
-    if hasattr(layer, "_wn_orig_forward"):
-        layer.forward = layer._wn_orig_forward
-        del layer._wn_orig_forward
+    """Reconstitute a plain trainable `name` parameter from g/v and
+    restore the original forward (ref: weight_norm_hook.remove)."""
+    if not hasattr(layer, "_wn_orig_forward"):
+        return layer
+    layer.forward = layer._wn_orig_forward
+    del layer._wn_orig_forward
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    vf = v._data.astype(jnp.float32)
+    axes = tuple(i for i in range(vf.ndim)
+                 if g._data.shape[i] == 1) if g._data.ndim == vf.ndim \
+        else tuple(range(vf.ndim))
+    norm = jnp.linalg.norm(vf, axis=axes, keepdims=True)
+    w = (vf / jnp.maximum(norm, 1e-12)
+         * g._data.astype(jnp.float32)).astype(v._data.dtype)
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Tensor._wrap(w, stop_gradient=False))
     return layer
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
                     error_if_nonfinite=False):
-    """In-place global-norm gradient clip (ref: nn/utils/clip_grad.py)."""
-    params = [p for p in parameters if p.grad is not None]
-    if not params:
-        return Tensor(0.0)
-    if norm_type == float("inf"):
-        total = max(float(jnp.max(jnp.abs(p.grad._data))) for p in params)
-        total = jnp.asarray(total)
-    else:
-        total = jnp.sum(jnp.stack([
-            jnp.sum(jnp.abs(p.grad._data.astype(jnp.float32))
-                    ** norm_type) for p in params])) ** (1.0 / norm_type)
-    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+    """In-place global-norm gradient clip (ref: nn/utils/clip_grad.py).
+    Delegates to the single implementation in nn/clip.py — two diverging
+    clippers under the same name is exactly the bug class this avoids."""
+    from ..clip import clip_grad_norm_ as _impl
+    total = _impl(parameters, max_norm, norm_type=norm_type,
+                  error_if_nonfinite=error_if_nonfinite)
+    if error_if_nonfinite and not bool(jnp.isfinite(total._data)):
         raise RuntimeError("gradient norm is non-finite")
-    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
-    for p in params:
-        p.grad._set_data((p.grad._data.astype(jnp.float32)
-                          * scale).astype(p.grad._data.dtype))
-    return Tensor._wrap(total)
+    return total
 
 
 def clip_grad_value_(parameters, clip_value):

@@ -1154,6 +1154,14 @@ def _adaptive_pool3d(x, output_size, reducer, data_format):
     channel_last = data_format[-1] == "C"
     axes = (1, 2, 3) if channel_last else (2, 3, 4)
     dims = [x.shape[a] for a in axes]
+    if all(d % o == 0 for d, o in zip(dims, output_size)) \
+            and not channel_last:
+        # evenly divisible: one reshape + one fused reduction
+        n, c = x.shape[:2]
+        od, oh, ow = output_size
+        r = x.reshape(n, c, od, dims[0] // od, oh, dims[1] // oh,
+                      ow, dims[2] // ow)
+        return reducer(r, axis=(3, 5, 7))
     planes = []
     for i in range(output_size[0]):
         d0, d1 = (i * dims[0]) // output_size[0], \

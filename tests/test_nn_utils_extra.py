@@ -118,3 +118,60 @@ class TestNewLayers:
         assert io.get_worker_info() is None
         f = nn.Fold(output_sizes=(4, 4), kernel_sizes=2)
         assert list(f(t(np.ones((1, 12, 9)))).shape) == [1, 3, 4, 4]
+
+
+class TestReviewFixes:
+    def test_remove_weight_norm_restores_trainable_weight(self):
+        lin = nn.Linear(4, 3)
+        nn.utils.weight_norm(lin)
+        lin(t(np.ones((1, 4))))
+        nn.utils.remove_weight_norm(lin)
+        assert "weight" in lin._parameters
+        assert "weight_g" not in lin._parameters
+        y = ops.sum(lin(t(np.ones((2, 4)))) ** 2.0)
+        y.backward()
+        assert lin.weight.grad is not None
+
+    def test_spectral_norm_u_persists_and_converges(self):
+        lin = nn.Linear(8, 4)
+        nn.utils.spectral_norm(lin)  # default 1 power iteration
+        x = t(np.ones((1, 8)))
+        for _ in range(40):          # u converges across calls
+            lin(x)
+        s = np.linalg.svd(np.asarray(lin.weight.numpy()),
+                          compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, atol=5e-2)
+
+    def test_rnn_sequence_length_masks(self):
+        pt.seed(1)
+        cell = nn.SimpleRNNCell(3, 5)
+        rnn = nn.RNN(cell)
+        x = t(np.random.default_rng(4).standard_normal((2, 6, 3)))
+        lens = pt.to_tensor(np.array([6, 3], np.int32))
+        out, last = rnn(x, sequence_length=lens)
+        # row 1: outputs past step 3 are zero, final state = state@step3
+        assert np.abs(out.numpy()[1, 3:]).max() == 0.0
+        short, short_last = rnn(t(x.numpy()[1:2, :3]))
+        np.testing.assert_allclose(last.numpy()[1], short_last.numpy()[0],
+                                   rtol=1e-5)
+
+    def test_conv_transpose_output_size(self):
+        layer = nn.Conv3DTranspose(2, 3, 3, stride=2, padding=1)
+        x = t(np.ones((1, 2, 4, 4, 4)))
+        assert list(layer(x).shape)[2:] == [7, 7, 7]
+        assert list(layer(x, output_size=(8, 8, 8)).shape)[2:] == [8, 8, 8]
+        with pytest.raises(ValueError, match="unreachable"):
+            layer(x, output_size=(20, 20, 20))
+
+    def test_return_mask_refused(self):
+        with pytest.raises(NotImplementedError):
+            nn.AdaptiveMaxPool3D(2, return_mask=True)
+
+    def test_clip_delegation_single_impl(self):
+        import paddle_tpu.nn.clip as clipmod
+        lin = nn.Linear(3, 3)
+        loss = ops.sum(lin(t(np.ones((2, 3)))) ** 2.0)
+        loss.backward()
+        n1 = nn.utils.clip_grad_norm_(lin.parameters(), 1e9)
+        n2 = clipmod.clip_grad_norm_(lin.parameters(), 1e9)
+        np.testing.assert_allclose(n1.numpy(), n2.numpy(), rtol=1e-6)
