@@ -388,6 +388,20 @@ class TestRegistryCoverage:
         "send_u_recv", "send_ue_recv", "send_uv", "segment_pool",
         # covered by tests/test_nn_utils_extra.py
         "adaptive_max_pool1d", "adaptive_avg_pool3d", "adaptive_max_pool3d",
+        # covered by tests/test_ops_torch_oracle.py
+        "lerp", "ldexp", "histogram", "bincount", "kthvalue", "mode",
+        "quantile", "nanquantile", "nanmedian", "polygamma",
+        "searchsorted", "put_along_axis", "take_along_axis",
+        "index_select", "index_add", "masked_fill", "masked_select",
+        "cholesky_solve", "matrix_power", "svdvals", "pinv",
+        "householder_product", "dist", "cov", "corrcoef", "glu", "prelu",
+        "cosine_similarity", "triplet_margin_loss",
+        "hinge_embedding_loss", "cosine_embedding_loss",
+        "margin_ranking_loss", "sigmoid_cross_entropy_with_logits",
+        "log_loss", "isclose", "equal_all", "allclose", "diag_embed",
+        "diagflat", "trapezoid", "cumulative_trapezoid", "unfold",
+        "repeat_interleave", "nonzero", "increment", "gather_nd",
+        "strided_slice", "expand_as", "angle", "conj",
     }
 
     def test_coverage_accounting(self):
@@ -411,7 +425,7 @@ class TestRegistryCoverage:
                                           "dist_", "moe_", "pp_xfer",
                                           "ring_", "to_static_"))]
         # Gate: breadth may grow, but the uncovered tail must not.
-        assert len(uncovered) <= 120, (
+        assert len(uncovered) <= 70, (
             f"{len(uncovered)} registered ops lack conformance coverage; "
             f"add them to a family table or a dedicated module: "
             f"{uncovered}")

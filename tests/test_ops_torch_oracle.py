@@ -1,0 +1,249 @@
+"""Torch-oracle conformance for the op long tail (C32).
+
+Each case drives a registered op against torch's CPU implementation —
+the same comparison style as the reference's OpTest-vs-framework checks
+(test/legacy_test/op_test.py) but vectorized over a case table instead
+of per-op classes.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(0)
+
+
+def t(x):
+    return pt.to_tensor(np.asarray(x))
+
+
+def npy(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+A23 = rng.standard_normal((2, 3)).astype(np.float32)
+A345 = rng.standard_normal((3, 4, 5)).astype(np.float32)
+V8 = rng.standard_normal(8).astype(np.float32)
+POS33 = (rng.random((3, 3)) + 0.5).astype(np.float32)
+SPD = (lambda m: (m @ m.T + 3 * np.eye(4)).astype(np.float32))(
+    rng.standard_normal((4, 4)))
+
+# (name, our_fn, torch_fn, rtol)
+CASES = [
+    ("lerp", lambda: ops.lerp(t(A23), t(A23 * 2), 0.3),
+     lambda: torch.lerp(torch.tensor(A23), torch.tensor(A23 * 2), 0.3),
+     1e-5),
+    ("ldexp", lambda: ops.ldexp(t(A23), t(np.array([1, 2, 3], np.int32))),
+     lambda: torch.ldexp(torch.tensor(A23), torch.tensor([1, 2, 3])),
+     1e-5),
+    ("histogram", lambda: ops.histogram(t(V8), bins=4, min=-2, max=2),
+     lambda: torch.histc(torch.tensor(V8), bins=4, min=-2, max=2), 0),
+    ("bincount",
+     lambda: ops.bincount(t(np.array([0, 1, 1, 3], np.int32)), minlength=5),
+     lambda: torch.bincount(torch.tensor([0, 1, 1, 3]), minlength=5), 0),
+    ("kthvalue", lambda: ops.kthvalue(t(A345), 2, axis=-1)[0],
+     lambda: torch.kthvalue(torch.tensor(A345), 2, dim=-1)[0], 1e-6),
+    ("mode", lambda: ops.mode(t(np.array([[1., 2., 2.], [3., 3., 1.]])))[0],
+     lambda: torch.mode(torch.tensor([[1., 2., 2.], [3., 3., 1.]]))[0], 0),
+    ("quantile", lambda: ops.quantile(t(A345), 0.25, axis=-1),
+     lambda: torch.quantile(torch.tensor(A345), 0.25, dim=-1), 1e-5),
+    ("nanquantile",
+     lambda: ops.nanquantile(t(np.array([1., np.nan, 3., 4.])), 0.5),
+     lambda: torch.nanquantile(torch.tensor([1., np.nan, 3., 4.]), 0.5),
+     1e-6),
+    # paddle's nanmedian averages the two middles (np semantics), torch
+    # takes the lower one — numpy is the right oracle
+    ("nanmedian", lambda: ops.nanmedian(t(np.array([1., np.nan, 3., 7.]))),
+     lambda: np.nanmedian(np.array([1., np.nan, 3., 7.])), 1e-6),
+    ("polygamma", lambda: ops.polygamma(t(POS33), 1),
+     lambda: torch.polygamma(1, torch.tensor(POS33)), 1e-4),
+    ("searchsorted",
+     lambda: ops.searchsorted(t(np.sort(V8)), t(A23)),
+     lambda: torch.searchsorted(torch.tensor(np.sort(V8)),
+                                torch.tensor(A23)), 0),
+    ("put_along_axis",
+     lambda: ops.put_along_axis(t(A23), t(np.array([[0], [1]])),
+                                9.0, 1),
+     lambda: torch.tensor(A23).scatter(
+         1, torch.tensor([[0], [1]]), 9.0), 0),
+    ("take_along_axis",
+     lambda: ops.take_along_axis(t(A23), t(np.array([[0, 1], [1, 2]])), 1),
+     lambda: torch.gather(torch.tensor(A23),
+                          1, torch.tensor([[0, 1], [1, 2]])), 0),
+    ("index_select",
+     lambda: ops.index_select(t(A345), t(np.array([0, 2], np.int32)), 1),
+     lambda: torch.index_select(torch.tensor(A345), 1,
+                                torch.tensor([0, 2])), 0),
+    ("index_add",
+     lambda: ops.index_add(t(A23), t(np.array([0, 1], np.int32)), 0,
+                           t(np.ones((2, 3), np.float32))),
+     lambda: torch.tensor(A23).index_add(
+         0, torch.tensor([0, 1]), torch.ones(2, 3)), 1e-6),
+    ("masked_fill",
+     lambda: ops.masked_fill(t(A23), t(A23 > 0), -1.0),
+     lambda: torch.tensor(A23).masked_fill(torch.tensor(A23 > 0), -1.0),
+     0),
+    ("masked_select",
+     lambda: ops.masked_select(t(A23), t(A23 > 0)),
+     lambda: torch.masked_select(torch.tensor(A23), torch.tensor(A23 > 0)),
+     0),
+    ("cholesky_solve",
+     lambda: ops.cholesky_solve(t(rng.standard_normal((4, 2))
+                                  .astype(np.float32)),
+                                t(np.linalg.cholesky(SPD)), upper=False),
+     None, None),  # checked against numpy below
+    ("matrix_power", lambda: ops.matrix_power(t(SPD), 3),
+     lambda: torch.linalg.matrix_power(torch.tensor(SPD), 3), 1e-3),
+    ("svdvals", lambda: ops.svdvals(t(A23)),
+     lambda: torch.linalg.svdvals(torch.tensor(A23)), 1e-4),
+    ("pinv", lambda: ops.pinv(t(A23)),
+     lambda: torch.linalg.pinv(torch.tensor(A23)), 1e-4),
+    ("householder_product",
+     lambda: ops.householder_product(
+         t(rng.standard_normal((4, 3)).astype(np.float32)),
+         t(rng.standard_normal((3,)).astype(np.float32))),
+     None, None),  # orthogonality checked below
+    ("dist", lambda: ops.dist(t(A23), t(A23 * 0.5), 2.0),
+     lambda: torch.dist(torch.tensor(A23), torch.tensor(A23 * 0.5), 2),
+     1e-5),
+    ("cov", lambda: ops.cov(t(A23)),
+     lambda: torch.cov(torch.tensor(A23)), 1e-4),
+    ("corrcoef", lambda: ops.corrcoef(t(A23)),
+     lambda: torch.corrcoef(torch.tensor(A23)), 1e-4),
+    ("glu", lambda: ops.glu(t(rng.standard_normal((2, 6))
+                              .astype(np.float32))),
+     None, None),
+    ("prelu", lambda: ops.prelu(t(A23), t(np.array([0.25], np.float32))),
+     lambda: TF.prelu(torch.tensor(A23), torch.tensor([0.25])), 1e-6),
+    ("cosine_similarity",
+     lambda: F.cosine_similarity(t(A23), t(A23 * 2 + 1), axis=1),
+     lambda: TF.cosine_similarity(torch.tensor(A23),
+                                  torch.tensor(A23 * 2 + 1), dim=1),
+     1e-5),
+    ("triplet_margin_loss",
+     lambda: ops.triplet_margin_loss(t(A23), t(A23 + 1), t(A23 - 2)),
+     lambda: TF.triplet_margin_loss(torch.tensor(A23),
+                                    torch.tensor(A23 + 1),
+                                    torch.tensor(A23 - 2)), 1e-5),
+    ("hinge_embedding_loss",
+     lambda: ops.hinge_embedding_loss(
+         t(A23), t(np.sign(A23) + (A23 == 0))),
+     lambda: TF.hinge_embedding_loss(
+         torch.tensor(A23),
+         torch.tensor(np.sign(A23) + (A23 == 0))), 1e-5),
+    ("cosine_embedding_loss",
+     lambda: ops.cosine_embedding_loss(
+         t(A23), t(A23 * 0.5 + 0.1), t(np.array([1., -1.], np.float32))),
+     lambda: TF.cosine_embedding_loss(
+         torch.tensor(A23), torch.tensor(A23 * 0.5 + 0.1),
+         torch.tensor([1., -1.])), 1e-5),
+    ("margin_ranking_loss",
+     lambda: ops.margin_ranking_loss(
+         t(V8), t(V8[::-1].copy()), t(np.sign(V8))),
+     lambda: TF.margin_ranking_loss(
+         torch.tensor(V8), torch.tensor(V8[::-1].copy()),
+         torch.tensor(np.sign(V8))), 1e-5),
+    ("sigmoid_cross_entropy_with_logits",
+     lambda: ops.sigmoid_cross_entropy_with_logits(
+         t(A23), t((A23 > 0).astype(np.float32))),
+     lambda: TF.binary_cross_entropy_with_logits(
+         torch.tensor(A23), torch.tensor((A23 > 0).astype(np.float32)),
+         reduction="none"), 1e-5),
+    ("log_loss",
+     lambda: ops.log_loss(t(np.clip(POS33[0] / 2, 0.05, 0.95)),
+                          t(np.array([1., 0., 1.], np.float32))),
+     None, None),
+    ("isclose", lambda: ops.isclose(t(A23), t(A23 + 1e-9)),
+     lambda: torch.isclose(torch.tensor(A23), torch.tensor(A23 + 1e-9)),
+     0),
+    ("equal_all", lambda: ops.equal_all(t(A23), t(A23.copy())),
+     lambda: torch.equal(torch.tensor(A23), torch.tensor(A23.copy())), 0),
+    ("allclose", lambda: ops.allclose(t(A23), t(A23 + 1e-9)),
+     lambda: torch.allclose(torch.tensor(A23), torch.tensor(A23 + 1e-9)),
+     0),
+    ("diag_embed", lambda: ops.diag_embed(t(A23)),
+     lambda: torch.diag_embed(torch.tensor(A23)), 0),
+    ("diagflat", lambda: ops.diagflat(t(V8)),
+     lambda: torch.diagflat(torch.tensor(V8)), 0),
+    ("trapezoid", lambda: ops.trapezoid(t(V8), dx=0.5),
+     lambda: torch.trapezoid(torch.tensor(V8), dx=0.5), 1e-5),
+    ("cumulative_trapezoid",
+     lambda: ops.cumulative_trapezoid(t(V8), dx=0.5),
+     lambda: torch.cumulative_trapezoid(torch.tensor(V8), dx=0.5), 1e-5),
+    ("unfold",
+     lambda: ops.unfold(t(V8), 0, 3, 2),
+     lambda: torch.tensor(V8).unfold(0, 3, 2), 0),
+    ("repeat_interleave",
+     lambda: ops.repeat_interleave(t(A23), 2, axis=1),
+     lambda: torch.repeat_interleave(torch.tensor(A23), 2, dim=1), 0),
+    ("nonzero", lambda: ops.nonzero(t(np.array([0., 1., 0., 2.]))),
+     lambda: torch.nonzero(torch.tensor([0., 1., 0., 2.])), 0),
+    ("increment", lambda: ops.increment(t(np.array([1.0], np.float32))),
+     lambda: torch.tensor([2.0]), 0),
+    ("gather_nd",
+     lambda: ops.gather_nd(t(A345), t(np.array([[0, 1], [2, 3]],
+                                               np.int32))),
+     lambda: torch.tensor(A345)[[0, 2], [1, 3]], 0),
+    ("strided_slice",
+     lambda: ops.strided_slice(t(A345), [1], [0], [4], [2]),
+     lambda: torch.tensor(A345)[:, 0:4:2], 0),
+    ("expand_as", lambda: ops.expand_as(t(V8[:1]), t(V8)),
+     lambda: torch.tensor(V8[:1]).expand_as(torch.tensor(V8)), 0),
+    ("angle", lambda: ops.angle(t(A23)),
+     lambda: torch.angle(torch.tensor(A23)), 1e-6),
+    ("conj", lambda: ops.conj(t(A23)),
+     lambda: torch.conj(torch.tensor(A23)), 0),
+]
+
+
+@pytest.mark.parametrize("name,ours,ref,rtol",
+                         CASES, ids=[c[0] for c in CASES])
+def test_matches_torch(name, ours, ref, rtol):
+    got = ours()
+    if ref is None:
+        pytest.skip("custom check below")
+    want = ref()
+    g = npy(got)
+    w = want.numpy() if hasattr(want, "numpy") else np.asarray(want)
+    if rtol == 0:
+        np.testing.assert_array_equal(np.asarray(g, w.dtype), w)
+    else:
+        np.testing.assert_allclose(np.asarray(g, np.float64),
+                                   np.asarray(w, np.float64),
+                                   rtol=rtol, atol=rtol)
+
+
+def test_cholesky_solve_numpy():
+    L = np.linalg.cholesky(SPD)
+    b = rng.standard_normal((4, 2)).astype(np.float32)
+    got = npy(ops.cholesky_solve(t(b), t(L), upper=False))
+    np.testing.assert_allclose(SPD @ got, b, rtol=1e-3, atol=1e-3)
+
+
+def test_householder_product_orthogonal():
+    # drive with LAPACK geqrf output (valid (v, tau) pairs)
+    from scipy.linalg import lapack
+    a = rng.standard_normal((4, 3)).astype(np.float32)
+    qr, tau, _, _ = lapack.sgeqrf(a)
+    got = npy(ops.householder_product(t(qr), t(tau)))
+    np.testing.assert_allclose(got.T @ got, np.eye(3), atol=1e-4)
+
+
+def test_glu_matches_torch():
+    x = rng.standard_normal((2, 6)).astype(np.float32)
+    np.testing.assert_allclose(npy(ops.glu(t(x))),
+                               TF.glu(torch.tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_log_loss_formula():
+    p = np.clip(rng.random(4).astype(np.float32), 0.05, 0.95)
+    y = np.array([1., 0., 1., 0.], np.float32)
+    eps = 1e-4  # the op's reference default (phi log_loss epsilon)
+    ref = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+    np.testing.assert_allclose(npy(ops.log_loss(t(p), t(y))).reshape(-1),
+                               ref, rtol=1e-4)
