@@ -9,6 +9,7 @@ from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .tape import (  # noqa: F401
     no_grad, enable_grad, is_grad_enabled, set_grad_enabled, run_backward,
@@ -39,13 +40,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         inputs = [inputs]
     if grad_outputs is not None and isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
-    # save/restore .grad of input leaves so grad() stays side-effect free
-    saved = [t._grad for t in inputs]
     retain = bool(retain_graph) if retain_graph is not None else create_graph
+    # accumulate_leaf_grads=False: paddle.grad never touches .grad of ANY
+    # leaf (GeneralGrad only_inputs semantics) — not just the requested ones
     results = run_backward(outputs, grad_outputs, retain_graph=retain,
-                           grad_targets=list(inputs))
-    for t, s in zip(inputs, saved):
-        t._grad = s
+                           grad_targets=list(inputs),
+                           create_graph=create_graph,
+                           accumulate_leaf_grads=False)
     out = []
     for i, r in enumerate(results):
         if r is None:
@@ -54,6 +55,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     f"input {i} is unreachable from outputs "
                     "(pass allow_unused=True to return None)")
             out.append(None)
+        elif create_graph:
+            # r is a tape-recorded Tensor — differentiable, NOT detached
+            out.append(r if isinstance(r, Tensor)
+                       else Tensor._wrap(jnp.asarray(r), stop_gradient=True))
         else:
             out.append(Tensor._wrap(jnp.asarray(r), stop_gradient=True))
     return out
@@ -156,7 +161,39 @@ class PyLayer(metaclass=PyLayerMeta):
                                     else jnp.asarray(r))
             return tuple(out_cots)
 
+        def record_vjp(cots):
+            """create_graph path: re-run backward with the tape ENABLED so
+            its registry ops are recorded (double backward through PyLayer,
+            ref: fluid/eager/pylayer/ create_graph handling)."""
+            grads_in = []
+            for c, aval in zip(cots, out_avals):
+                if isinstance(c, Tensor):
+                    grads_in.append(c)
+                else:
+                    dt = (aval.dtype
+                          if jnp.issubdtype(aval.dtype, jnp.inexact)
+                          else jnp.float32)
+                    grads_in.append(Tensor._wrap(
+                        jnp.zeros(aval.shape, dt), stop_gradient=True))
+            with tape.enable_grad():
+                res = cls.backward(ctx, *grads_in)
+            if not isinstance(res, (tuple, list)):
+                res = (res,)
+            res = list(res) + [None] * (len(tensor_inputs) - len(res))
+            out_cots = []
+            for t, r in zip(tensor_inputs, res):
+                if r is None:
+                    out_cots.append(Tensor._wrap(
+                        jnp.zeros(t._data.shape, t._data.dtype),
+                        stop_gradient=True))
+                else:
+                    out_cots.append(r if isinstance(r, Tensor) else
+                                    Tensor._wrap(jnp.asarray(r),
+                                                 stop_gradient=True))
+            return out_cots
+
         node = GradNode(f"pylayer_{cls.__name__}", vjp_fn, edges, out_avals)
+        node.record_vjp = record_vjp
         new_outs = []
         for i, o in enumerate(outs):
             t = Tensor._wrap(o._data, stop_gradient=False)
@@ -165,3 +202,151 @@ class PyLayer(metaclass=PyLayerMeta):
             node.register_output(i, t)
             new_outs.append(t)
         return new_outs[0] if single else tuple(new_outs)
+
+
+# ---------------------------------------------------------------------------
+# Functional jacobian / hessian
+# (ref: /root/reference/python/paddle/autograd/autograd.py — Jacobian/Hessian
+#  objects over double-backward; here rows come from tape vjp passes, and
+#  hessian chains through grad(create_graph=True) replay nodes.)
+# ---------------------------------------------------------------------------
+class Jacobian:
+    """Materialized Jacobian of `ys` w.r.t. `xs`.
+
+    Shape is (M, N) for batch_axis=None (M = ys.numel, N = xs.numel) or
+    (B, M, N) for batch_axis=0 (per-sample jacobian of a batched function).
+    Indexable like a Tensor; `.tensor` returns the underlying Tensor.
+    """
+
+    def __init__(self, tensor):
+        self._t = tensor
+
+    @property
+    def tensor(self):
+        return self._t
+
+    @property
+    def shape(self):
+        return self._t.shape
+
+    def __getitem__(self, idx):
+        return self._t[idx]
+
+    def numpy(self):
+        return self._t.numpy()
+
+    def __array__(self, dtype=None):
+        import numpy as _np
+        a = _np.asarray(self._t.numpy())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        return f"Jacobian(shape={self.shape})"
+
+
+def _one_hot_seed(shape, dtype, flat_idx, batch_axis):
+    if batch_axis is None:
+        n = int(np.prod(shape)) if shape else 1
+        seed = jnp.zeros((n,), dtype).at[flat_idx].set(1).reshape(shape or ())
+    else:
+        b = shape[0]
+        rest = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        seed = jnp.zeros((b, rest), dtype).at[:, flat_idx].set(1)
+        seed = seed.reshape(shape)
+    return Tensor._wrap(seed, stop_gradient=True)
+
+
+def _jacobian_single(y, x, batch_axis, create_graph):
+
+    yshape = tuple(y._data.shape)
+    xshape = tuple(x._data.shape)
+    if batch_axis is None:
+        m = int(np.prod(yshape)) if yshape else 1
+        n = int(np.prod(xshape)) if xshape else 1
+        rows = []
+        for i in range(m):
+            seed = _one_hot_seed(yshape, y._data.dtype, i, None)
+            (gx,) = grad([y], [x], grad_outputs=[seed], retain_graph=True,
+                         create_graph=create_graph, allow_unused=True)
+            if gx is None:
+                gx = Tensor._wrap(jnp.zeros(xshape, x._data.dtype),
+                                  stop_gradient=True)
+            rows.append(gx.reshape([n]))
+        from ..ops import stack as _stack
+        return Jacobian(_stack(rows, axis=0))
+    if batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    b = yshape[0]
+    m = int(np.prod(yshape[1:])) if len(yshape) > 1 else 1
+    n = int(np.prod(xshape[1:])) if len(xshape) > 1 else 1
+    rows = []
+    for i in range(m):
+        seed = _one_hot_seed(yshape, y._data.dtype, i, 0)
+        (gx,) = grad([y], [x], grad_outputs=[seed], retain_graph=True,
+                     create_graph=create_graph, allow_unused=True)
+        if gx is None:
+            gx = Tensor._wrap(jnp.zeros(xshape, x._data.dtype),
+                              stop_gradient=True)
+        rows.append(gx.reshape([b, n]))
+    from ..ops import stack as _stack
+    return Jacobian(_stack(rows, axis=1))  # (B, M, N)
+
+
+def jacobian(ys, xs, batch_axis=None, create_graph=False):
+    """Jacobian of ys w.r.t. xs (ref: paddle.autograd.jacobian,
+    /root/reference/python/paddle/autograd/autograd.py).
+
+    Returns a Jacobian (single ys, single xs) or a tuple of Jacobians
+    (one per xs). Pass create_graph=True to differentiate through it.
+    """
+    single_x = isinstance(xs, Tensor)
+    xs_list = [xs] if single_x else list(xs)
+    if not isinstance(ys, Tensor):
+        raise TypeError("jacobian currently supports a single ys Tensor")
+    jacs = [_jacobian_single(ys, x, batch_axis, create_graph)
+            for x in xs_list]
+    return jacs[0] if single_x else tuple(jacs)
+
+
+class Hessian(Jacobian):
+    def __repr__(self):
+        return f"Hessian(shape={self.shape})"
+
+
+def hessian(ys, xs, batch_axis=None):
+    """Hessian of a scalar ys w.r.t. xs via double backward
+    (grad(create_graph=True) then one vjp row per element)."""
+    single_x = isinstance(xs, Tensor)
+    xs_list = [xs] if single_x else list(xs)
+    yshape = tuple(ys._data.shape)
+    if batch_axis is None:
+        if ys.size != 1:
+            raise ValueError("hessian requires scalar ys when batch_axis=None")
+        seeds = None
+    else:
+        # batched hessian: ys must be per-sample scalar — (B,) or (B, 1)
+        if len(yshape) > 2 or (len(yshape) == 2 and yshape[1] != 1):
+            raise ValueError(
+                "hessian with batch_axis=0 requires per-sample scalar ys "
+                f"of shape (B,) or (B, 1); got {yshape}")
+        # seed with ones so the first backward yields per-sample first grads
+        seeds = [Tensor._wrap(jnp.ones(yshape, ys._data.dtype),
+                              stop_gradient=True)]
+    g = grad([ys], xs_list, grad_outputs=seeds, create_graph=True,
+             allow_unused=True)
+    out = []
+    for gx, x in zip(g, xs_list):
+        if gx is None:
+            xshape = tuple(x._data.shape)
+            if batch_axis is None:
+                n = int(np.prod(xshape)) if xshape else 1
+                zshape = (n, n)
+            else:
+                n = int(np.prod(xshape[1:])) if len(xshape) > 1 else 1
+                zshape = (xshape[0], n, n)
+            out.append(Hessian(Tensor._wrap(
+                jnp.zeros(zshape, x._data.dtype), stop_gradient=True)))
+            continue
+        jac = _jacobian_single(gx, x, batch_axis, create_graph=False)
+        out.append(Hessian(jac.tensor))
+    return out[0] if single_x else tuple(out)

@@ -91,6 +91,7 @@ class InputEdge:
 class GradNode:
     __slots__ = (
         "name", "vjp_fn", "edges", "out_avals", "out_tensor_refs",
+        "replay_fn", "primal_arrays", "record_vjp",
         "__weakref__",
     )
 
@@ -101,6 +102,20 @@ class GradNode:
         self.edges = edges
         self.out_avals = out_avals  # list of jax.ShapeDtypeStruct per output
         self.out_tensor_refs: List[Optional[weakref.ref]] = [None] * len(out_avals)
+        # higher-order support (create_graph=True): `replay_fn` re-expresses
+        # the flat forward over the diff-input arrays so the vjp itself can
+        # be recorded as a tape op; `primal_arrays` are their FORWARD-TIME
+        # values, edge-aligned (so in-place updates between forward and
+        # backward don't change what the vjp is evaluated at — same contract
+        # as the captured residuals on the first-order path). Graph
+        # connectivity during replay comes from `edges` (node refs are
+        # strong, leaf refs weak — no extra Tensor pinning). `record_vjp`,
+        # when set (PyLayer), is a callable cots->in_cot Tensors run with the
+        # tape enabled instead of replay. Ref: create_graph double backward
+        # in /root/reference/paddle/fluid/eager/general_grad.h.
+        self.replay_fn = None
+        self.primal_arrays: Optional[List[Any]] = None
+        self.record_vjp = None
 
     def register_output(self, idx: int, tensor):
         self.out_tensor_refs[idx] = weakref.ref(tensor)
@@ -109,10 +124,140 @@ class GradNode:
         return f"GradNode({self.name}, n_out={len(self.out_avals)})"
 
 
-def _zero_cotangent(aval):
+def _zero_cotangent(aval, as_tensor=False):
     if jax.numpy.issubdtype(aval.dtype, jax.numpy.inexact):
-        return jax.numpy.zeros(aval.shape, aval.dtype)
+        z = jax.numpy.zeros(aval.shape, aval.dtype)
+        if as_tensor:
+            from ..core.tensor import Tensor
+            return Tensor._wrap(z, stop_gradient=True)
+        return z
     return np.zeros(aval.shape, jax.dtypes.float0)
+
+
+def build_node(name, vjp_fn, diff_tensors, out_avals,
+               replay_fn=None, primal_arrays=None):
+    """Construct a GradNode from diff-input Tensors (one edge each, in
+    order) — the single recording sequence shared by ops.registry.dispatch
+    and record_apply, so edge/replay semantics cannot drift apart."""
+    edges = []
+    for t in diff_tensors:
+        if t._grad_node is not None:
+            edges.append(InputEdge("node", node=t._grad_node,
+                                   out_idx=t._out_idx))
+        else:
+            edges.append(InputEdge("leaf", tensor=t))
+    node = GradNode(name, vjp_fn, edges, out_avals)
+    node.replay_fn = replay_fn
+    node.primal_arrays = primal_arrays
+    return node
+
+
+def record_apply(name, flat_fn, tensors, input_arrays=None):
+    """Run `flat_fn(*arrays) -> tuple(arrays)` on Tensor inputs, recording a
+    GradNode (with replay info) when the tape is live.
+
+    This is the building block higher-order backward uses to make a vjp
+    application itself differentiable: the recorded node carries its own
+    replay closure, so arbitrary-order grads chain (ref: the generated
+    higher-order grad nodes of /root/reference/paddle/fluid/prim/).
+
+    input_arrays: optional per-tensor value overrides (forward-time
+    captures) used instead of the tensors' current ._data."""
+    from ..core.tensor import Tensor
+
+    arrs = (list(input_arrays) if input_arrays is not None
+            else [t._data for t in tensors])
+    assert len(arrs) == len(tensors)
+    record = is_grad_enabled() and any(
+        (not t.stop_gradient)
+        and jax.numpy.issubdtype(t._data.dtype, jax.numpy.inexact)
+        for t in tensors)
+    if not record:
+        flat_out = flat_fn(*arrs)
+        return [Tensor._wrap(a, stop_gradient=True) for a in flat_out]
+
+    diff_idx = [
+        i for i, t in enumerate(tensors)
+        if (not t.stop_gradient)
+        and jax.numpy.issubdtype(t._data.dtype, jax.numpy.inexact)
+    ]
+
+    def g(*diff_arrs):
+        vals = list(arrs)
+        for p, a in zip(diff_idx, diff_arrs):
+            vals[p] = a
+        return tuple(flat_fn(*vals))
+
+    primals = tuple(arrs[i] for i in diff_idx)
+    flat_out, vjp_fn = jax.vjp(g, *primals)
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in flat_out]
+    node = build_node(name, vjp_fn, [tensors[i] for i in diff_idx],
+                      out_avals, replay_fn=g, primal_arrays=list(primals))
+
+    wrapped = []
+    for idx, arr in enumerate(flat_out):
+        if jax.numpy.issubdtype(arr.dtype, jax.numpy.inexact):
+            t = Tensor._wrap(arr, stop_gradient=False)
+            t._grad_node = node
+            t._out_idx = idx
+            node.register_output(idx, t)
+        else:
+            t = Tensor._wrap(arr, stop_gradient=True)
+        wrapped.append(t)
+    return wrapped
+
+
+def _replay_vjp(node, cots):
+    """create_graph path: compute the node's input cotangents as a RECORDED
+    tape op, so the returned Tensors are themselves differentiable.
+
+    Connectivity stand-ins are synthesized from the node's edges: a 'node'
+    edge yields a fresh Tensor linked to (parent, out_idx) holding the
+    forward-time value; a 'leaf' edge reuses the live leaf Tensor (weakref —
+    a dead leaf's second-order contribution is dropped, matching the
+    first-order engine). No strong Tensor refs are ever stored."""
+    from ..core.tensor import Tensor
+
+    if node.record_vjp is not None:  # PyLayer custom double-backward
+        return node.record_vjp(cots)
+    if node.replay_fn is None:
+        raise RuntimeError(
+            f"create_graph=True requires replay info on node {node.name}; "
+            "this node was recorded without it (or it was released by an "
+            "earlier backward without retain_graph=True)")
+    g = node.replay_fn
+    prim = []
+    for e, arr in zip(node.edges, node.primal_arrays):
+        if e.kind == "leaf":
+            live = e.tensor_ref() if e.tensor_ref is not None else None
+            if live is not None:
+                prim.append(live)
+                continue
+            t = Tensor._wrap(arr, stop_gradient=True)  # dead leaf: drop
+        else:  # 'node'
+            t = Tensor._wrap(arr, stop_gradient=False)
+            t._grad_node = e.node
+            t._out_idx = e.out_idx
+        prim.append(t)
+    n = len(prim)
+    tensor_cot_idx = [i for i, c in enumerate(cots) if isinstance(c, Tensor)]
+    const_cots = [None if isinstance(c, Tensor) else c for c in cots]
+
+    def vjp_flat(*arrs):
+        pvals = arrs[:n]
+        cvals = list(const_cots)
+        for p, a in zip(tensor_cot_idx, arrs[n:]):
+            cvals[p] = a
+        _, vf = jax.vjp(g, *pvals)
+        return tuple(vf(tuple(cvals)))
+
+    cot_tensors = [cots[i] for i in tensor_cot_idx]
+    # evaluate at the forward-time primal values (primal_arrays), not the
+    # tensors' possibly-mutated current ._data — matches the residuals the
+    # first-order vjp_fn captured
+    in_arrays = list(node.primal_arrays) + [t._data for t in cot_tensors]
+    return record_apply(f"{node.name}_grad", vjp_flat, prim + cot_tensors,
+                        input_arrays=in_arrays)
 
 
 # --------------------------------------------------------------------------
@@ -150,13 +295,38 @@ def _accumulate(slot_map, key, idx, value):
             slots[idx] = prev + value
 
 
+def _apply_hooks(hooks, val, create_graph):
+    """Fire registered tensor hooks on a cotangent, honoring the
+    create_graph representation (Tensor) vs raw-array representation."""
+    from ..core.tensor import Tensor
+
+    for h in hooks.values():
+        arg = val if isinstance(val, Tensor) else Tensor._wrap(val)
+        new = h(arg)
+        if new is not None:
+            if create_graph:
+                val = new if isinstance(new, Tensor) else Tensor._wrap(new)
+            else:
+                val = new._data if isinstance(new, Tensor) else new
+    return val
+
+
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
-                 grad_targets=None):
+                 grad_targets=None, create_graph=False,
+                 accumulate_leaf_grads=True):
     """Run the reverse pass from `tensors`.
 
     grad_targets: optional list of Tensors; when given, returns the cotangent
     reaching each target (paddle.grad semantics) instead of (in addition to)
     accumulating leaf .grad.
+
+    create_graph: when True, every vjp application is itself dispatched as a
+    recorded tape op (via _replay_vjp), so returned cotangents are
+    differentiable Tensors — real double/higher-order backward (ref:
+    /root/reference/paddle/fluid/eager/general_grad.h create_graph path).
+
+    accumulate_leaf_grads: False for paddle.grad() semantics — no leaf
+    `.grad` is touched anywhere in the graph (GeneralGrad only_inputs).
     """
     from ..core.tensor import Tensor  # local import, avoids cycle
 
@@ -190,12 +360,18 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {tuple(t._data.shape)}")
             gval = jax.numpy.ones(t._data.shape, t._data.dtype)
+            if create_graph:
+                gval = Tensor._wrap(gval, stop_gradient=True)
+        elif create_graph:
+            gval = g if isinstance(g, Tensor) else Tensor._wrap(
+                jax.numpy.asarray(g), stop_gradient=True)
         else:
             gval = g._data if isinstance(g, Tensor) else jax.numpy.asarray(g)
         if node is None:
             if not t.stop_gradient:
                 leaf_results[id(t)] = gval
-                _apply_leaf_grad(t, gval)
+                if accumulate_leaf_grads:
+                    _apply_leaf_grad(t, gval, create_graph)
                 if target_ids and id(t) in target_ids:
                     target_results[target_ids[id(t)]] = gval
             continue
@@ -213,31 +389,31 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
             slots = cot.get(id(node))
             if slots is None:
                 slots = [None] * len(node.out_avals)
-            cots = tuple(
-                s if s is not None else _zero_cotangent(a)
+            cots = [
+                s if s is not None
+                else _zero_cotangent(a, as_tensor=create_graph)
                 for s, a in zip(slots, node.out_avals)
-            )
+            ]
             # fire tensor hooks / retain_grad on this node's outputs
-            cots = list(cots)
             for i, ref in enumerate(node.out_tensor_refs):
                 t = ref() if ref is not None else None
                 if t is None:
                     continue
                 if t._hooks:
-                    for h in t._hooks.values():
-                        new = h(Tensor._wrap(cots[i]))
-                        if new is not None:
-                            cots[i] = new._data if isinstance(new, Tensor) else new
+                    cots[i] = _apply_hooks(t._hooks, cots[i], create_graph)
                 if t._retain_grad or (target_ids and id(t) in target_ids):
                     if target_ids and id(t) in target_ids:
                         r = target_results[target_ids[id(t)]]
                         target_results[target_ids[id(t)]] = (
                             cots[i] if r is None else r + cots[i])
-                    if t._retain_grad:
-                        _apply_leaf_grad(t, cots[i])
+                    if t._retain_grad and accumulate_leaf_grads:
+                        _apply_leaf_grad(t, cots[i], create_graph)
             # dispatch always builds vjp over a flat-tuple-output function,
             # so the cotangent argument is always a tuple
-            in_cots = node.vjp_fn(tuple(cots))
+            if create_graph:
+                in_cots = _replay_vjp(node, cots)
+            else:
+                in_cots = node.vjp_fn(tuple(cots))
             if not isinstance(in_cots, (tuple, list)):
                 in_cots = (in_cots,)
             assert len(in_cots) == len(node.edges), (
@@ -250,22 +426,27 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                     t = e.tensor_ref() if e.tensor_ref is not None else None
                     if t is not None:
                         if t._hooks:
-                            for h in t._hooks.values():
-                                new = h(Tensor._wrap(g))
-                                if new is not None:
-                                    g = new._data if isinstance(new, Tensor) else new
+                            g = _apply_hooks(t._hooks, g, create_graph)
                         if target_ids and id(t) in target_ids:
                             i = target_ids[id(t)]
                             r = target_results[i]
                             target_results[i] = g if r is None else r + g
-                        _apply_leaf_grad(t, g)
+                        if accumulate_leaf_grads:
+                            _apply_leaf_grad(t, g, create_graph)
                 else:
                     seed(e.node, e.out_idx, g)
                     pending[id(e.node)] -= 1
                     if pending[id(e.node)] == 0:
                         queue.append(e.node)
             if not retain_graph:
-                node.vjp_fn = None  # release residuals
+                # release residuals AND replay state (replay closures pin all
+                # forward input arrays + Tensor objects — dropping them here
+                # restores the leaf-weakref memory design for the common
+                # first-order path)
+                node.vjp_fn = None
+                node.replay_fn = None
+                node.primal_arrays = None
+                node.record_vjp = None
             cot.pop(id(node), None)
 
     if grad_targets is not None:
@@ -273,10 +454,14 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     return None
 
 
-def _apply_leaf_grad(tensor, g):
+def _apply_leaf_grad(tensor, g, create_graph=False):
     """Accumulate cotangent into tensor.grad (GradTensorHolder analog)."""
     from ..core.tensor import Tensor
 
+    if create_graph and isinstance(g, Tensor):
+        # keep the cotangent's graph so .grad is differentiable
+        tensor._grad = g if tensor._grad is None else tensor._grad + g
+        return
     if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
         return
     if tensor._grad is None:

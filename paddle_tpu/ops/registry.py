@@ -30,7 +30,6 @@ from ..core import dtype as dtypes
 from ..core.flags import flag_value
 from ..core.tensor import Tensor
 from ..autograd import tape
-from ..autograd.tape import GradNode, InputEdge
 
 OPS: Dict[str, "OpDef"] = {}
 
@@ -98,16 +97,13 @@ def dispatch(opdef: OpDef, args, kwargs):
     flat_out, vjp_fn = jax.vjp(g, *primals)
     out_tree = g._out_tree
 
-    edges = []
-    for i in diff_pos:
-        t = leaves[i]
-        if t._grad_node is not None:
-            edges.append(InputEdge("node", node=t._grad_node,
-                                   out_idx=t._out_idx))
-        else:
-            edges.append(InputEdge("leaf", tensor=t))
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in flat_out]
-    node = GradNode(opdef.name, vjp_fn, edges, out_avals)
+    # replay info (g + forward-time primals) enables create_graph=True:
+    # re-running jax.vjp(g, primals) inside a recorded tape op yields
+    # differentiable cotangents (tape._replay_vjp)
+    node = tape.build_node(opdef.name, vjp_fn,
+                           [leaves[i] for i in diff_pos], out_avals,
+                           replay_fn=g, primal_arrays=list(primals))
 
     out = jax.tree_util.tree_unflatten(out_tree, list(flat_out))
     return _wrap_outputs(opdef, out, node=node)

@@ -138,6 +138,147 @@ class TestGradAPI:
         np.testing.assert_allclose(g1.numpy(), [12.0])
 
 
+class TestHigherOrder:
+    """Real create_graph double backward (ref:
+    /root/reference/paddle/fluid/eager/general_grad.h create_graph path,
+    python/paddle/autograd/autograd.py jacobian/hessian)."""
+
+    def test_create_graph_returns_differentiable(self):
+        x = _t([2.0, 3.0])
+        y = (x * x * x).sum()
+        (g,) = grad(y, [x], create_graph=True)
+        assert not g.stop_gradient  # NOT silently detached
+        (g2,) = grad(g.sum(), [x])
+        np.testing.assert_allclose(g2.numpy(), [12.0, 18.0])  # 6x
+
+    def test_third_order(self):
+        x = _t([2.0])
+        y = x * x * x * x  # d3/dx3 = 24x
+        (g1,) = grad(y.sum(), [x], create_graph=True)
+        (g2,) = grad(g1.sum(), [x], create_graph=True)
+        (g3,) = grad(g2.sum(), [x])
+        np.testing.assert_allclose(g3.numpy(), [48.0])
+
+    def test_double_backward_through_matmul(self):
+        rng = np.random.RandomState(0)
+        x = _t(rng.randn(3, 4))
+        w = _t(rng.randn(4, 2))
+        y = paddle.matmul(x, w)
+        loss = (y * y).sum()
+        (gw,) = grad(loss, [w], create_graph=True)
+        # grad-norm penalty: d(|gw|^2)/dw = 2 * d(gw)/dw . gw
+        penalty = (gw * gw).sum()
+        (g2,) = grad(penalty, [w])
+        # analytic: gw = 2 x^T x w  =>  d(|gw|^2)/dw = 2*(2x^Tx)^T(2x^Tx) w...
+        A = 2.0 * x.numpy().T @ x.numpy()
+        gw_ref = A @ w.numpy()
+        np.testing.assert_allclose(gw.numpy(), gw_ref, rtol=1e-4)
+        g2_ref = 2.0 * A.T @ gw_ref
+        np.testing.assert_allclose(g2.numpy(), g2_ref, rtol=1e-4)
+
+    def test_gradient_penalty_training_step(self):
+        """WGAN-GP style step: loss + lambda*|dD/dx|^2 trains end-to-end."""
+        rng = np.random.RandomState(1)
+        w = _t(rng.randn(4, 1) * 0.1)
+        x = _t(rng.randn(8, 4), sg=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        d_out = paddle.matmul(x, w).sum()
+        (gx,) = grad(d_out, [x], create_graph=True)
+        gp = (gx * gx).sum()
+        loss = d_out + 10.0 * gp
+        loss.backward()
+        assert w.grad is not None
+        g_before = w.grad.numpy().copy()
+        assert np.all(np.isfinite(g_before))
+        w_before = w.numpy().copy()
+        opt.step()
+        assert not np.allclose(w.numpy(), w_before)
+
+    def test_create_graph_through_pylayer(self):
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 3.0 * x * x
+
+        x = _t([2.0])
+        y = Cube.apply(x)
+        (g,) = grad(y.sum(), [x], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0])
+        (g2,) = grad(g.sum(), [x])
+        np.testing.assert_allclose(g2.numpy(), [12.0])  # d(3x^2)/dx = 6x
+
+    def test_jacobian_vs_jax(self):
+        import jax
+        import jax.numpy as jnp
+
+        xa = np.random.RandomState(0).randn(3).astype(np.float32)
+        xt = _t(xa)
+        yt = paddle.sin(xt) * 2.0
+        J = paddle.autograd.jacobian(yt, xt)
+        Jref = jax.jacfwd(lambda a: jnp.sin(a) * 2.0)(xa)
+        np.testing.assert_allclose(np.asarray(J), np.asarray(Jref),
+                                   atol=1e-5)
+        assert list(J.shape) == [3, 3]
+
+    def test_jacobian_batch_axis(self):
+        rng = np.random.RandomState(2)
+        xa = rng.randn(4, 3).astype(np.float32)
+        w = rng.randn(3, 2).astype(np.float32)
+        xt = _t(xa)
+        yt = paddle.matmul(xt, paddle.to_tensor(w))
+        J = paddle.autograd.jacobian(yt, xt, batch_axis=0)
+        assert list(J.shape) == [4, 2, 3]
+        # per-sample jacobian of x@w is w^T
+        np.testing.assert_allclose(np.asarray(J)[0], w.T, atol=1e-5)
+
+    def test_hessian(self):
+        xa = np.array([1.0, 2.0, 3.0], np.float32)
+        xt = _t(xa)
+        yt = (xt ** 3).sum()
+        H = paddle.autograd.hessian(yt, xt)
+        np.testing.assert_allclose(np.asarray(H), np.diag(6 * xa),
+                                   atol=1e-4)
+
+    def test_grad_does_not_pollute_other_leaf_grads(self):
+        """grad()/jacobian must not accumulate into .grad of requires-grad
+        leaves outside `inputs` (GeneralGrad only_inputs semantics)."""
+        rng = np.random.RandomState(4)
+        w = _t(rng.randn(3, 2))  # trainable param NOT in inputs
+        x = _t(rng.randn(2, 3))
+        y = paddle.matmul(x, w)
+        J = paddle.autograd.jacobian(y, x)
+        assert w.grad is None
+        assert x.grad is None
+        assert list(J.shape) == [4, 6]
+
+    def test_hessian_batch_axis(self):
+        rng = np.random.RandomState(5)
+        xa = rng.randn(4, 3).astype(np.float32)
+        xt = _t(xa)
+        y = (xt ** 3).sum(axis=1)  # per-sample scalar, shape (4,)
+        H = paddle.autograd.hessian(y, xt, batch_axis=0)
+        assert list(H.shape) == [4, 3, 3]
+        for b in range(4):
+            np.testing.assert_allclose(np.asarray(H)[b],
+                                       np.diag(6 * xa[b]), atol=1e-4)
+
+    def test_hessian_quadratic_form(self):
+        rng = np.random.RandomState(3)
+        A = rng.randn(4, 4).astype(np.float32)
+        A = A + A.T
+        xt = _t(rng.randn(4))
+        At = paddle.to_tensor(A)
+        y = (xt.reshape([1, 4]) @ At @ xt.reshape([4, 1])).sum() * 0.5
+        H = paddle.autograd.hessian(y, xt)
+        np.testing.assert_allclose(np.asarray(H), A, atol=1e-4)
+
+
 class TestPyLayer:
     def test_custom_forward_backward(self):
         class Double(PyLayer):
