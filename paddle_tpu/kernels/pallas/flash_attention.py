@@ -54,17 +54,21 @@ _SUBL = 8   # per-head stats ride as [b, h*_SUBL, s]: seq in lanes, each
             # head's row replicated over one sublane tile (minimum height)
 
 
-def _causal_tile_mask(qi, ki, block_q, block_k):
-    """Bool [block_q, block_k] validity (q_pos >= k_pos) for a block pair.
-    Only called on diagonal-straddling pairs."""
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+def _causal_tile_mask(qi, ki, block_q, block_k, offset=0):
+    """Bool [block_q, block_k] validity (q_pos + offset >= k_pos) for a
+    block pair. Only called on diagonal-straddling pairs.
+
+    offset = sk - sq gives the FlashAttention-2 bottom-right-aligned causal
+    mask for cross-length attention (the reference's dynloaded FA2 library
+    aligns this way; ADVICE r2 finding on top-left drift)."""
+    q_pos = offset + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     return q_pos >= k_pos
 
 
-def _block_classes(causal, qi, ki, block_q, block_k):
+def _block_classes(causal, qi, ki, block_q, block_k, offset=0):
     """(run, needs_mask) predicates for a (q_block, k_block) pair.
 
     run: some (q_pos, k_pos) pair is valid -> compute the block at all.
@@ -73,19 +77,38 @@ def _block_classes(causal, qi, ki, block_q, block_k):
     """
     if not causal:
         return None, None
-    last_q = qi * block_q + block_q - 1
+    last_q = offset + qi * block_q + block_q - 1
     run = last_q >= ki * block_k
-    full = qi * block_q >= ki * block_k + block_k - 1
+    full = offset + qi * block_q >= ki * block_k + block_k - 1
     return run, jnp.logical_and(run, jnp.logical_not(full))
+
+
+def _seg_tile_mask(qseg_ref, kseg_ref, block_k):
+    """Segment-equality mask [block_q, block_k] from the streamed id tiles.
+
+    Layout (TPU-friendly, same convention as the public jax pallas flash
+    attention): q ids ride as [block_q, _LANES] (value replicated over
+    lanes), kv ids as [_SUBL, block_k] (value replicated over sublanes) —
+    both are natural 2D tiles, no in-kernel transposes."""
+    reps = block_k // _LANES
+    qs = jnp.tile(qseg_ref[0], (1, reps))         # [block_q, block_k]
+    ks = kseg_ref[0, :1, :]                       # [1, block_k]
+    return qs == ks
 
 
 # ======================= forward =======================
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, causal, block_q, block_k, H, D):
+def _fwd_kernel(*refs, causal, block_q, block_k, H, Hk, D, offset, has_seg):
+    if has_seg:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    G = H // Hk  # q-heads per kv-head (GQA group size; 1 = MHA, H = MQA)
 
     @pl.when(ki == 0)
     def _init():
@@ -93,15 +116,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    def _body(masked):
+    def _body(causal_masked):
         qf = q_ref[0]          # [bq, H*D] native dtype (pre-scaled)
-        kf = k_ref[0]
+        kf = k_ref[0]          # [bk, Hk*D]
         vf = v_ref[0]
-        ok = _causal_tile_mask(qi, ki, block_q, block_k) if masked else None
+        ok = (_causal_tile_mask(qi, ki, block_q, block_k, offset)
+              if causal_masked else None)
+        if has_seg:
+            seg_ok = _seg_tile_mask(qseg_ref, kseg_ref, block_k)
+            ok = seg_ok if ok is None else jnp.logical_and(ok, seg_ok)
         for h in range(H):
             sl = slice(h * D, (h + 1) * D)
+            slk = slice((h // G) * D, (h // G) * D + D)
             s = jax.lax.dot_general(
-                qf[:, sl], kf[:, sl], (((1,), (1,)), ((), ())),
+                qf[:, sl], kf[:, slk], (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)      # [bq, bk] f32
             if ok is not None:
                 s = jnp.where(ok, s, _NEG_INF)
@@ -109,15 +137,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             m_cur = jnp.max(s, axis=1, keepdims=True)
             m_new = jnp.maximum(m_prev, m_cur)
             p = jnp.exp(s - m_new)                       # [bq, bk] f32
+            if ok is not None:
+                # rows with NO valid key in this block (segment mismatch, or
+                # bottom-right causal with sq > sk): m_new stays at _NEG_INF
+                # and exp(s - m_new) = 1 — zero those explicitly
+                p = jnp.where(ok, p, 0.0)
             alpha = jnp.exp(m_prev - m_new)
             l_ref[:, h:h + 1] = alpha * l_ref[:, h:h + 1] + jnp.sum(
                 p, axis=1, keepdims=True)
             acc_ref[:, sl] = acc_ref[:, sl] * alpha + jax.lax.dot_general(
-                p.astype(vf.dtype), vf[:, sl], (((1,), (0,)), ((), ())),
+                p.astype(vf.dtype), vf[:, slk], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             m_ref[:, h:h + 1] = m_new
 
-    run, needs_mask = _block_classes(causal, qi, ki, block_q, block_k)
+    run, needs_mask = _block_classes(causal, qi, ki, block_q, block_k,
+                                     offset)
     if run is None:
         _body(False)
     else:
@@ -146,29 +180,55 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 lse_t[h:h + 1], (_SUBL, lse_t.shape[1]))
 
 
+def _seg_operands(segment_ids, b, sq, sk):
+    """Broadcast (q_seg [b, sq], kv_seg [b, sk]) int32 into the TPU tile
+    layouts _seg_tile_mask expects."""
+    q_seg, kv_seg = segment_ids
+    q_seg = jnp.broadcast_to(jnp.asarray(q_seg, jnp.int32)[:, :, None],
+                             (b, sq, _LANES))
+    kv_seg = jnp.broadcast_to(jnp.asarray(kv_seg, jnp.int32)[:, None, :],
+                              (b, _SUBL, sk))
+    return q_seg, kv_seg
+
+
 def _flash_fwd_fused(q, k, v, H, causal, block_q=256, block_k=1024,
-                     interpret=False):
-    """q,k,v: [b, s, H*D] (q pre-scaled by sm_scale).
+                     interpret=False, Hk=None, segment_ids=None):
+    """q: [b, s, H*D]; k,v: [b, sk, Hk*D] (q pre-scaled by sm_scale).
+    Hk < H = grouped-query attention (q-head h reads kv-head h // (H//Hk)).
+    segment_ids: optional (q_seg [b, sq], kv_seg [b, sk]) int32 — scores
+    are masked to segment equality (padding/varlen-packing mask).
     Returns (out [b, s, H*D], lse [b, H*_SUBL, s] f32)."""
     b, sq, HD = q.shape
     sk = k.shape[1]
     D = HD // H
+    Hk = H if Hk is None else Hk
+    HkD = Hk * D
     block_q, block_k = _fit_blocks(block_q, block_k, HD,
-                                   n_bufs_q=2, n_bufs_k=2)
+                                   n_bufs_q=2, n_bufs_k=2, HDk=HkD)
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     grid = (b, sq // block_q, sk // block_k)
+    has_seg = segment_ids is not None
     kernel = functools.partial(
         _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
-        H=H, D=D)
+        H=H, Hk=Hk, D=D, offset=sk - sq, has_seg=has_seg)
+    in_specs = [
+        pl.BlockSpec((1, block_q, HD), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, HkD), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, HkD), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        qseg, kseg = _seg_operands(segment_ids, b, sq, sk)
+        in_specs += [
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, _SUBL, block_k), lambda b, i, j: (b, 0, j)),
+        ]
+        operands += [qseg, kseg]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, HD), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, HD), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, HD), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, HD), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, H * _SUBL, block_q), lambda b, i, j: (b, 0, i)),
@@ -186,7 +246,7 @@ def _flash_fwd_fused(q, k, v, H, causal, block_q=256, block_k=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
 
 # ======================= backward =======================
@@ -197,9 +257,7 @@ def _stats_cols(ref):
     return jax.lax.transpose(ref[0], (1, 0))
 
 
-def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                causal, block_q, block_k, H, D):
+def _bwd_kernel(*refs, causal, block_q, block_k, H, Hk, D, offset, has_seg):
     """Single-pass backward: one s/p recompute per block pair feeds dk, dv
     AND this pair's dq contribution (vs. the classic two-kernel split that
     recomputes s/p and the dp dot twice). dq contributions can't accumulate
@@ -207,54 +265,68 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     writes a partial into dqp [b, n_kblocks, sq, HD] f32; the caller sums
     over the k-block axis in XLA — a few hundred MB of streaming traffic
     that costs far less than a second full recompute pass."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dqp_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
+    G = H // Hk
 
     @pl.when(qi == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    def _body(masked):
+    def _body(causal_masked):
         qf = q_ref[0]                        # [bq, HD] (pre-scaled)
-        kf = k_ref[0]
+        kf = k_ref[0]                        # [bk, Hk*D]
         vf = v_ref[0]
         dof = do_ref[0]
         lse_c = _stats_cols(lse_ref)         # [bq, H*_SUBL]
         delta_c = _stats_cols(delta_ref)
-        ok = _causal_tile_mask(qi, ki, block_q, block_k) if masked else None
+        ok = (_causal_tile_mask(qi, ki, block_q, block_k, offset)
+              if causal_masked else None)
+        if has_seg:
+            seg_ok = _seg_tile_mask(qseg_ref, kseg_ref, block_k)
+            ok = seg_ok if ok is None else jnp.logical_and(ok, seg_ok)
         for h in range(H):
             sl = slice(h * D, (h + 1) * D)
+            slk = slice((h // G) * D, (h // G) * D + D)
             cl = slice(h * _SUBL, h * _SUBL + 1)
             s = jax.lax.dot_general(
-                qf[:, sl], kf[:, sl], (((1,), (1,)), ((), ())),
+                qf[:, sl], kf[:, slk], (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)      # [bq, bk]
             p = jnp.exp(s - lse_c[:, cl])
             if ok is not None:
                 p = jnp.where(ok, p, 0.0)
             # dv += p^T @ do
-            dv_acc[:, sl] += jax.lax.dot_general(
+            dv_acc[:, slk] += jax.lax.dot_general(
                 p.astype(dof.dtype), dof[:, sl], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(
-                dof[:, sl], vf[:, sl], (((1,), (1,)), ((), ())),
+                dof[:, sl], vf[:, slk], (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)      # [bq, bk]
             ds = p * (dp - delta_c[:, cl])
             # dk += ds^T @ q_scaled
-            dk_acc[:, sl] += jax.lax.dot_general(
+            dk_acc[:, slk] += jax.lax.dot_general(
                 ds.astype(qf.dtype), qf[:, sl], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            # this pair's dq contribution: ds @ k. Stored in the input
-            # dtype (bf16 under AMP): each partial is individually rounded
-            # before the f32-accumulated sum — acceptable because nk <= 8
-            # and the final dq is cast to the same dtype anyway (validated
-            # by the multi-k-block bf16 test in test_flash_attention.py)
+            # this pair's dq contribution: ds @ k. Stored in dqp's dtype:
+            # the input dtype while nk <= 8 (each partial individually
+            # rounded before the f32-accumulated sum), f32 beyond that —
+            # the caller picks (ADVICE r2: _fit_blocks can shrink block_k
+            # so nk may exceed 8)
             dqp_ref[0, 0, :, sl] = jax.lax.dot_general(
-                ds.astype(kf.dtype), kf[:, sl], (((1,), (0,)), ((), ())),
+                ds.astype(kf.dtype), kf[:, slk], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32).astype(dqp_ref.dtype)
 
-    run, needs_mask = _block_classes(causal, qi, ki, block_q, block_k)
+    run, needs_mask = _block_classes(causal, qi, ki, block_q, block_k,
+                                     offset)
     if run is None:
         _body(False)
     else:
@@ -279,25 +351,33 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_fused(q, k, v, o, lse, do, H, causal,
-                     block_q=256, block_k=512, interpret=False):
+                     block_q=256, block_k=512, interpret=False,
+                     Hk=None, segment_ids=None):
     """Blockwise dq/dk/dv on the fused-head layout.
 
-    q,k,v,o,do: [b, s, H*D] (q pre-scaled); lse: [b, H*_SUBL, sq] f32.
+    q,o,do: [b, sq, H*D] (q pre-scaled); k,v: [b, sk, Hk*D];
+    lse: [b, H*_SUBL, sq] f32.
     Returns (dq_scaled f32, dk, dv) — caller multiplies dq by sm_scale.
     """
     b, sq, HD = q.shape
     sk = k.shape[1]
     D = HD // H
+    Hk = H if Hk is None else Hk
+    HkD = Hk * D
     # long sequences: grow K blocks so the dq partial-sum buffer
     # (b * nk * sq * HD) stays bounded at nk <= 8 — _fit_blocks may shrink
     # them back if HD is too wide for VMEM, which keeps correctness and
     # trades the extra partials for compile-safety.
     block_k = max(block_k, sk // 8)
     block_q, block_k = _fit_blocks(block_q, block_k, HD,
-                                   n_bufs_q=3, n_bufs_k=4)
+                                   n_bufs_q=3, n_bufs_k=4, HDk=HkD)
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     nk = sk // block_k
+    # dq partials in the input dtype are only safe while few partials are
+    # summed; past nk=8 (e.g. _fit_blocks shrank block_k for a wide HD)
+    # keep them f32 so rounding doesn't scale with nk (ADVICE r2)
+    dqp_dtype = q.dtype if nk <= 8 else jnp.float32
 
     # delta_i = rowsum(do_i * o_i) per head — fused elementwise in XLA,
     # laid out like lse: [b, H*_SUBL, sq].
@@ -308,31 +388,43 @@ def _flash_bwd_fused(q, k, v, o, lse, do, H, causal,
                              (b, H, _SUBL, sq)).reshape(b, H * _SUBL, sq)
 
     q_spec_i = pl.BlockSpec((1, block_q, HD), lambda b, j, i: (b, i, 0))
-    k_spec_j = pl.BlockSpec((1, block_k, HD), lambda b, j, i: (b, j, 0))
+    k_spec_j = pl.BlockSpec((1, block_k, HkD), lambda b, j, i: (b, j, 0))
     stat_i = pl.BlockSpec((1, H * _SUBL, block_q), lambda b, j, i: (b, 0, i))
     dqp_spec = pl.BlockSpec((1, 1, block_q, HD),
                             lambda b, j, i: (b, j, i, 0))
 
+    has_seg = segment_ids is not None
+    in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, stat_i, stat_i]
+    operands = [q, k, v, do, lse, delta]
+    if has_seg:
+        qseg, kseg = _seg_operands(segment_ids, b, sq, sk)
+        in_specs += [
+            pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, _SUBL, block_k), lambda b, j, i: (b, 0, j)),
+        ]
+        operands += [qseg, kseg]
+
     dqp, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, causal=causal, block_q=block_q,
-                          block_k=block_k, H=H, D=D),
+                          block_k=block_k, H=H, Hk=Hk, D=D,
+                          offset=sk - sq, has_seg=has_seg),
         grid=(b, nk, sq // block_q),
-        in_specs=[q_spec_i, k_spec_j, k_spec_j, q_spec_i, stat_i, stat_i],
+        in_specs=in_specs,
         out_specs=[dqp_spec, k_spec_j, k_spec_j],
         out_shape=[
-            jax.ShapeDtypeStruct((b, nk, sq, HD), q.dtype),
-            jax.ShapeDtypeStruct((b, sk, HD), k.dtype),
-            jax.ShapeDtypeStruct((b, sk, HD), v.dtype),
+            jax.ShapeDtypeStruct((b, nk, sq, HD), dqp_dtype),
+            jax.ShapeDtypeStruct((b, sk, HkD), k.dtype),
+            jax.ShapeDtypeStruct((b, sk, HkD), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, HD), jnp.float32),
-            pltpu.VMEM((block_k, HD), jnp.float32),
+            pltpu.VMEM((block_k, HkD), jnp.float32),
+            pltpu.VMEM((block_k, HkD), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*operands)
     return jnp.sum(dqp, axis=1, dtype=jnp.float32), dk, dv
 
 
@@ -346,17 +438,22 @@ def _pick_block(s, target):
     return blk
 
 
-def _fit_blocks(block_q, block_k, HD, n_bufs_q, n_bufs_k, budget=_VMEM_LIMIT):
+def _fit_blocks(block_q, block_k, HD, n_bufs_q, n_bufs_k, HDk=None,
+                budget=_VMEM_LIMIT):
     """Shrink (block_q, block_k) until the kernel's VMEM appetite fits.
 
-    The dominant consumers scale linearly with HD (double-buffered block
-    DMAs + f32 accumulators) and with block_q*block_k (score-tile
-    transients), so large-model head widths (e.g. HD=4096) must trade
-    block size rather than crash the Pallas compile."""
+    The dominant consumers scale linearly with the operand widths
+    (double-buffered block DMAs + f32 accumulators) and with
+    block_q*block_k (score-tile transients), so large-model head widths
+    (e.g. HD=4096) must trade block size rather than crash the Pallas
+    compile. HDk: k/v-side width (Hk*D) — narrower than HD under GQA/MQA,
+    so k-side blocks aren't shrunk for q-side bytes."""
+    HDk = HD if HDk is None else HDk
+
     def est(bq, bk):
-        io = 2 * (n_bufs_q * bq + n_bufs_k * bk) * HD * 2   # dbuf bf16 DMAs
-        acc = (bq + bk) * HD * 4                            # f32 accumulators
-        tile = 3 * bq * bk * 4                              # score transients
+        io = 2 * (n_bufs_q * bq * HD + n_bufs_k * bk * HDk) * 2  # dbuf DMAs
+        acc = (bq * HD + bk * HDk) * 4                   # f32 accumulators
+        tile = 3 * bq * bk * 4                           # score transients
         return io + acc + tile
     while est(block_q, block_k) > budget * 0.75 and (
             block_q > 128 or block_k > 128):
@@ -369,23 +466,41 @@ def _fit_blocks(block_q, block_k, HD, n_bufs_q, n_bufs_k, budget=_VMEM_LIMIT):
 
 # ======================= dispatch =======================
 
-def _xla_attention(q, k, v, attn_mask, causal, sm_scale):
-    """Reference composite ([b,s,h,d] in/out) — the non-Pallas fallback."""
+def _xla_attention(q, k, v, attn_mask, causal, sm_scale, segment_ids=None):
+    """Reference composite ([b,s,h,d] in/out) — the non-Pallas fallback.
+    Handles GQA (kv heads dividing q heads), bottom-right-aligned causal
+    masking for sq != sk (FA2 semantics), and segment-id masking."""
+    h, hk = q.shape[2], k.shape[2]
+    if hk != h:
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
                    preferred_element_type=jnp.float32) * sm_scale
+    neg = jnp.asarray(_NEG_INF, s.dtype)
     if causal:
-        qpos = jnp.arange(s.shape[-2])[:, None]
-        kpos = jnp.arange(s.shape[-1])[None, :]
-        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        sq, sk = s.shape[-2], s.shape[-1]
+        qpos = (sk - sq) + jnp.arange(sq)[:, None]
+        kpos = jnp.arange(sk)[None, :]
+        s = jnp.where(qpos >= kpos, s, neg)
+    if segment_ids is not None:
+        q_seg, kv_seg = segment_ids
+        ok = (jnp.asarray(q_seg)[:, None, :, None]
+              == jnp.asarray(kv_seg)[:, None, None, :])
+        s = jnp.where(ok, s, neg)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
-            s = jnp.where(attn_mask, s, _NEG_INF)
+            s = jnp.where(attn_mask, s, neg)
         else:
             s = s + attn_mask.astype(s.dtype)
+    # fully-masked rows (padding / cross-length causal): softmax of all
+    # -inf would give uniform garbage; zero them instead
+    any_valid = jnp.max(s, axis=-1, keepdims=True) > _NEG_INF / 2
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    p = jnp.where(any_valid, p, jnp.zeros_like(p))
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
     return jnp.swapaxes(o, 1, 2).astype(q.dtype)
 
@@ -409,73 +524,109 @@ def _pallas_available():
     return _pallas_ok
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core(q, k, v, causal, sm_scale, use_pallas):
-    """[b, s, h, d] in/out."""
-    if use_pallas:
-        b, s, h, d = q.shape
-        qs = (q * sm_scale).astype(q.dtype).reshape(b, s, h * d)
-        o, _ = _flash_fwd_fused(qs, k.reshape(b, -1, h * d),
-                                v.reshape(b, -1, h * d), h, causal)
-        return o.reshape(b, s, h, d)
-    return _xla_attention(q, k, v, None, causal, sm_scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, segment_ids, causal, sm_scale, use_pallas):
+    """[b, s, h, d] in/out; k, v may carry fewer (kv) heads (GQA/MQA).
+    segment_ids: None or (q_seg [b,sq], kv_seg [b,sk]) int32."""
+    out, _ = _flash_core_fwd(q, k, v, segment_ids, causal, sm_scale,
+                             use_pallas)
+    return out
 
 
-def _flash_core_fwd(q, k, v, causal, sm_scale, use_pallas):
+def _flash_core_fwd(q, k, v, segment_ids, causal, sm_scale, use_pallas):
     if use_pallas:
         b, s, h, d = q.shape
+        hk = k.shape[2]
         qs = (q * sm_scale).astype(q.dtype).reshape(b, s, h * d)
-        km = k.reshape(b, -1, h * d)
-        vm = v.reshape(b, -1, h * d)
-        o, lse = _flash_fwd_fused(qs, km, vm, h, causal)
-        return o.reshape(b, s, h, d), (qs, km, vm, o, lse, h)
-    out = _xla_attention(q, k, v, None, causal, sm_scale)
-    return out, (q, k, v, None, None, None)
+        km = k.reshape(b, -1, hk * d)
+        vm = v.reshape(b, -1, hk * d)
+        o, lse = _flash_fwd_fused(qs, km, vm, h, causal, Hk=hk,
+                                  segment_ids=segment_ids)
+        return o.reshape(b, s, h, d), (qs, km, vm, o, lse, h, hk,
+                                       segment_ids)
+    out = _xla_attention(q, k, v, None, causal, sm_scale,
+                         segment_ids=segment_ids)
+    return out, (q, k, v, None, None, None, None, segment_ids)
 
 
 def _flash_core_bwd(causal, sm_scale, use_pallas, res, g):
-    q, k, v, o, lse, h = res
+    q, k, v, o, lse, h, hk, segment_ids = res
     if use_pallas:
         b, s, hd = q.shape
         gm = g.reshape(b, s, hd)
-        dq, dk, dv = _flash_bwd_fused(q, k, v, o, lse, gm, h, causal)
+        dq, dk, dv = _flash_bwd_fused(q, k, v, o, lse, gm, h, causal,
+                                      Hk=hk, segment_ids=segment_ids)
         d = hd // h
         dq = (dq * sm_scale).astype(q.dtype)  # dq arrives as f32 partial-sum
-        return (dq.reshape(b, s, h, d), dk.reshape(b, -1, h, d),
-                dv.reshape(b, -1, h, d))
+        return (dq.reshape(b, s, h, d), dk.reshape(b, -1, hk, d),
+                dv.reshape(b, -1, hk, d), None)
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_attention(q_, k_, v_, None, causal, sm_scale),
+        lambda q_, k_, v_: _xla_attention(q_, k_, v_, None, causal, sm_scale,
+                                          segment_ids=segment_ids),
         q, k, v)
-    return vjp(g)
+    return vjp(g) + (None,)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def _shapes_ok(q_shape, k_shape):
+    return not _shape_reject_reason(q_shape, k_shape)
+
+
+def _shape_reject_reason(q_shape, k_shape):
+    """None if the Pallas kernel applies, else a human-readable reason."""
     sq, sk, h, d = q_shape[1], k_shape[1], q_shape[2], q_shape[-1]
-    return (sq >= 128 and sk >= 128 and d in (64, 128, 256)
-            and sq % 128 == 0 and sk % 128 == 0
-            and (h * d) % _LANES == 0 and h <= _LANES
-            and k_shape[2] == h)
+    hk = k_shape[2]
+    if d not in (64, 128, 256):
+        return f"head_dim {d} not in (64, 128, 256)"
+    if sq < 128 or sk < 128 or sq % 128 or sk % 128:
+        return (f"seq lengths ({sq}, {sk}) must be >=128 multiples of 128 "
+                "(pad or pack, e.g. via segment_ids)")
+    if (h * d) % _LANES or h > _LANES:
+        return f"h*d={h * d} must be lane-aligned (%128==0) with h<=128"
+    if h % max(hk, 1) or (hk * d) % _LANES:
+        return (f"kv heads {hk} must divide q heads {h} with hk*d "
+                "lane-aligned (%128==0)")
+    return None
 
 
 def attention_path(q_shape, k_shape, masked=False):
-    """Which implementation flash_attention will take for these shapes:
-    'pallas' or 'xla'. Lets callers (e.g. bench.py) fail loudly when the
-    Pallas kernel silently disengages."""
-    if masked or not _pallas_available():
-        return "xla"
-    return "pallas" if _shapes_ok(q_shape, k_shape) else "xla"
+    """('pallas'|'xla', reason) — which implementation flash_attention will
+    take for these shapes and why. Lets callers (bench.py asserts on it;
+    nn.functional.flash_attention warns on fallback) see when the Pallas
+    kernel disengages. masked=True means a dense attn_mask (XLA
+    composite); segment-id masking stays on the Pallas path and needs no
+    flag."""
+    if masked:
+        return ("xla", "dense attn_mask forces the XLA composite — use "
+                "segment_ids or causal for the Pallas path")
+    if not _pallas_available():
+        return ("xla", f"no TPU Pallas backend ({jax.default_backend()})")
+    reason = _shape_reject_reason(q_shape, k_shape)
+    if reason:
+        return ("xla", reason)
+    return ("pallas", "")
 
 
 def flash_attention(q, k, v, attn_mask=None, causal=False,
-                    softmax_scale=None):
-    """[b, s, h, d] in and out. attn_mask forces the XLA composite (mask
-    streaming into the kernel lands with the masked/paged variant)."""
+                    softmax_scale=None, segment_ids=None):
+    """[b, s, h, d] in and out; k/v may have fewer heads (GQA/MQA).
+
+    segment_ids: (q_seg [b, sq], kv_seg [b, sk]) int32 — attention is
+    masked to equal ids (padding / packed-varlen, stays on the Pallas
+    path). A dense attn_mask forces the XLA composite.
+    Causal masking is bottom-right aligned when sq != sk (FA2 semantics,
+    ref: python/paddle/nn/functional/flash_attention.py:146 routing to the
+    FlashAttention-2 library)."""
     d = q.shape[-1]
     sm_scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(d)
     if attn_mask is not None:
-        return _xla_attention(q, k, v, attn_mask, causal, sm_scale)
+        return _xla_attention(q, k, v, attn_mask, causal, sm_scale,
+                              segment_ids=segment_ids)
     use_pallas = _pallas_available() and _shapes_ok(q.shape, k.shape)
-    return _flash_core(q, k, v, causal, sm_scale, bool(use_pallas))
+    if segment_ids is not None:
+        segment_ids = (jnp.asarray(segment_ids[0], jnp.int32),
+                       jnp.asarray(segment_ids[1], jnp.int32))
+    return _flash_core(q, k, v, segment_ids, causal, sm_scale,
+                       bool(use_pallas))

@@ -102,14 +102,16 @@ class LlamaAttention(Layer):
         from ..incubate.nn.functional import fused_rotary_position_embedding
         q, k = fused_rotary_position_embedding(
             q, k, sin=Tensor(sin), cos=Tensor(cos))
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = ops.repeat_interleave(k, rep, axis=2)
-            v = ops.repeat_interleave(v, rep, axis=2)
         if self.use_flash_attention:
+            # GQA stays native: the Pallas kernel maps q-head h to kv-head
+            # h // (H//Hk) in-kernel — no repeat_interleave materialization
             from ..incubate.nn.functional import fused_flash_attention
             out = fused_flash_attention(q, k, v, causal=True)
         else:
+            if self.num_kv_heads != self.num_heads:
+                rep = self.num_heads // self.num_kv_heads
+                k = ops.repeat_interleave(k, rep, axis=2)
+                v = ops.repeat_interleave(v, rep, axis=2)
             out = ops.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = ops.reshape(out, (b, s, self.hidden_size))
         return self.o_proj(out)

@@ -112,16 +112,75 @@ def sequence_mask(x, maxlen=None, dtype="int64"):
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    return_softmax=False, training=True, name=None):
+                    return_softmax=False, training=True, name=None,
+                    segment_ids=None):
     """ref API: python/paddle/nn/functional/flash_attention.py:146.
-    Dispatches to the Pallas flash-attention kernel on TPU when available,
-    else the XLA softmax-attention composite."""
+    Dispatches to the Pallas flash-attention kernel on TPU when available
+    (warning on fallback), else the XLA softmax-attention composite.
+    key/value may carry fewer heads (GQA/MQA); segment_ids=(q_seg, kv_seg)
+    masks to equal ids without leaving the Pallas path."""
     from ...incubate.nn.functional import fused_flash_attention
     out = fused_flash_attention(query, key, value, causal=causal,
-                                dropout=dropout, training=training)
-    if return_softmax:
-        return out, None
-    return out, None
+                                dropout=dropout, training=training,
+                                segment_ids=segment_ids)
+    return out, None  # softmax is never materialized on the flash path
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        training=True, name=None):
+    """Varlen flash attention over packed sequences
+    (ref API: python/paddle/nn/functional/flash_attention.py:302).
+
+    query/key/value: [total_tokens, num_heads, head_dim] with sequences
+    concatenated; cu_seqlens_*: [n_seqs+1] int32 cumulative offsets.
+    TPU-idiomatic rendering: the packed batch is ONE Pallas call masked by
+    segment ids derived from cu_seqlens (no per-sequence padding, stays on
+    the flash path); tokens past cu_seqlens[-1] are padding and attend to
+    nothing."""
+    from ...core.tensor import Tensor
+    from ...incubate.nn.functional import fused_flash_attention
+
+    if causal:
+        import numpy as _np
+        cq = _np.asarray(cu_seqlens_q._data if isinstance(cu_seqlens_q, Tensor)
+                         else cu_seqlens_q)
+        ck = _np.asarray(cu_seqlens_k._data if isinstance(cu_seqlens_k, Tensor)
+                         else cu_seqlens_k)
+        if cq.shape != ck.shape or not _np.array_equal(cq, ck):
+            raise NotImplementedError(
+                "flash_attn_unpadded with causal=True requires identical "
+                "q/kv packing (cu_seqlens_q == cu_seqlens_k): the global "
+                "bottom-right causal mask only matches per-sequence "
+                "causality when the packings coincide")
+
+    def seg_of(cu, total):
+        cu = jnp.asarray(cu._data if isinstance(cu, Tensor) else cu,
+                         jnp.int32)
+        pos = jnp.arange(total, dtype=jnp.int32)
+        # token i belongs to segment searchsorted(cu, i, 'right') - 1;
+        # tokens at/past cu[-1] get id -1 (padding, matches nothing)
+        seg = jnp.searchsorted(cu, pos, side="right").astype(jnp.int32) - 1
+        n_seq = cu.shape[0] - 1
+        return jnp.where((pos < cu[-1]) & (seg < n_seq), seg, -1)
+
+    tq = query.shape[0]
+    tk = key.shape[0]
+    q_seg = seg_of(cu_seqlens_q, tq)[None, :]
+    kv_seg = seg_of(cu_seqlens_k, tk)[None, :]
+    # pad-attends-nothing: give q padding a different sentinel than kv
+    # padding so the two never match each other
+    kv_seg = jnp.where(kv_seg < 0, -2, kv_seg)
+
+    # causal note: the global q_pos >= k_pos mask composed with segment
+    # equality gives per-sequence causal masking when q and kv share the
+    # same packing (cu_seqlens_q == cu_seqlens_k) — the self-attention
+    # case flash_attn_unpadded exists for.
+    out = fused_flash_attention(
+        query[None], key[None], value[None], causal=causal, dropout=dropout,
+        training=training, softmax_scale=scale, segment_ids=(q_seg, kv_seg))
+    return out[0], None  # softmax is never materialized on the flash path
 
 
 def softmax_(x, axis=-1):

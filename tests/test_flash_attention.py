@@ -123,15 +123,166 @@ def test_nonsquare_block_pick():
 
 
 def test_attention_path_gating():
-    # CPU backend -> xla; masked -> xla; odd shapes -> xla
-    assert fa.attention_path((2, 256, 4, 64), (2, 256, 4, 64)) == "xla"
-    assert fa.attention_path((2, 256, 4, 64), (2, 256, 4, 64),
-                             masked=True) == "xla"
-    assert fa.attention_path((2, 100, 4, 64), (2, 100, 4, 64)) == "xla"
+    # CPU backend -> xla; masked -> xla; odd shapes -> xla. Each fallback
+    # carries a human-readable reason (VERDICT r2 weak #3).
+    path, why = fa.attention_path((2, 256, 4, 64), (2, 256, 4, 64))
+    assert path == "xla" and "backend" in why
+    path, why = fa.attention_path((2, 256, 4, 64), (2, 256, 4, 64),
+                                  masked=True)
+    assert path == "xla" and "attn_mask" in why
+    path, _ = fa.attention_path((2, 100, 4, 64), (2, 100, 4, 64))
+    assert path == "xla"
     # fused-head lane alignment: h*d must be a multiple of 128
     assert not fa._shapes_ok((2, 256, 3, 64), (2, 256, 3, 64))
     assert fa._shapes_ok((2, 256, 4, 64), (2, 256, 4, 64))
     assert fa._shapes_ok((2, 1024, 12, 64), (2, 1024, 12, 64))
+    # GQA: kv heads must divide q heads with hk*d lane-aligned
+    assert fa._shapes_ok((2, 256, 4, 64), (2, 256, 2, 64))
+    assert fa._shapes_ok((2, 1024, 12, 128), (2, 1024, 4, 128))
+    assert not fa._shapes_ok((2, 256, 4, 64), (2, 256, 3, 64))
+    assert not fa._shapes_ok((2, 256, 8, 64), (2, 256, 1, 64))  # 64 lanes
+    assert fa._shapes_ok((2, 256, 8, 128), (2, 256, 1, 128))    # MQA ok
+
+
+def _xla_ref(q, k, v, causal, sc, segment_ids=None):
+    return fa._xla_attention(q, k, v, None, causal, sc,
+                             segment_ids=segment_ids)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hk", [1, 2])
+def test_gqa_fwd_bwd_interpret(causal, hk):
+    """GQA/MQA: q-head h reads kv-head h // (H//Hk) in-kernel."""
+    h, d, s, b = 4, 128, 256, 2
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hk, d)), jnp.float32)
+    sc = 1.0 / np.sqrt(d)
+    qs = (q * sc).astype(q.dtype).reshape(b, s, h * d)
+    km, vm = k.reshape(b, s, hk * d), v.reshape(b, s, hk * d)
+    o, lse = fa._flash_fwd_fused(qs, km, vm, h, causal, block_q=128,
+                                 block_k=128, interpret=True, Hk=hk)
+    ref = _xla_ref(q, k, v, causal, sc)
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(ref.reshape(b, s, h * d)),
+                               rtol=5e-5, atol=5e-5)
+    do = jnp.asarray(rng.standard_normal(o.shape), o.dtype)
+    dq, dk, dv = fa._flash_bwd_fused(qs, km, vm, o, lse, do, h, causal,
+                                     block_q=128, block_k=128,
+                                     interpret=True, Hk=hk)
+    dq = dq * sc
+
+    def comp(qm, km, vm):
+        out = _xla_ref(qm.reshape(b, s, h, d), km.reshape(b, s, hk, d),
+                       vm.reshape(b, s, hk, d), causal, sc)
+        return out.reshape(b, s, h * d)
+
+    _, vjp = jax.vjp(comp, q.reshape(b, s, h * d), km, vm)
+    rq, rk, rv = vjp(do)
+    for got, ref_g in ((dq, rq), (dk, rk), (dv, rv)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref_g),
+                                   rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_ids_fwd_bwd_interpret(causal):
+    """Padding + packed-varlen masking via segment ids stays in-kernel."""
+    b, s, h, d = 2, 256, 2, 64
+    rng = np.random.default_rng(11)
+    q, k, v = _make(b=b, s=s, h=h, d=d, seed=11)
+    # batch 0: two packed sequences + tail padding; batch 1: all one segment
+    seg0 = np.concatenate([np.zeros(100), np.ones(80),
+                           -np.ones(76)]).astype(np.int32)
+    seg1 = np.zeros(s, np.int32)
+    seg = jnp.asarray(np.stack([seg0, seg1]))
+    sc = 1.0 / np.sqrt(d)
+    qs = (q * sc).astype(q.dtype).reshape(b, s, h * d)
+    km, vm = _fuse(k), _fuse(v)
+    o, lse = fa._flash_fwd_fused(qs, km, vm, h, causal, block_q=128,
+                                 block_k=128, interpret=True,
+                                 segment_ids=(seg, seg))
+    ref = _xla_ref(q, k, v, causal, sc, segment_ids=(seg, seg))
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(_fuse(ref)),
+                               rtol=5e-5, atol=5e-5)
+    do = jnp.asarray(rng.standard_normal(o.shape), o.dtype)
+    dq, dk, dv = fa._flash_bwd_fused(qs, km, vm, o, lse, do, h, causal,
+                                     block_q=128, block_k=128,
+                                     interpret=True,
+                                     segment_ids=(seg, seg))
+    dq = dq * sc
+
+    def comp(qm, km, vm):
+        out = _xla_ref(qm.reshape(b, s, h, d), km.reshape(b, s, h, d),
+                       vm.reshape(b, s, h, d), causal, sc,
+                       segment_ids=(seg, seg))
+        return out.reshape(b, s, h * d)
+
+    _, vjp = jax.vjp(comp, _fuse(q), km, vm)
+    rq, rk, rv = vjp(do)
+    for got, ref_g in ((dq, rq), (dk, rk), (dv, rv)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref_g),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_cross_length_causal_bottom_right():
+    """sq != sk causal is bottom-right aligned (FA2 semantics, ADVICE r2):
+    the LAST q row sees all sk keys."""
+    b, h, d = 1, 2, 64
+    sq, sk = 128, 256
+    rng = np.random.default_rng(13)
+    q = jnp.asarray(rng.standard_normal((b, sq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, sk, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, sk, h, d)), jnp.float32)
+    sc = 1.0 / np.sqrt(d)
+    qs = (q * sc).astype(q.dtype).reshape(b, sq, h * d)
+    o, _ = fa._flash_fwd_fused(qs, k.reshape(b, sk, h * d),
+                               v.reshape(b, sk, h * d), h, True,
+                               block_q=128, block_k=128, interpret=True)
+    ref = _xla_ref(q, k, v, True, sc)  # composite also bottom-right
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(ref.reshape(b, sq, h * d)),
+                               rtol=5e-5, atol=5e-5)
+    # semantic spot-check vs an explicit bottom-right mask
+    s_full = np.einsum("bqhd,bkhd->bhqk", np.asarray(q, np.float64),
+                       np.asarray(k, np.float64)) * sc
+    qpos = (sk - sq) + np.arange(sq)[:, None]
+    kpos = np.arange(sk)[None, :]
+    s_full = np.where(qpos >= kpos, s_full, -1e30)
+    p = np.exp(s_full - s_full.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    exp = np.einsum("bhqk,bkhd->bqhd", p, np.asarray(v, np.float64))
+    np.testing.assert_allclose(
+        np.asarray(o).reshape(b, sq, h, d), exp, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attn_unpadded_varlen():
+    """Packed varlen wrapper == per-sequence dense attention."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional import flash_attn_unpadded
+
+    h, d = 2, 64
+    lens = [100, 80, 50]
+    total = 256  # padded to a 128 multiple
+    rng = np.random.default_rng(17)
+    qkv = [jnp.asarray(rng.standard_normal((total, h, d)), jnp.float32)
+           for _ in range(3)]
+    cu = np.cumsum([0] + lens).astype(np.int32)
+    out, _ = flash_attn_unpadded(
+        paddle.to_tensor(qkv[0]), paddle.to_tensor(qkv[1]),
+        paddle.to_tensor(qkv[2]), cu_seqlens_q=cu, cu_seqlens_k=cu,
+        causal=True)
+    out = np.asarray(out.numpy())
+    sc = 1.0 / np.sqrt(d)
+    for i in range(len(lens)):
+        s0, s1 = cu[i], cu[i + 1]
+        qi = qkv[0][None, s0:s1]
+        ki = qkv[1][None, s0:s1]
+        vi = qkv[2][None, s0:s1]
+        ref = _xla_ref(qi, ki, vi, True, sc)[0]
+        np.testing.assert_allclose(out[s0:s1], np.asarray(ref),
+                                   rtol=5e-4, atol=5e-4)
 
 
 def test_flash_attention_dispatch_cpu_fallback():
