@@ -89,6 +89,13 @@ def maybe_cast_inputs(opdef, arguments: dict) -> dict:
                     from ..ops import cast as cast_op
                     return cast_op(x, dtypes.from_np(target))
                 return Tensor._wrap(x._data.astype(target), stop_gradient=True)
+        elif isinstance(x, jax.Array) and jnp.issubdtype(x.dtype,
+                                                         jnp.floating):
+            # raw arrays (e.g. batch inputs traced through TrainStep) are
+            # non-diff constants — cast like a stop_gradient Tensor
+            if x.dtype != target and x.dtype in (
+                    jnp.float32, jnp.bfloat16, jnp.float16):
+                return x.astype(target)
         return x
 
     return jax.tree_util.tree_map(

@@ -256,10 +256,14 @@ class Tensor:
         return self
 
     def __deepcopy__(self, memo):
-        # jax arrays are immutable: the buffer can be shared, the wrapper
-        # must be fresh (independent autograd meta)
+        # the wrapper must be fresh (independent autograd meta) AND the
+        # buffer must be a distinct device allocation: deep-copied params
+        # (e.g. TransformerEncoder replicating its layer) are donated as
+        # separate arguments by TrainStep, and XLA rejects donating one
+        # buffer twice
         t = type(self).__new__(type(self))
-        t._data = self._data
+        t._data = (self._data if _is_tracer(self._data)
+                   else jnp.array(self._data, copy=True))
         t.stop_gradient = self.stop_gradient
         t.persistable = self.persistable
         t.name = self.name

@@ -35,6 +35,7 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     tie_word_embeddings: bool = True
     use_flash_attention: bool = False  # route SDPA through the Pallas kernel
+    recompute: bool = False  # per-block activation remat (jax.checkpoint)
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -181,10 +182,18 @@ class GPTModel(Layer):
             position_ids = ops.arange(past, past + s, dtype="int32")
         x = self.embeddings(input_ids, position_ids)
         new_caches = []
+        use_remat = (self.config.recompute and self.training
+                     and caches is None)
+        if use_remat:
+            from ..distributed.meta_parallel.recompute import recompute
         for i, layer in enumerate(self.layers):
             if caches is not None:
                 x, c = layer(x, caches[i])
                 new_caches.append(c)
+            elif use_remat:
+                # ref: fleet recompute_interval on GPT blocks
+                # (python/paddle/distributed/fleet/recompute/recompute.py:108)
+                x = recompute(layer, x)
             else:
                 x = layer(x)
         x = self.final_norm(x)

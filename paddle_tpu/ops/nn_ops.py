@@ -267,7 +267,10 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format):
     channel_last = data_format[-1] == "C"
     dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
                                         _conv_dn(x.ndim, channel_last))
-    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    # NOTE: no preferred_element_type here — the TPU MXU accumulates conv
+    # in f32 regardless and we'd round back to x.dtype below anyway, while
+    # jax's conv transpose rule rejects the mixed-dtype (f32 cotangent,
+    # bf16 operand) call an f32-preferred conv produces under autodiff
     prec = jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
     out = jax.lax.conv_general_dilated(
         x, weight,
@@ -276,10 +279,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format):
         rhs_dilation=_norm_tuple(dilation, n),
         dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=acc,
         precision=prec)
-    if acc is not None:
-        out = out.astype(x.dtype)
     if bias is not None:
         bshape = [1] * x.ndim
         bshape[-1 if channel_last else 1] = bias.shape[0]
@@ -835,7 +835,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True):
     # [batch, seq, heads, head_dim] (paddle convention,
-    # ref: python/paddle/nn/functional/flash_attention.py:441)
+    # ref: python/paddle/nn/functional/flash_attention.py:441 — which also
+    # routes SDPA into the flash library when eligible)
+    if attn_mask is None and (dropout_p == 0.0 or not training):
+        from ..kernels.pallas import flash_attention as _pk_fa
+        from ..kernels.pallas.flash_attention import (
+            _pallas_available, _shapes_ok)
+        if _pallas_available() and _shapes_ok(query.shape, key.shape):
+            return _pk_fa(query, key, value, causal=is_causal)
     q = jnp.swapaxes(query, 1, 2)  # [b, h, s, d]
     k = jnp.swapaxes(key, 1, 2)
     v = jnp.swapaxes(value, 1, 2)

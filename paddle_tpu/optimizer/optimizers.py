@@ -53,21 +53,35 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, name=None):
+                 use_multi_tensor=False, moment_dtype=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        # moment_dtype='bfloat16' stores m/v in bf16 (update math stays
+        # f32): 8 bytes/param instead of 4+4 f32 — the HBM lever that lets
+        # billion-parameter configs train on one 16GB chip (same trade the
+        # reference ships as multi-tensor fp16 moments in
+        # paddle/phi/kernels/gpu/adamw_kernel.cu's MP path, inverted for
+        # TPU where params stay f32 and moments shrink)
+        self.moment_dtype = moment_dtype
 
     def _state_names(self):
         return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
 
+    def _moment_dtype(self, base):
+        if self.moment_dtype is None:
+            return base.dtype
+        from ..core import dtype as dtypes
+        return dtypes.to_jnp(self.moment_dtype)
+
     def _init_state(self, p):
         base = self._master(p) if self._master(p) is not None else p._data
+        mdt = self._moment_dtype(base)
         return {
-            "moment1": jnp.zeros_like(base),
-            "moment2": jnp.zeros_like(base),
+            "moment1": jnp.zeros(base.shape, mdt),
+            "moment2": jnp.zeros(base.shape, mdt),
             "beta1_pow": jnp.asarray(1.0, jnp.float32),
             "beta2_pow": jnp.asarray(1.0, jnp.float32),
         }
@@ -77,18 +91,22 @@ class Adam(Optimizer):
 
     def _update_rule(self, param, grad, state, lr, group):
         grad = self._decayed_grad(param, grad, group)
-        m = state["moment1"]
-        v = state["moment2"]
+        mdt = state["moment1"].dtype
+        m = state["moment1"].astype(jnp.float32)
+        v = state["moment2"].astype(jnp.float32)
+        grad32 = grad.astype(jnp.float32)
         b1p = state["beta1_pow"] * self.beta1
         b2p = state["beta2_pow"] * self.beta2
-        m = self.beta1 * m + (1 - self.beta1) * grad
-        v = self.beta2 * v + (1 - self.beta2) * jnp.square(grad)
+        m = self.beta1 * m + (1 - self.beta1) * grad32
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(grad32)
         m_hat = m / (1 - b1p)
         v_hat = v / (1 - b2p)
-        new_param = param - lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+        upd = (lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)).astype(
+            param.dtype)
+        new_param = param - upd
         new_param = self._post_update(new_param, param, lr, group)
-        return new_param, {"moment1": m, "moment2": v, "beta1_pow": b1p,
-                           "beta2_pow": b2p}
+        return new_param, {"moment1": m.astype(mdt), "moment2": v.astype(mdt),
+                           "beta1_pow": b1p, "beta2_pow": b2p}
 
     def _post_update(self, new_param, param, lr, group):
         return new_param
@@ -100,9 +118,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False, moment_dtype=None,
+                 name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode, multi_precision)
+                         None, grad_clip, lazy_mode, multi_precision,
+                         moment_dtype=moment_dtype)
         self.weight_decay = weight_decay or 0.0
         self.apply_decay_param_fun = apply_decay_param_fun
         self._current_param_name = None
