@@ -79,8 +79,23 @@ def _child_env(args, global_rank: int, local_rank: int,
         "PADDLE_LOCAL_RANK": str(local_rank),
         "PADDLE_NNODES": str(args.nnodes),
         "FLAGS_selected_devices": str(local_rank),
+        # shared HMAC key authenticating RPC frames (rpc._rpc_token);
+        # same value for every rank of this job
+        "PADDLE_RPC_TOKEN": _job_rpc_token(),
     })
     return env
+
+
+_RPC_TOKEN_CACHE = None
+
+
+def _job_rpc_token() -> str:
+    global _RPC_TOKEN_CACHE
+    if _RPC_TOKEN_CACHE is None:
+        import secrets
+        _RPC_TOKEN_CACHE = os.environ.get("PADDLE_RPC_TOKEN") \
+            or secrets.token_hex(16)
+    return _RPC_TOKEN_CACHE
 
 
 def launch(argv: Optional[List[str]] = None) -> int:

@@ -27,6 +27,20 @@ WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 
 _DEFAULT_RPC_TIMEOUT = 120.0
 
+
+def _rpc_token() -> bytes:
+    """Shared HMAC key authenticating every RPC frame before unpickling
+    (plain pickle over TCP is remote code execution for any peer that can
+    reach the port — ADVICE r2). The launch CLI generates a random token
+    and injects PADDLE_RPC_TOKEN into every rank's env; standalone jobs
+    without one fall back to a master-endpoint-derived key, which only
+    keeps out stray traffic — set PADDLE_RPC_TOKEN for real isolation."""
+    tok = os.environ.get("PADDLE_RPC_TOKEN", "")
+    if tok:
+        return tok.encode()
+    seed = os.environ.get("PADDLE_MASTER", "127.0.0.1:29431")
+    return ("paddle-tpu-rpc:" + seed).encode()
+
 _server = None
 _server_thread = None
 _executor = None
@@ -36,24 +50,34 @@ _master_sock = None
 
 
 def _send_msg(sock, obj):
+    import hmac as _hmac
+    import hashlib
     payload = pickle.dumps(obj)
-    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+    mac = _hmac.new(_rpc_token(), payload, hashlib.sha256).digest()
+    sock.sendall(struct.pack("!Q", len(payload)) + mac + payload)
 
 
 def _recv_msg(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
-            raise ConnectionError("rpc peer closed")
-        hdr += chunk
-    (n,) = struct.unpack("!Q", hdr)
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("rpc peer closed mid-message")
-        buf += chunk
+    import hmac as _hmac
+    import hashlib
+
+    def read_exact(n, what):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(min(1 << 20, n - len(buf)))
+            if not chunk:
+                raise ConnectionError(f"rpc peer closed {what}")
+            buf += chunk
+        return buf
+
+    (n,) = struct.unpack("!Q", read_exact(8, ""))
+    mac = read_exact(32, "mid-mac")
+    buf = read_exact(n, "mid-message")
+    want = _hmac.new(_rpc_token(), buf, hashlib.sha256).digest()
+    if not _hmac.compare_digest(mac, want):
+        # authenticate BEFORE unpickling: reject unauthenticated peers
+        # without ever deserializing their payload
+        raise ConnectionError("rpc frame failed HMAC authentication")
     return pickle.loads(buf)
 
 
@@ -165,14 +189,17 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         t.start()
         _master_sock = master
 
-    _server = _Server(("0.0.0.0", 0), _RpcHandler)
+    # bind to the interface peers actually use (loopback for single-host
+    # jobs) instead of 0.0.0.0 — ADVICE r2: don't expose the RPC port on
+    # every interface
+    host_ip = socket.gethostbyname(socket.gethostname())
+    bind_ip = host_ip if world_size > 1 else "127.0.0.1"
+    _server = _Server((bind_ip, 0), _RpcHandler)
     port = _server.server_address[1]
     _server_thread = threading.Thread(target=_server.serve_forever,
                                       daemon=True)
     _server_thread.start()
     _executor = ThreadPoolExecutor(max_workers=8)
-
-    host_ip = socket.gethostbyname(socket.gethostname())
     me = WorkerInfo(name, rank, host_ip if world_size > 1 else "127.0.0.1",
                     port)
     _master_call(master_endpoint, "register", me)

@@ -36,6 +36,7 @@ from .random import (  # noqa: F401
     randint_like, randperm, multinomial, bernoulli, poisson, rand_like,
     randn_like, exponential_,
 )
+from .longtail import *  # noqa: F401,F403
 from .nn_ops import *  # noqa: F401,F403
 from .vision_ops import (  # noqa: F401
     depthwise_conv2d, conv3d_transpose, deformable_conv, fold,

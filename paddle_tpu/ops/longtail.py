@@ -1,0 +1,394 @@
+"""Round-3 long-tail ops (VERDICT r2 missing #3).
+
+Manipulation / math / linalg / complex surface the reference declares in
+its YAML + python/paddle/tensor API that had no analog here yet. All are
+pure-jnp registry ops (eager + tape + AMP + trace for free); each cites
+its reference definition. Oracle coverage: tests/test_ops_oracle_r3.py.
+"""
+from __future__ import annotations
+
+import itertools as _it
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+__all__ = [
+    "tensor_split", "hsplit", "vsplit", "dsplit", "column_stack",
+    "row_stack", "hstack", "vstack", "dstack", "unflatten", "take",
+    "block_diag", "cartesian_prod", "combinations", "diagonal_scatter",
+    "select_scatter", "slice_scatter", "sinc", "signbit", "isposinf",
+    "isneginf", "isreal", "positive", "negative", "sgn", "float_power",
+    "vander", "gammaln", "gammainc", "gammaincc", "multigammaln",
+    "histogram_bin_edges", "histogramdd", "pdist", "cdist", "polar",
+    "view_as_complex", "view_as_real", "cond", "matrix_exp", "addbmm",
+    "baddbmm", "cholesky_inverse", "geqrf", "orgqr", "reverse",
+]
+
+
+# ---------------- manipulation ----------------
+# ref: python/paddle/tensor/manipulation.py (tensor_split:6246 family)
+
+def tensor_split(x, num_or_indices, axis=0):
+    """ref: manipulation.py tensor_split — uneven splits allowed."""
+    from ..core.tensor import Tensor
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if isinstance(num_or_indices, int):
+        pieces = jnp.array_split(arr, num_or_indices, axis=axis)
+    else:
+        pieces = jnp.split(arr, list(num_or_indices), axis=axis)
+    return [Tensor._wrap(p, stop_gradient=getattr(x, "stop_gradient", True))
+            for p in pieces]
+
+
+def hsplit(x, num_or_indices):
+    if x.ndim < 1:
+        raise ValueError("hsplit expects ndim >= 1")
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices):
+    if x.ndim < 2:
+        raise ValueError("vsplit expects ndim >= 2")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices):
+    if x.ndim < 3:
+        raise ValueError("dsplit expects ndim >= 3")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+@register_op("column_stack")
+def column_stack(x):
+    return jnp.column_stack(tuple(x))
+
+
+@register_op("row_stack")
+def row_stack(x):
+    return jnp.vstack(tuple(x))
+
+
+@register_op("hstack")
+def hstack(x):
+    return jnp.hstack(tuple(x))
+
+
+@register_op("vstack")
+def vstack(x):
+    return jnp.vstack(tuple(x))
+
+
+@register_op("dstack")
+def dstack(x):
+    return jnp.dstack(tuple(x))
+
+
+@register_op("unflatten")
+def unflatten(x, axis, shape):
+    """ref: manipulation.py unflatten — expand `axis` into `shape`."""
+    axis = axis % x.ndim
+    shape = list(shape)
+    if shape.count(-1) > 1:
+        raise ValueError("unflatten shape may contain at most one -1")
+    new_shape = list(x.shape[:axis]) + shape + list(x.shape[axis + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+@register_op("take")
+def take(x, index, mode="raise"):
+    """ref: math.py take — flat-index gather with raise/wrap/clip."""
+    flat = jnp.ravel(x)
+    idx = index.astype(jnp.int32) if index.dtype != jnp.int64 else index
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    else:  # 'raise' cannot raise under XLA; clip is the safe rendering
+        idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+    return jnp.take(flat, idx)
+
+
+@register_op("block_diag")
+def block_diag(inputs):
+    from jax.scipy.linalg import block_diag as _bd
+    return _bd(*[jnp.atleast_2d(a) for a in inputs])
+
+
+@register_op("cartesian_prod")
+def cartesian_prod(x):
+    grids = jnp.meshgrid(*list(x), indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+@register_op("combinations")
+def combinations(x, r=2, with_replacement=False):
+    n = x.shape[0]
+    gen = (_it.combinations_with_replacement(range(n), r)
+           if with_replacement else _it.combinations(range(n), r))
+    idx = np.array(list(gen), np.int32).reshape(-1, r)
+    return jnp.take(x, jnp.asarray(idx), axis=0)
+
+
+@register_op("diagonal_scatter")
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    """ref: manipulation.py diagonal_scatter — write y onto a diagonal."""
+    axis1, axis2 = axis1 % x.ndim, axis2 % x.ndim
+    xm = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    n1, n2 = xm.shape[-2], xm.shape[-1]
+    if offset >= 0:
+        i = jnp.arange(max(min(n1, n2 - offset), 0))
+        j = i + offset
+    else:
+        j = jnp.arange(max(min(n1 + offset, n2), 0))
+        i = j - offset
+    out = xm.at[..., i, j].set(y)
+    return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+
+
+@register_op("select_scatter")
+def select_scatter(x, values, axis, index):
+    idx = [slice(None)] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@register_op("slice_scatter")
+def slice_scatter(x, value, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a % x.ndim] = slice(s, e, st)
+    return x.at[tuple(idx)].set(value)
+
+
+@register_op("reverse")
+def reverse(x, axis):
+    """ref: legacy reverse op (alias of flip)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+# ---------------- math ----------------
+# ref: python/paddle/tensor/math.py
+
+@register_op("sinc")
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@register_op("signbit")
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@register_op("isposinf")
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+@register_op("isneginf")
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+@register_op("isreal")
+def isreal(x):
+    return jnp.isreal(x)
+
+
+@register_op("positive")
+def positive(x):
+    return +x
+
+
+@register_op("negative")
+def negative(x):
+    return -x
+
+
+@register_op("sgn")
+def sgn(x):
+    """ref: math.py sgn — complex-aware sign (unit phasor / 0)."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+@register_op("float_power")
+def float_power(x, y):
+    return jnp.float_power(x, y)
+
+
+@register_op("vander")
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@register_op("gammaln", amp_policy="black")
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@register_op("gammainc", amp_policy="black")
+def gammainc(x, y):
+    """ref: math.py gammainc(x, y) = P(x, y) regularized lower."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+@register_op("gammaincc", amp_policy="black")
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@register_op("multigammaln", amp_policy="black")
+def multigammaln(x, p):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+@register_op("histogram_bin_edges")
+def histogram_bin_edges(x, bins=100, min=0, max=0):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    return jnp.histogram_bin_edges(x, bins=bins, range=rng)
+
+
+@register_op("histogramdd")
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    h, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                               weights=weights)
+    return (h, *edges)
+
+
+@register_op("pdist")
+def pdist(x, p=2.0):
+    """ref: math.py pdist — condensed pairwise distance vector."""
+    n = x.shape[0]
+    i, j = np.triu_indices(n, k=1)
+    diff = x[jnp.asarray(i)] - x[jnp.asarray(j)]
+    return _minkowski(diff, p, axis=-1)
+
+
+@register_op("cdist")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    """ref: python/paddle/tensor/linalg.py cdist — batched [.., P, M] x
+    [.., R, M] -> [.., P, R] p-norm distance matrix. The p=2 path uses
+    the MXU (||a||^2 + ||b||^2 - 2ab) when allowed, matching the
+    reference's use_mm compute modes."""
+    if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+        x2 = jnp.sum(x * x, axis=-1, keepdims=True)        # [.., P, 1]
+        y2 = jnp.sum(y * y, axis=-1, keepdims=True)        # [.., R, 1]
+        xy = jnp.matmul(x, jnp.swapaxes(y, -1, -2))        # [.., P, R]
+        sq = x2 - 2.0 * xy + jnp.swapaxes(y2, -1, -2)
+        return jnp.sqrt(jnp.maximum(sq, 0.0))
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    return _minkowski(diff, p, axis=-1)
+
+
+def _minkowski(diff, p, axis):
+    ad = jnp.abs(diff)
+    if p == 0:
+        return jnp.sum((ad != 0).astype(diff.dtype), axis=axis)
+    if p == float("inf"):
+        return jnp.max(ad, axis=axis)
+    if p == 1.0:
+        return jnp.sum(ad, axis=axis)
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(ad * ad, axis=axis))
+    return jnp.sum(ad ** p, axis=axis) ** (1.0 / p)
+
+
+# ---------------- complex ----------------
+# ref: python/paddle/tensor/creation.py polar; manipulation as_complex
+
+@register_op("polar")
+def polar(abs, angle):
+    return (abs * jnp.cos(angle) + 1j * (abs * jnp.sin(angle))).astype(
+        jnp.complex64 if abs.dtype == jnp.float32 else jnp.complex128)
+
+
+def view_as_complex(x):
+    from . import as_complex
+    return as_complex(x)
+
+
+def view_as_real(x):
+    from . import as_real
+    return as_real(x)
+
+
+# ---------------- linalg ----------------
+# ref: python/paddle/tensor/linalg.py
+
+@register_op("linalg_cond", amp_policy="black")
+def cond(x, p=None):
+    """ref: linalg.py cond — condition number (default 2-norm)."""
+    if p is None or p == 2 or p == -2:
+        s = jnp.linalg.svd(x, compute_uv=False)
+        if p == -2:
+            return s[..., -1] / s[..., 0]
+        return s[..., 0] / s[..., -1]
+    if p in ("fro", "nuc", 1, -1, np.inf, -np.inf, float("inf")):
+        return (jnp.linalg.norm(x, ord=p, axis=(-2, -1))
+                * jnp.linalg.norm(jnp.linalg.inv(x), ord=p, axis=(-2, -1)))
+    raise ValueError(f"unsupported p for cond: {p!r}")
+
+
+@register_op("matrix_exp", amp_policy="black")
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@register_op("addbmm")
+def addbmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.sum(jnp.matmul(x, y), axis=0)
+
+
+@register_op("baddbmm")
+def baddbmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register_op("cholesky_inverse", amp_policy="black")
+def cholesky_inverse(x, upper=False):
+    """ref: linalg.py cholesky_inverse — inverse of A from its Cholesky
+    factor, via two triangular solves against I."""
+    n = x.shape[-1]
+    eye = jnp.eye(n, dtype=x.dtype)
+    if upper:
+        # A = U^T U ; A^-1 = U^-1 U^-T
+        w = jax.scipy.linalg.solve_triangular(x, eye, lower=False)
+        return w @ w.T if x.ndim == 2 else jnp.matmul(
+            w, jnp.swapaxes(w, -1, -2))
+    w = jax.scipy.linalg.solve_triangular(x, eye, lower=True)
+    return w.T @ w if x.ndim == 2 else jnp.matmul(
+        jnp.swapaxes(w, -1, -2), w)
+
+
+@register_op("geqrf", amp_policy="black")
+def geqrf(x):
+    """ref: linalg geqrf — raw householder QR factors (a, tau), via a
+    LAPACK host callback (a host-side factorization utility, not a
+    training hot path)."""
+    k = min(x.shape[-2], x.shape[-1])
+    out_shapes = (jax.ShapeDtypeStruct(x.shape, x.dtype),
+                  jax.ShapeDtypeStruct(x.shape[:-2] + (k,), x.dtype))
+
+    def host_fn(a):
+        from scipy.linalg import lapack
+        fn = lapack.sgeqrf if a.dtype == np.float32 else lapack.dgeqrf
+        batch = a.reshape((-1,) + a.shape[-2:])
+        qrs, taus = zip(*((lambda r: (r[0], r[1]))(fn(m)) for m in batch))
+        qr_ = np.stack(qrs).reshape(a.shape)
+        tau_ = np.stack(taus).reshape(a.shape[:-2] + (min(a.shape[-2:]),))
+        return qr_.astype(a.dtype), tau_.astype(a.dtype)
+
+    return jax.pure_callback(host_fn, out_shapes, x,
+                             vmap_method="sequential")
+
+
+def orgqr(x, tau):
+    """alias of householder_product (ref: linalg.py orgqr)."""
+    from . import householder_product
+    return householder_product(x, tau)

@@ -71,6 +71,12 @@ class SparseCooTensor:
         return Tensor._wrap(self._bcoo.data)
 
     def to_dense(self):
+        # sparse.nn ops attach the tape-recorded dense Tensor they were
+        # computed from, so to_dense() keeps autograd connectivity
+        # (trainable sparse conv layers)
+        dt = getattr(self, "_dense_tensor", None)
+        if dt is not None:
+            return dt
         return Tensor._wrap(self._bcoo.todense())
 
     def to_sparse_csr(self):
@@ -201,10 +207,19 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
     if dtype is not None:
         from ..core.dtype import to_jax_dtype
         vals = vals.astype(to_jax_dtype(dtype))
-    bcsr = jsparse.BCSR(
-        (vals, jnp.asarray(_dense_data(cols), jnp.int32),
-         jnp.asarray(_dense_data(crows), jnp.int32)),
-        shape=tuple(shape))
+    crows = jnp.asarray(_dense_data(crows), jnp.int32)
+    cols = jnp.asarray(_dense_data(cols), jnp.int32)
+    if len(shape) == 3 and crows.ndim == 1:
+        # paddle passes BATCHED CSR ([B, S, S]) as flat crows
+        # [B*(S+1)] / cols [B*nnz] (ref creation.py sparse_csr_tensor);
+        # jax BCSR wants them per-batch
+        b, s = int(shape[0]), int(shape[1])
+        crows = crows.reshape(b, s + 1)
+        cols = cols.reshape(b, -1)
+        vals = jnp.asarray(vals).reshape(b, -1, *np.asarray(vals).shape[2:]) \
+            if np.asarray(vals).ndim > 1 else jnp.asarray(vals).reshape(b, -1)
+    bcsr = jsparse.BCSR((jnp.asarray(vals), cols, crows),
+                        shape=tuple(shape))
     return SparseCsrTensor(bcsr)
 
 
@@ -306,11 +321,7 @@ def coalesce(x, name=None):
     return _sp(x).coalesce()
 
 
-# ---- sparse.nn (ref sparse/nn/layer/activation.py) ----
-class nn:
-    class ReLU:
-        def __call__(self, x):
-            return _sp(x).relu()
-
-        def __repr__(self):
-            return "sparse.nn.ReLU()"
+# ---- sparse.nn subpackage (conv3d/subm_conv3d/pooling/attention;
+# ref sparse/nn/) — imported at the bottom to avoid a circular import
+# with paddle_tpu.nn
+from . import nn  # noqa: E402,F401

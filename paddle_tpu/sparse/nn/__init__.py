@@ -1,0 +1,146 @@
+"""paddle.sparse.nn parity — layers over sparse/nn/functional (ref:
+/root/reference/python/paddle/sparse/nn/layer/{conv.py:102,208,
+pooling.py, activation.py})."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional  # noqa: F401
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...nn.initializer import Normal
+
+
+class _SparseConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, nd=3,
+                 bias_attr=None, data_format=None):
+        super().__init__()
+        ks = ((kernel_size,) * nd if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups, self.subm, self.nd = groups, subm, nd
+        w_shape = ks + (in_channels // groups, out_channels)
+        self.weight = self.create_parameter(
+            w_shape, attr=Normal(std=0.02))
+        self.bias = (self.create_parameter((out_channels,), is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        fn = {
+            (3, False): functional.conv3d,
+            (3, True): functional.subm_conv3d,
+            (2, False): functional.conv2d,
+            (2, True): functional.subm_conv2d,
+        }[(self.nd, self.subm)]
+        return fn(x, self.weight, self.bias, self.stride, self.padding,
+                  self.dilation, self.groups)
+
+
+class Conv3D(_SparseConvNd):
+    """ref: sparse/nn/layer/conv.py Conv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False, nd=3,
+                         bias_attr=bias_attr)
+
+
+class SubmConv3D(_SparseConvNd):
+    """ref: sparse/nn/layer/conv.py SubmConv3D (submanifold)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, nd=3,
+                         bias_attr=bias_attr)
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False, nd=2,
+                         bias_attr=bias_attr)
+
+
+class SubmConv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, nd=2,
+                         bias_attr=bias_attr)
+
+
+class MaxPool3D(Layer):
+    """ref: sparse/nn/layer/pooling.py MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self.kernel_size, self.stride,
+                                     self.padding)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+    def __repr__(self):
+        return "sparse.nn.ReLU()"
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        import jax
+        from .. import SparseCooTensor
+        return x._map_values(
+            lambda v: jax.nn.leaky_relu(v, self.negative_slope))
+
+
+class Softmax(Layer):
+    """ref: sparse/nn/layer/activation.py Softmax — softmax over the
+    stored values of each row (CSR) / last dense axis."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from .. import SparseCsrTensor
+        import jax.numpy as jnp
+        import jax
+        if isinstance(x, SparseCsrTensor):
+            bcsr = x._bcsr
+            s = bcsr.shape[-2]
+            crows = np.asarray(bcsr.indptr).reshape(-1, s + 1)
+            data = np.asarray(bcsr.data).reshape(crows.shape[0], -1)
+            out = np.empty_like(data)
+            for b in range(crows.shape[0]):
+                for r in range(s):
+                    lo, hi = crows[b, r], crows[b, r + 1]
+                    seg = data[b, lo:hi]
+                    if hi > lo:
+                        e = np.exp(seg - seg.max())
+                        out[b, lo:hi] = e / e.sum()
+            new = SparseCsrTensor.__new__(SparseCsrTensor)
+            new._bcsr = bcsr.__class__(
+                (jnp.asarray(out.reshape(np.asarray(bcsr.data).shape)),
+                 bcsr.indices, bcsr.indptr), shape=bcsr.shape)
+            return new
+        return x._map_values(lambda v: jax.nn.softmax(v, axis=self.axis))

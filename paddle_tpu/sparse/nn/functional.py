@@ -1,0 +1,222 @@
+"""paddle.sparse.nn.functional parity (ref:
+/root/reference/python/paddle/sparse/nn/functional/{conv.py:199,305,417,
+pooling.py:22, transformer.py:22}).
+
+TPU stance (documented substitution): at the point-cloud densities these
+APIs serve, the TPU MXU has no scatter-gather advantage — the compute is
+executed DENSE (XLA conv / matmul on the MXU) while the sparse format is
+preserved at the API boundary (inputs are SparseCooTensor, outputs are
+re-sparsified with the op's exact site semantics: conv activates every
+site its receptive field reaches, subm keeps the input's site pattern,
+pooling keeps windows containing at least one active site). The CUDA
+reference instead gathers rulebooks (paddle/phi/kernels/sparse/gpu/
+conv_kernel.cu) — a GPU-shaped choice, not a semantic one.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _coo(x):
+    from .. import SparseCooTensor
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"expected SparseCooTensor, got {type(x).__name__}")
+    return x
+
+
+def _dense_tensor(x):
+    """Dense Tensor view of a COO input. When the COO came from a
+    tape-recorded op (chained sparse convs), this returns the RECORDED
+    tensor, so gradients flow through stacked sparse layers."""
+    return _coo(x).to_dense()
+
+
+def _resparsify(dense_t, site_mask):
+    """dense Tensor [N, *spatial, C] + bool site mask [N, *spatial] ->
+    SparseCooTensor with sparse_dim = 1 + len(spatial), dense channel.
+    The sparse wrapper keeps a reference to the recorded dense Tensor so
+    to_dense() stays on the autograd tape (trainable sparse layers)."""
+    from .. import sparse_coo_tensor
+    idx = np.argwhere(np.asarray(site_mask))           # [nnz, 1+spatial]
+    vals = jnp.asarray(np.asarray(dense_t._data)[tuple(idx.T)])  # [nnz, C]
+    out = sparse_coo_tensor(idx.T, vals, shape=tuple(dense_t._data.shape))
+    out._dense_tensor = dense_t
+    return out
+
+
+def _site_mask(x):
+    """Active-site mask [N, *spatial] of a COO input (any channel)."""
+    dense = np.asarray(_dense_tensor(x)._data)
+    return np.any(dense != 0, axis=-1)
+
+
+def _norm3(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
+             subm=False):
+    from ... import ops
+
+    dense_t = _dense_tensor(x)                          # [N, *spatial, C]
+    dense = dense_t._data
+    w = weight if isinstance(weight, Tensor) else Tensor(weight)
+    spec = ("NDHWC", "DHWIO", "NDHWC") if nd == 3 else \
+        ("NHWC", "HWIO", "NHWC")
+    if subm:
+        # submanifold contract: output sites == input sites, so output
+        # SHAPE must equal input shape — force pad=kernel//2, stride=1
+        # like the reference (phi conv_kernel ResetSubmKernelSizeAndStrides)
+        pd_list = [(k // 2, (k - 1) - k // 2) for k in w.shape[:nd]]
+        st = (1,) * nd
+    else:
+        st = _norm3(stride)[:nd]
+        pd = padding
+        if isinstance(pd, int):
+            pd_list = [(pd, pd)] * nd
+        elif isinstance(pd, (list, tuple)) and pd and isinstance(pd[0], int):
+            pd_list = [(p, p) for p in pd]
+        else:
+            pd_list = [tuple(p) for p in pd]
+    dl = _norm3(dilation)[:nd]
+
+    # the dense compute runs through the op registry (recorded on the
+    # tape) so weight/bias — and chained sparse layers — are trainable
+    conv_op = ops.conv3d if nd == 3 else ops.conv2d
+    out_t = conv_op(dense_t, w, bias, stride=list(st), padding=pd_list,
+                    dilation=list(dl), groups=groups,
+                    data_format=spec[0])
+    if subm:
+        # submanifold: the output site pattern IS the input site pattern
+        # (ref: conv.py:305 subm_conv3d / phi sparse subm rulebook)
+        mask = _site_mask(x)
+    else:
+        # standard sparse conv: a site is active when any active input
+        # site falls inside its receptive field
+        act = jnp.asarray(_site_mask(x), dense.dtype)[..., None]
+        ones = jnp.ones(tuple(w._data.shape[:nd]) + (1, 1), dense.dtype)
+        dnm = jax.lax.conv_dimension_numbers(act.shape, ones.shape, spec)
+        reach = jax.lax.conv_general_dilated(
+            act, ones, window_strides=st, padding=pd_list, rhs_dilation=dl,
+            dimension_numbers=dnm)
+        mask = np.asarray(reach[..., 0]) > 0
+    masked_t = out_t * Tensor(jnp.asarray(mask, out_t._data.dtype)[..., None])
+    return _resparsify(masked_t, mask)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """ref: sparse/nn/functional/conv.py:199 — x [N,D,H,W,C] COO,
+    weight [kd,kh,kw,C/groups,M]."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports NDHWC only (ref parity)")
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """ref: sparse/nn/functional/conv.py:305 — submanifold conv: output
+    sites == input sites (no dilation of the active set)."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse subm_conv3d supports NDHWC only")
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    subm=True)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """ref: sparse/nn/functional/conv.py:417."""
+    if data_format != "NHWC":
+        raise ValueError("sparse conv2d supports NHWC only")
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    if data_format != "NHWC":
+        raise ValueError("sparse subm_conv2d supports NHWC only")
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    subm=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """ref: sparse/nn/functional/pooling.py:22 — max over ACTIVE sites in
+    each window; a window with no active site yields an inactive site."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d supports NDHWC only")
+    dense = _dense_tensor(x)._data
+    mask = jnp.asarray(_site_mask(x))
+    ks = _norm3(kernel_size)
+    st = _norm3(stride if stride is not None else kernel_size)
+    pd = padding
+    if isinstance(pd, int):
+        pd = [(pd, pd)] * 3
+    elif isinstance(pd, (list, tuple)) and pd and isinstance(pd[0], int):
+        pd = [(p, p) for p in pd]
+    window = (1,) + ks + (1,)
+    strides = (1,) + st + (1,)
+    pads = [(0, 0)] + list(pd) + [(0, 0)]
+    neg = jnp.asarray(-np.inf, dense.dtype)
+    masked = jnp.where(mask[..., None], dense, neg)
+    out = jax.lax.reduce_window(masked, neg, jax.lax.max, window, strides,
+                                pads)
+    out_mask = jax.lax.reduce_window(
+        mask, False, jax.lax.bitwise_or, window[:-1], strides[:-1],
+        pads[:-1])
+    om = np.asarray(out_mask)
+    out = jnp.where(out_mask[..., None], out, 0).astype(dense.dtype)
+    return _resparsify(Tensor._wrap(out), om)
+
+
+def relu(x, name=None):
+    return _coo(x).relu()
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """ref: sparse/nn/functional/transformer.py:22 — softmax(QK^T/sqrt(d))V
+    with the attention matrix restricted to `sparse_mask`'s CSR layout
+    ([batch*heads, seq, seq]). TPU rendering: the restriction is a mask on
+    the dense MXU matmul — the CSR pattern supplies WHERE attention may
+    flow; scores outside it never contribute."""
+    from .. import SparseCsrTensor
+
+    q = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._data if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    b, h, s, d = q.shape
+    if not isinstance(sparse_mask, SparseCsrTensor):
+        raise TypeError("sparse_mask must be a SparseCsrTensor")
+    bcsr = sparse_mask._bcsr
+    # CSR layout -> dense bool [b*h, s, s] (host-side, layout is static)
+    crows = np.asarray(bcsr.indptr).reshape(b * h, s + 1)
+    cols = np.asarray(bcsr.indices).reshape(b * h, -1)
+    allow = np.zeros((b * h, s, s), bool)
+    for bh in range(b * h):
+        counts = np.diff(crows[bh])
+        rows = np.repeat(np.arange(s), counts)
+        allow[bh, rows, cols[bh][:rows.shape[0]]] = True
+    allow = jnp.asarray(allow.reshape(b, h, s, s))
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+    neg = jnp.asarray(-1e30, scores.dtype)
+    scores = jnp.where(allow, scores, neg)
+    if attn_mask is not None:
+        am = attn_mask._data if isinstance(attn_mask, Tensor) \
+            else jnp.asarray(attn_mask)
+        scores = scores + am.astype(scores.dtype)
+    if key_padding_mask is not None:
+        kp = key_padding_mask._data if isinstance(key_padding_mask, Tensor) \
+            else jnp.asarray(key_padding_mask)
+        scores = scores + kp[:, None, None, :].astype(scores.dtype)
+    any_valid = jnp.max(scores, axis=-1, keepdims=True) > neg / 2
+    p = jax.nn.softmax(scores, axis=-1)
+    p = jnp.where(any_valid, p, 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return Tensor._wrap(out)

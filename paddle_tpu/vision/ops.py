@@ -105,6 +105,19 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
         if prior_box_var is not None:
             out = out / prior_box_var
         return out
+    if code_type == "decode_center_size":
+        # ref: phi/kernels/impl/box_coder.h DecodeCenterSize — deltas
+        # [M, 4] or [N, M, 4] against priors [M, 4]
+        tb = target_box if target_box.ndim == 3 else target_box[None]
+        if prior_box_var is not None:
+            tb = tb * prior_box_var
+        cx = tb[..., 0] * pw + px
+        cy = tb[..., 1] * ph + py
+        w = jnp.exp(tb[..., 2]) * pw
+        h = jnp.exp(tb[..., 3]) * ph
+        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                        axis=-1)
+        return out if target_box.ndim == 3 else out[0]
     raise NotImplementedError(code_type)
 
 

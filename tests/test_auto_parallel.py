@@ -142,6 +142,7 @@ class TestShardLayerOptimizer:
 
 
 class TestHybridGPT:
+    @pytest.mark.slow  # >25s on the 1-core CI box; --runslow tier
     def test_tp_pp_dp_pipeline_training(self):
         import paddle_tpu.distributed as dist
         from paddle_tpu.distributed.topology import (

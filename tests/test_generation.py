@@ -29,6 +29,7 @@ def _naive_greedy(model, ids, n_new):
     return ids
 
 
+@pytest.mark.slow  # >25s on the 1-core CI box; --runslow tier
 def test_greedy_matches_full_context(model):
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, 1024, (2, 7)).astype(np.int32)
@@ -37,6 +38,7 @@ def test_greedy_matches_full_context(model):
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.slow  # >25s on the 1-core CI box; --runslow tier
 def test_eos_freezes_sequences(model):
     rng = np.random.default_rng(1)
     prompt = rng.integers(0, 1024, (1, 5)).astype(np.int32)

@@ -402,6 +402,34 @@ class TestRegistryCoverage:
         "diagflat", "trapezoid", "cumulative_trapezoid", "unfold",
         "repeat_interleave", "nonzero", "increment", "gather_nd",
         "strided_slice", "expand_as", "angle", "conj",
+        # covered by tests/test_ops_oracle_r3.py (round-3 long-tail +
+        # previously-exempt tail; see its case tables)
+        "column_stack", "row_stack", "hstack", "vstack", "dstack",
+        "unflatten", "take", "block_diag", "cartesian_prod",
+        "combinations", "diagonal_scatter", "select_scatter",
+        "slice_scatter", "sinc", "signbit", "isposinf", "isneginf",
+        "isreal", "positive", "negative", "sgn", "float_power", "vander",
+        "gammaln", "gammainc", "gammaincc", "multigammaln",
+        "histogram_bin_edges", "histogramdd", "pdist", "cdist", "polar",
+        "linalg_cond", "matrix_exp", "addbmm", "baddbmm",
+        "cholesky_inverse", "geqrf", "reverse",
+        "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+        "avg_pool1d", "avg_pool3d", "max_pool1d", "max_pool3d",
+        "bucketize", "channel_shuffle", "pixel_shuffle", "pixel_unshuffle",
+        "index_sample", "index_fill", "index_put", "masked_scatter",
+        "local_response_norm", "normalize", "multi_dot", "matrix_norm",
+        "vector_norm", "matrix_rank", "maxout", "triangular_solve",
+        "unique_consecutive", "unique_op", "label_smooth",
+        "square_error_cost", "scale", "crop", "multiplex", "is_empty",
+        "shard_index", "einsum_op", "view", "as_complex", "as_real",
+        "complex", "atleast_1d_op", "atleast_3d_op", "unfold_im2col",
+        "scatter", "scatter_nd", "scatter_nd_add", "eig", "eigh",
+        "eigvals", "eigvalsh", "lstsq", "interpolate", "upsample",
+        "affine_grid", "grid_sample", "alpha_dropout", "dropout2d",
+        "gumbel_softmax", "temporal_shift", "nms", "sequence_mask",
+        "roi_align", "box_coder", "fused_dropout_add",
+        "fused_bias_dropout_residual_layer_norm",
+        "fused_linear_activation", "npair_loss",
     }
 
     def test_coverage_accounting(self):
@@ -425,7 +453,10 @@ class TestRegistryCoverage:
                                           "dist_", "moe_", "pp_xfer",
                                           "ring_", "to_static_"))]
         # Gate: breadth may grow, but the uncovered tail must not.
-        assert len(uncovered) <= 70, (
+        # (r1: 120, r2: 70, r3: 5 — the remainder is runtime-internal scan
+        # bodies (gru/lstm/rnn, exercised via the RNN layer tests) and two
+        # explicit stubs)
+        assert len(uncovered) <= 5, (
             f"{len(uncovered)} registered ops lack conformance coverage; "
             f"add them to a family table or a dedicated module: "
             f"{uncovered}")

@@ -1,0 +1,686 @@
+"""Round-3 oracle conformance: the new long-tail ops (ops/longtail.py,
+sparse/nn) AND the previously conformance-exempt registry tail
+(VERDICT r2 weak #2 — drive exemptions 70 -> <=25).
+
+Torch CPU (or numpy/scipy) is the oracle, same style as
+test_ops_torch_oracle.py; case tables keep it vectorized.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(7)
+
+
+def t(x):
+    return pt.to_tensor(np.asarray(x))
+
+
+def npy(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+A23 = rng.standard_normal((2, 3)).astype(np.float32)
+A46 = rng.standard_normal((4, 6)).astype(np.float32)
+A345 = rng.standard_normal((3, 4, 5)).astype(np.float32)
+V6 = rng.standard_normal(6).astype(np.float32)
+IMG = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)   # NCHW
+IMG3 = rng.standard_normal((1, 3, 4, 6, 6)).astype(np.float32)  # NCDHW
+SEQ = rng.standard_normal((2, 4, 16)).astype(np.float32)     # NCL
+
+
+# ---------------------------------------------------------------------
+# new long-tail ops (ops/longtail.py)
+# ---------------------------------------------------------------------
+LONGTAIL_CASES = [
+    ("tensor_split",
+     lambda: ops.tensor_split(t(A46), 4, axis=1)[0],
+     lambda: torch.tensor_split(torch.tensor(A46), 4, dim=1)[0], 0),
+    ("hsplit", lambda: ops.hsplit(t(A46), 2)[1],
+     lambda: torch.hsplit(torch.tensor(A46), 2)[1], 0),
+    ("vsplit", lambda: ops.vsplit(t(A46), 2)[1],
+     lambda: torch.vsplit(torch.tensor(A46), 2)[1], 0),
+    ("dsplit", lambda: ops.dsplit(t(A345.reshape(3, 4, 5)), [2])[0],
+     lambda: torch.dsplit(torch.tensor(A345), [2])[0], 0),
+    ("column_stack", lambda: ops.column_stack([t(V6), t(V6 * 2)]),
+     lambda: torch.column_stack([torch.tensor(V6),
+                                 torch.tensor(V6 * 2)]), 0),
+    ("row_stack", lambda: ops.row_stack([t(A23), t(A23)]),
+     lambda: torch.vstack([torch.tensor(A23), torch.tensor(A23)]), 0),
+    ("hstack", lambda: ops.hstack([t(A23), t(A23)]),
+     lambda: torch.hstack([torch.tensor(A23), torch.tensor(A23)]), 0),
+    ("vstack", lambda: ops.vstack([t(A23), t(A23)]),
+     lambda: torch.vstack([torch.tensor(A23), torch.tensor(A23)]), 0),
+    ("dstack", lambda: ops.dstack([t(A23), t(A23)]),
+     lambda: torch.dstack([torch.tensor(A23), torch.tensor(A23)]), 0),
+    ("unflatten", lambda: ops.unflatten(t(A46), 1, [2, 3]),
+     lambda: torch.tensor(A46).unflatten(1, (2, 3)), 0),
+    ("take", lambda: ops.take(t(A46), t(np.array([0, 7, 23]))),
+     lambda: torch.take(torch.tensor(A46), torch.tensor([0, 7, 23])), 0),
+    ("block_diag",
+     lambda: ops.block_diag([t(A23), t(np.eye(2, dtype=np.float32))]),
+     lambda: torch.block_diag(torch.tensor(A23),
+                              torch.eye(2)), 0),
+    ("cartesian_prod",
+     lambda: ops.cartesian_prod([t(V6[:2]), t(V6[2:5])]),
+     lambda: torch.cartesian_prod(torch.tensor(V6[:2]),
+                                  torch.tensor(V6[2:5])), 0),
+    ("combinations", lambda: ops.combinations(t(V6), 2),
+     lambda: torch.combinations(torch.tensor(V6), 2), 0),
+    ("combinations_wr",
+     lambda: ops.combinations(t(V6[:3]), 2, with_replacement=True),
+     lambda: torch.combinations(torch.tensor(V6[:3]), 2,
+                                with_replacement=True), 0),
+    ("diagonal_scatter",
+     lambda: ops.diagonal_scatter(t(A46), t(np.ones(4, np.float32))),
+     lambda: torch.diagonal_scatter(torch.tensor(A46),
+                                    torch.ones(4)), 0),
+    ("diagonal_scatter_off",
+     lambda: ops.diagonal_scatter(t(A46), t(np.ones(4, np.float32)),
+                                  offset=2),
+     lambda: torch.diagonal_scatter(torch.tensor(A46), torch.ones(4),
+                                    offset=2), 0),
+    ("select_scatter",
+     lambda: ops.select_scatter(t(A46), t(np.zeros(6, np.float32)), 0, 2),
+     lambda: torch.select_scatter(torch.tensor(A46), torch.zeros(6),
+                                  0, 2), 0),
+    ("slice_scatter",
+     lambda: ops.slice_scatter(t(A46), t(np.zeros((4, 2), np.float32)),
+                               [1], [1], [3], [1]),
+     lambda: torch.slice_scatter(torch.tensor(A46), torch.zeros(4, 2),
+                                 1, 1, 3, 1), 0),
+    ("sinc", lambda: ops.sinc(t(A23)),
+     lambda: torch.sinc(torch.tensor(A23)), 1e-5),
+    ("signbit", lambda: ops.signbit(t(A23)),
+     lambda: torch.signbit(torch.tensor(A23)), 0),
+    ("isposinf",
+     lambda: ops.isposinf(t(np.array([1.0, np.inf, -np.inf]))),
+     lambda: torch.isposinf(torch.tensor([1.0, np.inf, -np.inf])), 0),
+    ("isneginf",
+     lambda: ops.isneginf(t(np.array([1.0, np.inf, -np.inf]))),
+     lambda: torch.isneginf(torch.tensor([1.0, np.inf, -np.inf])), 0),
+    ("positive", lambda: ops.positive(t(A23)),
+     lambda: torch.positive(torch.tensor(A23)), 0),
+    ("negative", lambda: ops.negative(t(A23)),
+     lambda: torch.negative(torch.tensor(A23)), 0),
+    ("sgn_complex",
+     lambda: ops.sgn(t((A23 + 1j * A23).astype(np.complex64))),
+     lambda: torch.sgn(torch.tensor((A23 + 1j * A23).astype(
+         np.complex64))), 1e-5),
+    ("float_power", lambda: ops.float_power(t(np.abs(A23) + 0.1), 2.5),
+     lambda: torch.float_power(torch.tensor(np.abs(A23) + 0.1), 2.5),
+     1e-6),
+    ("vander", lambda: ops.vander(t(V6), 4),
+     lambda: torch.vander(torch.tensor(V6), 4), 1e-4),
+    ("gammaln", lambda: ops.gammaln(t(np.abs(A23) + 0.5)),
+     lambda: torch.lgamma(torch.tensor(np.abs(A23) + 0.5)), 1e-5),
+    ("gammainc", lambda: ops.gammainc(t(np.abs(A23) + 1),
+                                      t(np.abs(A23) + 0.5)),
+     lambda: torch.special.gammainc(torch.tensor(np.abs(A23) + 1),
+                                    torch.tensor(np.abs(A23) + 0.5)),
+     1e-5),
+    ("gammaincc", lambda: ops.gammaincc(t(np.abs(A23) + 1),
+                                        t(np.abs(A23) + 0.5)),
+     lambda: torch.special.gammaincc(torch.tensor(np.abs(A23) + 1),
+                                     torch.tensor(np.abs(A23) + 0.5)),
+     1e-5),
+    ("multigammaln", lambda: ops.multigammaln(t(np.abs(A23) + 3), 2),
+     lambda: torch.special.multigammaln(torch.tensor(np.abs(A23) + 3),
+                                        2), 1e-4),
+    ("histogram_bin_edges",
+     lambda: ops.histogram_bin_edges(t(V6), 4, -2, 2),
+     lambda: np.histogram_bin_edges(V6, 4, range=(-2, 2)), 1e-6),
+    ("pdist", lambda: ops.pdist(t(A46)),
+     lambda: torch.pdist(torch.tensor(A46)), 1e-4),
+    ("pdist_p1", lambda: ops.pdist(t(A46), p=1.0),
+     lambda: torch.pdist(torch.tensor(A46), p=1.0), 1e-4),
+    ("cdist", lambda: ops.cdist(t(A46), t(A46[:3])),
+     lambda: torch.cdist(torch.tensor(A46), torch.tensor(A46[:3])),
+     1e-3),
+    ("cdist_p1", lambda: ops.cdist(t(A46), t(A46[:3]), p=1.0),
+     lambda: torch.cdist(torch.tensor(A46), torch.tensor(A46[:3]),
+                         p=1.0), 1e-4),
+    ("polar", lambda: ops.polar(t(np.abs(A23)), t(A23)),
+     lambda: torch.polar(torch.tensor(np.abs(A23)),
+                         torch.tensor(A23)), 1e-5),
+    ("view_as_complex",
+     lambda: ops.view_as_complex(t(A46.reshape(4, 3, 2))),
+     lambda: torch.view_as_complex(torch.tensor(
+         A46.reshape(4, 3, 2))), 0),
+    ("view_as_real",
+     lambda: ops.view_as_real(t((A23 + 1j * A23).astype(np.complex64))),
+     lambda: torch.view_as_real(torch.tensor(
+         (A23 + 1j * A23).astype(np.complex64))), 0),
+    ("cond_2", lambda: ops.cond(t(A46[:4, :4] + 4 * np.eye(4, dtype=np.float32))),
+     lambda: torch.linalg.cond(torch.tensor(
+         A46[:4, :4] + 4 * np.eye(4, dtype=np.float32))), 1e-3),
+    ("cond_fro",
+     lambda: ops.cond(t(A46[:4, :4] + 4 * np.eye(4, dtype=np.float32)),
+                      p="fro"),
+     lambda: torch.linalg.cond(torch.tensor(
+         A46[:4, :4] + 4 * np.eye(4, dtype=np.float32)), p="fro"), 1e-3),
+    ("matrix_exp", lambda: ops.matrix_exp(t(A46[:3, :3] * 0.3)),
+     lambda: torch.matrix_exp(torch.tensor(A46[:3, :3] * 0.3)), 1e-4),
+    ("addbmm",
+     lambda: ops.addbmm(t(A23), t(A345[:, :2, :]),
+                        t(np.swapaxes(A345, 1, 2)[:, :, :3][:, :, :3]
+                          .copy())[:, :, :3][:, :, :3],
+                        beta=0.5, alpha=2.0),
+     lambda: torch.addbmm(torch.tensor(A23),
+                          torch.tensor(A345[:, :2, :]),
+                          torch.tensor(np.swapaxes(A345, 1, 2)
+                                       [:, :, :3].copy()),
+                          beta=0.5, alpha=2.0), 1e-4),
+    ("baddbmm",
+     lambda: ops.baddbmm(t(np.zeros((3, 2, 3), np.float32)),
+                         t(A345[:, :2, :]),
+                         t(np.swapaxes(A345, 1, 2)[:, :, :3].copy()),
+                         beta=0.0, alpha=1.0),
+     lambda: torch.baddbmm(torch.zeros(3, 2, 3),
+                           torch.tensor(A345[:, :2, :]),
+                           torch.tensor(np.swapaxes(A345, 1, 2)
+                                        [:, :, :3].copy()),
+                           beta=0.0, alpha=1.0), 1e-4),
+    ("reverse", lambda: ops.reverse(t(A345), [0, 2]),
+     lambda: torch.flip(torch.tensor(A345), [0, 2]), 0),
+]
+
+# wrong-shaped lambda above for addbmm second operand; rebuild simply
+B34 = rng.standard_normal((3, 4)).astype(np.float32)
+B_ADD = rng.standard_normal((3, 2, 4)).astype(np.float32)
+C_ADD = rng.standard_normal((3, 4, 3)).astype(np.float32)
+LONGTAIL_CASES = [c for c in LONGTAIL_CASES if c[0] != "addbmm"] + [
+    ("addbmm",
+     lambda: ops.addbmm(t(A23), t(B_ADD), t(C_ADD), beta=0.5, alpha=2.0),
+     lambda: torch.addbmm(torch.tensor(A23), torch.tensor(B_ADD),
+                          torch.tensor(C_ADD), beta=0.5, alpha=2.0),
+     1e-4),
+]
+
+
+# ---------------------------------------------------------------------
+# previously conformance-exempt registry tail
+# ---------------------------------------------------------------------
+IDX23 = np.array([[0, 2], [1, 0]], np.int64)
+TAIL_CASES = [
+    ("adaptive_avg_pool1d", lambda: ops.adaptive_avg_pool1d(t(SEQ), 4),
+     lambda: TF.adaptive_avg_pool1d(torch.tensor(SEQ), 4), 1e-5),
+    ("adaptive_avg_pool2d", lambda: ops.adaptive_avg_pool2d(t(IMG), 3),
+     lambda: TF.adaptive_avg_pool2d(torch.tensor(IMG), 3), 1e-5),
+    ("adaptive_max_pool2d", lambda: ops.adaptive_max_pool2d(t(IMG), 3),
+     lambda: TF.adaptive_max_pool2d(torch.tensor(IMG), 3), 1e-5),
+    ("avg_pool1d", lambda: ops.avg_pool1d(t(SEQ), 4, 2, 0),
+     lambda: TF.avg_pool1d(torch.tensor(SEQ), 4, 2, 0), 1e-5),
+    ("avg_pool3d", lambda: ops.avg_pool3d(t(IMG3), 2, 2, 0),
+     lambda: TF.avg_pool3d(torch.tensor(IMG3), 2, 2, 0), 1e-5),
+    ("max_pool1d", lambda: ops.max_pool1d(t(SEQ), 4, 2, 0),
+     lambda: TF.max_pool1d(torch.tensor(SEQ), 4, 2, 0), 1e-5),
+    ("max_pool3d", lambda: ops.max_pool3d(t(IMG3), 2, 2, 0),
+     lambda: TF.max_pool3d(torch.tensor(IMG3), 2, 2, 0), 1e-5),
+    ("bucketize",
+     lambda: ops.bucketize(t(A23), t(np.sort(V6))),
+     lambda: torch.bucketize(torch.tensor(A23),
+                             torch.tensor(np.sort(V6))), 0),
+    ("channel_shuffle", lambda: ops.channel_shuffle(t(IMG), 2),
+     lambda: TF.channel_shuffle(torch.tensor(IMG), 2), 0),
+    ("pixel_shuffle", lambda: ops.pixel_shuffle(t(IMG), 2),
+     lambda: TF.pixel_shuffle(torch.tensor(IMG), 2), 0),
+    ("pixel_unshuffle", lambda: ops.pixel_unshuffle(t(IMG), 2),
+     lambda: TF.pixel_unshuffle(torch.tensor(IMG), 2), 0),
+    ("index_sample",
+     lambda: ops.index_sample(t(A23), t(IDX23)),
+     lambda: torch.gather(torch.tensor(A23), 1, torch.tensor(IDX23)), 0),
+    ("index_fill",
+     lambda: ops.index_fill(t(A46), t(np.array([0, 2])), 0, -1.0),
+     lambda: torch.tensor(A46).index_fill(
+         0, torch.tensor([0, 2]), -1.0), 0),
+    ("masked_scatter",
+     lambda: ops.masked_scatter(t(A23), t(A23 > 0),
+                                t(np.ones(6, np.float32))),
+     lambda: torch.tensor(A23).masked_scatter(
+         torch.tensor(A23 > 0), torch.ones(6)), 0),
+    ("local_response_norm",
+     lambda: F.local_response_norm(t(IMG), 3, alpha=1e-4, beta=0.75, k=1.0),
+     lambda: TF.local_response_norm(torch.tensor(IMG), 3, alpha=1e-4,
+                                    beta=0.75, k=1.0), 2e-3),
+    ("normalize", lambda: F.normalize(t(A23), p=2, axis=1),
+     lambda: TF.normalize(torch.tensor(A23), p=2, dim=1), 1e-5),
+    ("multi_dot",
+     lambda: ops.multi_dot([t(A23), t(B34), t(C_ADD[0][:, :2].copy())]),
+     lambda: torch.linalg.multi_dot(
+         [torch.tensor(A23), torch.tensor(B34),
+          torch.tensor(C_ADD[0][:, :2].copy())]), 1e-4),
+    ("matrix_norm", lambda: ops.matrix_norm(t(A46), "fro"),
+     lambda: torch.linalg.matrix_norm(torch.tensor(A46), "fro"), 1e-5),
+    ("vector_norm", lambda: ops.vector_norm(t(A46), 3.0),
+     lambda: torch.linalg.vector_norm(torch.tensor(A46), 3.0), 1e-5),
+    ("matrix_rank",
+     lambda: ops.matrix_rank(t(np.outer(V6, V6).astype(np.float32))),
+     lambda: torch.linalg.matrix_rank(torch.tensor(
+         np.outer(V6, V6).astype(np.float32))), 0),
+    ("maxout", lambda: ops.maxout(t(IMG), groups=2),
+     lambda: torch.tensor(IMG).reshape(2, 2, 2, 8, 8).max(2)[0], 0),
+    ("triangular_solve",
+     lambda: ops.triangular_solve(
+         t(np.tril(A46[:4, :4]) + 3 * np.eye(4, dtype=np.float32)),
+         t(A46[:4, :2].copy()), upper=False),
+     lambda: torch.linalg.solve_triangular(
+         torch.tensor(np.tril(A46[:4, :4])
+                      + 3 * np.eye(4, dtype=np.float32)),
+         torch.tensor(A46[:4, :2].copy()), upper=False), 1e-4),
+    ("unique_consecutive",
+     lambda: ops.unique_consecutive(t(np.array([1., 1., 2., 2., 3., 1.]))),
+     lambda: torch.unique_consecutive(
+         torch.tensor([1., 1., 2., 2., 3., 1.])), 0),
+    ("label_smooth",
+     lambda: ops.label_smooth(t(np.eye(3, dtype=np.float32)), epsilon=0.1),
+     lambda: torch.tensor(np.eye(3, dtype=np.float32)) * 0.9 + 0.1 / 3, 1e-6),
+    ("square_error_cost",
+     lambda: ops.square_error_cost(t(A23), t(A23 * 2)),
+     lambda: (torch.tensor(A23) - torch.tensor(A23 * 2)) ** 2, 1e-6),
+    ("scale", lambda: ops.scale(t(A23), 2.0, 1.0),
+     lambda: torch.tensor(A23) * 2.0 + 1.0, 1e-6),
+    ("scale_after",
+     lambda: ops.scale(t(A23), 2.0, 1.0, bias_after_scale=False),
+     lambda: (torch.tensor(A23) + 1.0) * 2.0, 1e-6),
+    ("crop", lambda: ops.crop(t(A46), shape=[2, 3], offsets=[1, 2]),
+     lambda: torch.tensor(A46)[1:3, 2:5], 0),
+    ("multiplex",
+     lambda: ops.multiplex([t(A23), t(A23 * 2)],
+                           t(np.array([[0], [1]], np.int32))),
+     lambda: torch.stack([torch.tensor(A23)[0],
+                          torch.tensor(A23 * 2)[1]]), 1e-6),
+    ("is_empty", lambda: ops.is_empty(t(np.zeros((0, 3), np.float32))),
+     lambda: np.array(True), 0),
+    ("shard_index",
+     lambda: ops.shard_index(t(np.array([[1], [6], [11]], np.int64)),
+                             index_num=12, nshards=2, shard_id=0),
+     lambda: np.array([[1], [-1], [-1]], np.int64), 0),
+    ("einsum_op", lambda: ops.einsum("ij,jk->ik", t(A23), t(B34)),
+     lambda: np.einsum("ij,jk->ik", A23, B34), 1e-5),
+    ("view", lambda: ops.view(t(A46), [2, 12]),
+     lambda: torch.tensor(A46).view(2, 12), 0),
+    ("as_complex", lambda: ops.as_complex(t(A46.reshape(4, 3, 2))),
+     lambda: torch.view_as_complex(torch.tensor(A46.reshape(4, 3, 2))), 0),
+    ("as_real",
+     lambda: ops.as_real(t((A23 + 1j * A23).astype(np.complex64))),
+     lambda: torch.view_as_real(torch.tensor(
+         (A23 + 1j * A23).astype(np.complex64))), 0),
+    ("complex", lambda: ops.complex(t(A23), t(A23 * 2)),
+     lambda: torch.complex(torch.tensor(A23), torch.tensor(A23 * 2)), 0),
+    ("atleast_1d", lambda: ops.atleast_1d(t(np.float32(3.0))),
+     lambda: torch.atleast_1d(torch.tensor(3.0)), 0),
+    ("atleast_3d", lambda: ops.atleast_3d(t(A23)),
+     lambda: torch.atleast_3d(torch.tensor(A23)), 0),
+    ("unfold_im2col", lambda: ops.unfold_im2col(t(IMG), 3, 1, 1, 1),
+     lambda: TF.unfold(torch.tensor(IMG), 3, dilation=1, padding=1,
+                       stride=1), 1e-5),
+    ("tensor_unfold", lambda: ops.unfold(t(V6), 0, 3, 1),
+     lambda: torch.tensor(V6).unfold(0, 3, 1), 0),
+    ("gather_tree_like_scatter",  # scatter overwrite semantics
+     lambda: ops.scatter(t(A46), t(np.array([1, 3])),
+                         t(np.zeros((2, 6), np.float32))),
+     lambda: torch.tensor(A46).index_copy(
+         0, torch.tensor([1, 3]), torch.zeros(2, 6)), 0),
+    ("scatter_nd",
+     lambda: ops.scatter_nd(t(np.array([[1], [3]], np.int64)),
+                            t(np.ones((2, 6), np.float32)), [4, 6]),
+     lambda: torch.zeros(4, 6).index_add(
+         0, torch.tensor([1, 3]), torch.ones(2, 6)), 0),
+    ("scatter_nd_add",
+     lambda: ops.scatter_nd_add(t(A46), t(np.array([[1], [1]], np.int64)),
+                                t(np.ones((2, 6), np.float32))),
+     lambda: torch.tensor(A46).index_add(
+         0, torch.tensor([1, 1]), torch.ones(2, 6)), 1e-6),
+]
+
+
+@pytest.mark.parametrize("name,ours,ref,rtol",
+                         LONGTAIL_CASES + TAIL_CASES,
+                         ids=[c[0] for c in LONGTAIL_CASES + TAIL_CASES])
+def test_matches_oracle(name, ours, ref, rtol):
+    got = npy(ours())
+    want = ref()
+    want = want.numpy() if hasattr(want, "numpy") else np.asarray(want)
+    if rtol == 0:
+        np.testing.assert_array_equal(got, np.asarray(want))
+    else:
+        np.testing.assert_allclose(got, np.asarray(want), rtol=rtol,
+                                   atol=rtol)
+
+
+# ---------------------------------------------------------------------
+# cases that need structure beyond allclose
+# ---------------------------------------------------------------------
+def test_eigh_eigvalsh():
+    S = (A46[:4, :4] + A46[:4, :4].T).astype(np.float32)
+    w, v = ops.eigh(t(S))
+    wr = np.linalg.eigvalsh(S)
+    np.testing.assert_allclose(npy(w), wr, atol=1e-4)
+    np.testing.assert_allclose(npy(ops.eigvalsh(t(S))), wr, atol=1e-4)
+    # eigenvector property: S v = w v
+    np.testing.assert_allclose(S @ npy(v), npy(v) * npy(w)[None, :],
+                               atol=1e-3)
+
+
+def test_eig_eigvals():
+    M = A46[:4, :4]
+    w = npy(ops.eigvals(t(M)))
+    wr = np.linalg.eigvals(M)
+    np.testing.assert_allclose(sorted(w.real), sorted(wr.real), atol=1e-4)
+    w2, v2 = ops.eig(t(M))
+    np.testing.assert_allclose(sorted(npy(w2).real), sorted(wr.real),
+                               atol=1e-4)
+
+
+def test_lstsq():
+    a = A46[:4, :3]
+    b = A46[:4, :2].copy()
+    sol = ops.lstsq(t(a), t(b))
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(npy(sol[0]), ref, atol=1e-4)
+
+
+def test_interpolate_upsample_match_torch():
+    for mode, tm in (("nearest", "nearest"), ("bilinear", "bilinear")):
+        got = npy(F.interpolate(t(IMG), size=[16, 16], mode=mode,
+                                align_corners=False if mode != "nearest"
+                                else None))
+        want = TF.interpolate(torch.tensor(IMG), size=(16, 16), mode=tm,
+                              align_corners=(False if mode != "nearest"
+                                             else None)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    got = npy(ops.upsample(t(IMG), scale_factor=2, mode="nearest"))
+    want = TF.interpolate(torch.tensor(IMG), scale_factor=2,
+                          mode="nearest").numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_grid_sample_affine_grid():
+    theta = np.tile(np.array([[[1., 0., 0.], [0., 1., 0.]]], np.float32),
+                    (2, 1, 1))
+    grid = F.affine_grid(t(theta), [2, 4, 8, 8], align_corners=False)
+    gref = TF.affine_grid(torch.tensor(theta), [2, 4, 8, 8],
+                          align_corners=False)
+    np.testing.assert_allclose(npy(grid), gref.numpy(), atol=1e-5)
+    out = F.grid_sample(t(IMG), grid, align_corners=False)
+    oref = TF.grid_sample(torch.tensor(IMG), gref, align_corners=False)
+    np.testing.assert_allclose(npy(out), oref.numpy(), atol=1e-4)
+
+
+def test_dropout_family_identity_and_structure():
+    # p=0 -> identity for all dropout variants
+    np.testing.assert_array_equal(npy(F.alpha_dropout(t(A23), 0.0)), A23)
+    np.testing.assert_array_equal(npy(F.dropout2d(t(IMG), 0.0)), IMG)
+    # dropout2d zeroes whole channels
+    out = npy(F.dropout2d(t(np.ones_like(IMG)), 0.5, training=True))
+    per_chan = out.reshape(2, 4, -1)
+    is_zero = (per_chan == 0).all(-1)
+    is_kept = (per_chan == 2.0).all(-1)
+    assert np.all(is_zero | is_kept)
+
+
+def test_gumbel_softmax():
+    logits = t(rng.standard_normal((4, 5)).astype(np.float32))
+    soft = npy(F.gumbel_softmax(logits, temperature=1.0))
+    np.testing.assert_allclose(soft.sum(-1), np.ones(4), atol=1e-5)
+    hard = npy(F.gumbel_softmax(logits, temperature=1.0, hard=True))
+    assert np.all(np.isclose(hard, 0.0, atol=1e-6)
+                  | np.isclose(hard, 1.0, atol=1e-6))
+    np.testing.assert_allclose(hard.sum(-1), np.ones(4), atol=1e-5)
+
+
+def test_unique_op_full():
+    x = np.array([3., 1., 2., 1., 3.], np.float32)
+    out = ops.unique(t(x), return_index=True, return_inverse=True,
+                     return_counts=True)
+    ur, ui, uinv, uc = [npy(o) for o in out]
+    ref = np.unique(x, return_index=True, return_inverse=True,
+                    return_counts=True)
+    np.testing.assert_array_equal(ur, ref[0])
+    np.testing.assert_array_equal(uinv.reshape(-1), ref[2])
+    np.testing.assert_array_equal(uc, ref[3])
+
+
+def test_index_put():
+    x = A46.copy()
+    idx = (np.array([0, 2]), np.array([1, 3]))
+    got = npy(ops.index_put(t(x), (t(idx[0]), t(idx[1])),
+                            t(np.array([9., 8.], np.float32))))
+    want = x.copy()
+    want[idx] = [9., 8.]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_temporal_shift():
+    # ref semantics (phi temporal_shift_kernel): batch-major [B*T, C, H, W];
+    # first fold of channels pulls from t+1, second fold from t-1, rest
+    # untouched
+    x = rng.standard_normal((4, 4, 2, 2)).astype(np.float32)  # B=2,T=2
+    got = npy(ops.temporal_shift(t(x), seg_num=2, shift_ratio=0.25))
+    xt = x.reshape(2, 2, 4, 2, 2)                 # [B, T, C, H, W]
+    want = np.zeros_like(xt)
+    want[:, :-1, :1] = xt[:, 1:, :1]              # from the future
+    want[:, 1:, 1:2] = xt[:, :-1, 1:2]            # from the past
+    want[:, :, 2:] = xt[:, :, 2:]
+    np.testing.assert_allclose(got, want.reshape(4, 4, 2, 2), atol=1e-6)
+
+
+def test_nms_oracle():
+    from paddle_tpu.vision.ops import nms
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = npy(nms(t(boxes), iou_threshold=0.5, scores=t(scores)))
+    kept = set(np.asarray(keep).reshape(-1).tolist())
+    assert 0 in kept and 2 in kept and 1 not in kept
+
+
+def test_fused_functional_identity_paths():
+    # dropout=0 renderings against their compositional definitions
+    x, r = A23, A23 * 0.5
+    bias = np.float32(0.1) * np.ones(3, np.float32)
+    from paddle_tpu.incubate.nn.functional import fused_dropout_add
+    got = npy(fused_dropout_add(t(x), t(r), p=0.0))
+    np.testing.assert_allclose(got, x + r, atol=1e-6)
+    from paddle_tpu.incubate.nn.functional import (
+        fused_bias_dropout_residual_layer_norm, fused_linear_activation)
+    got = npy(fused_bias_dropout_residual_layer_norm(
+        t(x), t(r), bias=t(bias), dropout_rate=0.0))
+    ref = TF.layer_norm(torch.tensor(x + bias + r), (3,)).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    got = npy(fused_linear_activation(t(A23), t(B34),
+                                      activation="gelu"))
+    # jax.nn.gelu defaults to the tanh approximation
+    ref = TF.gelu(torch.tensor(A23) @ torch.tensor(B34),
+                  approximate="tanh").numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+# ---------------------------------------------------------------------
+# sparse conv3d / subm / pool / attention (VERDICT r2 missing #3 tail)
+# ---------------------------------------------------------------------
+class TestSparseNN:
+    def _coo_input(self):
+        import paddle_tpu.sparse as sp
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        sites = [(0, 0, 1, 1), (0, 2, 2, 3), (0, 3, 0, 2)]
+        for s in sites:
+            dense[s] = rng.standard_normal(2)
+        idx = np.array(sites).T
+        vals = np.stack([dense[s] for s in sites])
+        return sp.sparse_coo_tensor(idx, vals, shape=dense.shape), dense
+
+    def test_conv3d_matches_dense_at_active_sites(self):
+        import jax
+        import paddle_tpu.sparse as sp
+        x, dense = self._coo_input()
+        w = rng.standard_normal((3, 3, 3, 2, 3)).astype(np.float32)
+        out = sp.nn.functional.conv3d(x, t(w), padding=1)
+        dn = jax.lax.conv_dimension_numbers(
+            dense.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            dense, w, (1, 1, 1), [(1, 1)] * 3, dimension_numbers=dn))
+        od = npy(out.to_dense())
+        mask = np.any(od != 0, -1)
+        np.testing.assert_allclose(od[mask], ref[mask], atol=1e-5)
+
+    def test_subm_conv3d_preserves_site_pattern(self):
+        import paddle_tpu.sparse as sp
+        x, dense = self._coo_input()
+        w = rng.standard_normal((3, 3, 3, 2, 3)).astype(np.float32)
+        out = sp.nn.functional.subm_conv3d(x, t(w), padding=1)
+        out_sites = set(map(tuple, np.argwhere(
+            np.any(npy(out.to_dense()) != 0, -1))))
+        in_sites = set(map(tuple, np.argwhere(np.any(dense != 0, -1))))
+        assert out_sites <= in_sites
+
+    def test_max_pool3d_active_window_semantics(self):
+        import paddle_tpu.sparse as sp
+        x, dense = self._coo_input()
+        out = sp.nn.functional.max_pool3d(x, 2, 2)
+        od = npy(out.to_dense())
+        assert list(od.shape) == [1, 2, 2, 2, 2]
+        # windows with no active input site stay inactive
+        act = np.any(dense != 0, -1)[0]
+        win_act = act.reshape(2, 2, 2, 2, 2, 2).transpose(
+            0, 2, 4, 1, 3, 5).reshape(2, 2, 2, -1).any(-1)
+        np.testing.assert_array_equal(np.any(od[0] != 0, -1), win_act)
+
+    def test_sparse_attention_matches_masked_dense(self):
+        import paddle_tpu.sparse as sp
+        b, h, s, d = 1, 2, 4, 8
+        q, k, v = [rng.standard_normal((b, h, s, d)).astype(np.float32)
+                   for _ in range(3)]
+        cols, crow = [], [0]
+        for r in range(s):
+            cr = [max(0, r - 1), r] if r > 0 else [0]
+            cols += cr
+            crow.append(len(cols))
+        nnz = len(cols)
+        crows_b = np.tile(np.array(crow), (b * h, 1))
+        cols_b = np.tile(np.array(cols), (b * h, 1))
+        sm = sp.sparse_csr_tensor(
+            crows_b.reshape(-1), cols_b.reshape(-1),
+            np.ones((b * h * nnz,), np.float32), shape=(b * h, s, s))
+        out = npy(sp.nn.functional.attention(t(q), t(k), t(v), sm))
+        allow = np.zeros((s, s), bool)
+        for r in range(s):
+            allow[r, max(0, r - 1)] = True
+            allow[r, r] = True
+        sc = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        sc = np.where(allow, sc, -1e30)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_isreal_histogramdd_choleskyinv_geqrf():
+    z = (A23 + 1j * np.where(A23 > 0, A23, 0)).astype(np.complex64)
+    np.testing.assert_array_equal(npy(ops.isreal(t(z))),
+                                  torch.isreal(torch.tensor(z)).numpy())
+    pts = rng.random((20, 2)).astype(np.float32)
+    h = ops.histogramdd(t(pts), bins=4)
+    href = np.histogramdd(pts, bins=4)
+    np.testing.assert_array_equal(npy(h[0]), href[0])
+    np.testing.assert_allclose(npy(h[1]), href[1][0], atol=1e-6)
+    # cholesky_inverse: A^-1 from its factor
+    S = (A46[:3, :3] @ A46[:3, :3].T + 3 * np.eye(3)).astype(np.float32)
+    L = np.linalg.cholesky(S)
+    np.testing.assert_allclose(npy(ops.cholesky_inverse(t(L))),
+                               np.linalg.inv(S), atol=1e-3)
+    # geqrf/orgqr: Q orthonormal and QR == A
+    Amn = A46[:4, :3].copy()
+    a, tau = ops.geqrf(t(Amn))
+    Q = npy(ops.orgqr(a, tau))
+    np.testing.assert_allclose(Q.T @ Q, np.eye(3), atol=1e-4)
+    R = np.triu(npy(a))[:3, :]
+    np.testing.assert_allclose(Q @ R, Amn, atol=1e-4)
+
+
+def test_sequence_mask():
+    from paddle_tpu.nn.functional import sequence_mask
+    got = npy(sequence_mask(t(np.array([1, 3, 2], np.int64)), maxlen=4))
+    want = np.array([[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_roi_align_linear_ramp():
+    # bilinear interpolation reproduces a linear ramp EXACTLY at any
+    # sample point, so 1x1 roi_align of a ramp == ramp value at the box
+    # center — an analytic oracle with no torchvision dependency
+    from paddle_tpu.vision.ops import roi_align
+    ii, jj = np.meshgrid(np.arange(8.), np.arange(8.), indexing="ij")
+    ramp = (2.0 * ii + 3.0 * jj + 1.0).astype(np.float32)
+    x = np.stack([ramp, -ramp])[None]                  # [1, 2, 8, 8]
+    boxes = np.array([[1.0, 1.0, 5.0, 7.0]], np.float32)
+    out = npy(roi_align(t(x), t(boxes),
+                        boxes_num=t(np.array([1], np.int32)),
+                        output_size=1, spatial_scale=1.0,
+                        sampling_ratio=2, aligned=True))
+    cy, cx = (1.0 + 7.0) / 2 - 0.5, (1.0 + 5.0) / 2 - 0.5
+    want = 2.0 * cy + 3.0 * cx + 1.0
+    np.testing.assert_allclose(out.reshape(2), [want, -want], atol=1e-3)
+
+
+def test_box_coder_roundtrip():
+    # decode(encode(gt, prior), prior) == gt (self-consistency oracle,
+    # ref: phi box_coder encode/decode_center_size)
+    from paddle_tpu.vision.ops import box_coder
+    prior = np.array([[0., 0., 10., 10.], [5., 5., 20., 25.]], np.float32)
+    var = np.ones_like(prior)
+    gt = np.array([[1., 1., 8., 9.], [6., 7., 18., 22.]], np.float32)
+    enc = box_coder(t(prior), t(var), t(gt),
+                    code_type="encode_center_size")
+    dec = npy(box_coder(t(prior), t(var), enc,
+                        code_type="decode_center_size"))
+    np.testing.assert_allclose(dec.reshape(2, 4), gt, atol=1e-3)
+
+
+def test_npair_loss_formula():
+    # ref formula (phi npair_loss): CE of anchor-positive similarities
+    # against the diagonal + l2 regularization of both embeddings
+    a = rng.standard_normal((4, 8)).astype(np.float32)
+    p = rng.standard_normal((4, 8)).astype(np.float32)
+    got = float(npy(ops.npair_loss(t(a), t(p), t(np.arange(4)),
+                                   l2_reg=0.002)))
+    sim = torch.tensor(a) @ torch.tensor(p).T
+    ce = TF.cross_entropy(sim, torch.arange(4))
+    l2 = 0.002 * (np.sum(a * a) + np.sum(p * p)) / (2.0 * 4)
+    np.testing.assert_allclose(got, float(ce) + l2, rtol=1e-5)
+
+
+def test_sparse_conv_trainable_and_subm_default_padding():
+    """code-review r3: subm conv must work with ANY user padding (output
+    shape == input shape, ref ResetSubmKernelSizeAndStrides) and sparse
+    conv layers must be trainable (grads reach the weights)."""
+    import paddle_tpu.sparse as sp
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    for s in [(0, 0, 1, 1), (0, 2, 2, 3)]:
+        dense[s] = rng.standard_normal(2)
+    idx = np.array([(0, 0, 1, 1), (0, 2, 2, 3)]).T
+    vals = np.stack([dense[(0, 0, 1, 1)], dense[(0, 2, 2, 3)]])
+    x = sp.sparse_coo_tensor(idx, vals, shape=dense.shape)
+    conv = sp.nn.SubmConv3D(2, 3, 3)        # default padding=0
+    out = conv(x)
+    assert list(out.shape) == [1, 4, 4, 4, 3]
+    conv2 = sp.nn.SubmConv3D(3, 2, 3)
+    loss = (conv2(out).to_dense() ** 2).sum()
+    loss.backward()
+    assert conv.weight.grad is not None      # chained sparse layers train
+    assert conv2.weight.grad is not None
+
+
+def test_geqrf_batched():
+    A = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    a, tau = ops.geqrf(t(A))
+    assert list(a.shape) == [2, 4, 3] and list(tau.shape) == [2, 3]
+    for b in range(2):
+        Q = npy(ops.orgqr(t(npy(a)[b]), t(npy(tau)[b])))
+        np.testing.assert_allclose(Q.T @ Q, np.eye(3), atol=1e-4)

@@ -41,6 +41,7 @@ def test_matches_dense(causal):
                                atol=2e-4)
 
 
+@pytest.mark.slow  # >25s on the 1-core CI box; --runslow tier
 def test_gradients_match_dense(causal=True):
     rng = np.random.default_rng(1)
     b, s, h, d = 1, 32, 2, 8
@@ -91,6 +92,7 @@ def test_tensor_api_and_uneven_raises():
         ring_flash_attention(bad, bad, bad, _mesh())
 
 
+@pytest.mark.slow  # >25s on the 1-core CI box; --runslow tier
 def test_eager_tape_backward():
     # code-review r2: eager Tensor path must record on the tape
     rng = np.random.default_rng(4)

@@ -7,6 +7,9 @@ import paddle_tpu as pt
 from paddle_tpu import ops
 from paddle_tpu.vision import models
 
+# 12 model families x XLA compiles: slow tier (run with --runslow)
+pytestmark = pytest.mark.slow
+
 
 def _x(size=64, b=2):
     rng = np.random.default_rng(0)
