@@ -47,6 +47,7 @@ from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import inference  # noqa: F401
+from . import onnx  # noqa: F401  (documented exclusion: raises w/ guidance)
 from . import utils  # noqa: F401
 from .framework_io import save, load  # noqa: F401
 from .tensor_array import (  # noqa: F401

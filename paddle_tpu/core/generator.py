@@ -38,7 +38,11 @@ class Generator:
             off = self._offset
             self._offset += 1
             if self._root is None:
-                self._root = jax.random.PRNGKey(self._seed)
+                # concrete even when first touched inside a jit trace —
+                # a lazily-created root must never be a tracer (it would
+                # escape the trace and poison later eager calls)
+                with jax.ensure_compile_time_eval():
+                    self._root = jax.random.PRNGKey(self._seed)
             root = self._root  # bind under the lock: a concurrent
             # manual_seed/set_state may null the attribute
         return jax.random.fold_in(root, off)
@@ -48,7 +52,13 @@ class Generator:
 
     def set_state(self, state):
         with self._lock:
-            self._seed, self._offset = state
+            seed, offset = state
+            # normalize to python ints: callers pass (seed, offset)
+            # tuples OR raw PRNGKey arrays (RNGStatesTracker); array-
+            # typed state would turn `_offset += 1` into a TRACER under
+            # any jitted dispatch and poison later eager calls
+            self._seed = int(seed)
+            self._offset = int(offset)
             self._root = None
         return self
 

@@ -81,7 +81,7 @@ def _child_env(args, global_rank: int, local_rank: int,
         "FLAGS_selected_devices": str(local_rank),
         # shared HMAC key authenticating RPC frames (rpc._rpc_token);
         # same value for every rank of this job
-        "PADDLE_RPC_TOKEN": _job_rpc_token(),
+        "PADDLE_RPC_TOKEN": _job_rpc_token(args),
     })
     return env
 
@@ -89,12 +89,21 @@ def _child_env(args, global_rank: int, local_rank: int,
 _RPC_TOKEN_CACHE = None
 
 
-def _job_rpc_token() -> str:
+def _job_rpc_token(args=None) -> str:
     global _RPC_TOKEN_CACHE
     if _RPC_TOKEN_CACHE is None:
-        import secrets
-        _RPC_TOKEN_CACHE = os.environ.get("PADDLE_RPC_TOKEN") \
-            or secrets.token_hex(16)
+        tok = os.environ.get("PADDLE_RPC_TOKEN")
+        if not tok and args is not None and args.nnodes > 1:
+            # multi-node: every node's launcher must derive the SAME key
+            # without a side channel — hash the rendezvous endpoint.
+            # Export PADDLE_RPC_TOKEN on all nodes for real isolation.
+            import hashlib
+            tok = hashlib.sha256(
+                f"paddle-tpu-job:{args.master}".encode()).hexdigest()[:32]
+        if not tok:
+            import secrets
+            tok = secrets.token_hex(16)
+        _RPC_TOKEN_CACHE = tok
     return _RPC_TOKEN_CACHE
 
 
@@ -108,14 +117,11 @@ def launch(argv: Optional[List[str]] = None) -> int:
         print("--max_restarts must be >= 0", file=sys.stderr)
         return 2
     if args.max_restarts > 0 and args.nnodes > 1:
-        # per-node restarting cannot coordinate a collective epoch:
-        # surviving nodes hang in collectives and the fixed master
-        # port may sit in TIME_WAIT — an external elastic controller
-        # (k8s operator / GKE jobset) must restart multi-node jobs
-        print("--max_restarts only supports single-node jobs; "
-              "multi-node elastic needs an external controller",
-              file=sys.stderr)
-        return 2
+        # coordinated whole-job restart over the elastic rendezvous:
+        # membership epochs agreed by every node's launcher, a fresh
+        # coordinator port per epoch (ref: fleet/elastic/manager.py:126
+        # ElasticManager's etcd membership + rescale/restart)
+        return _launch_elastic(args)
     rc = 0
     for attempt in range(args.max_restarts + 1):
         rc = _launch_once(args, attempt)
@@ -128,9 +134,174 @@ def launch(argv: Optional[List[str]] = None) -> int:
     return rc
 
 
-def _launch_once(args, restart_count: int) -> int:
+# ---------------------------------------------------------------------------
+# multi-node elastic rendezvous (ElasticManager analog). Node 0's
+# launcher runs a tiny coordination service on the --master port (HMAC-
+# framed, same transport as distributed.rpc); each node's launcher joins
+# an EPOCH, receives that epoch's job coordinator endpoint (base_port +
+# 1 + epoch — a fresh port per epoch so jax.distributed never fights
+# TIME_WAIT), spawns its local ranks, and reports their fate. ANY node's
+# failure flips the epoch to `failed`; every launcher then kills its
+# local ranks and rejoins at epoch+1 — a coordinated whole-job restart.
+# ---------------------------------------------------------------------------
+
+def _elastic_call(endpoint: str, kind: str, body, timeout=120.0,
+                  retries=60):
+    from ..rpc import _send_msg, _recv_msg
+    ip, port = endpoint.rsplit(":", 1)
+    last = None
+    for _ in range(retries):
+        try:
+            with socket.create_connection((ip, int(port)),
+                                          timeout=timeout) as s:
+                _send_msg(s, (kind, body))
+                status, payload = _recv_msg(s)
+                if status != "ok":
+                    raise RuntimeError(f"elastic master error: {payload}")
+                return payload
+        except (ConnectionError, OSError) as e:
+            last = e
+            time.sleep(0.5)
+    raise ConnectionError(
+        f"cannot reach elastic master at {endpoint}: {last}")
+
+
+def _start_elastic_master(ip: str, port: int, nnodes: int):
+    import socketserver
+    import threading
+    from ..rpc import _send_msg, _recv_msg
+
+    class _Srv(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    epochs: dict = {}  # epoch -> {"joined": set, "rcs": {node: rc}}
+
+    def data(epoch):
+        return epochs.setdefault(epoch, {"joined": set(), "rcs": {}})
+
+    class _Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                kind, body = _recv_msg(self.request)
+            except ConnectionError:
+                return
+            if kind == "join":
+                node, epoch = body
+                with cond:
+                    data(epoch)["joined"].add(node)
+                    cond.notify_all()
+                    while len(data(epoch)["joined"]) < nnodes:
+                        cond.wait(timeout=1.0)
+                _send_msg(self.request, ("ok", epoch))
+            elif kind == "report":
+                node, epoch, rc = body
+                with cond:
+                    data(epoch)["rcs"][node] = rc
+                    cond.notify_all()
+                _send_msg(self.request, ("ok", None))
+            elif kind == "status":
+                epoch = body
+                with lock:
+                    rcs = dict(data(epoch)["rcs"])
+                failed = any(rc != 0 for rc in rcs.values())
+                done = len(rcs) == nnodes and not failed
+                _send_msg(self.request,
+                          ("ok", {"failed": failed, "done": done}))
+            elif kind == "bye":
+                node, epoch = body
+                with cond:
+                    data(epoch).setdefault("byes", set()).add(node)
+                    cond.notify_all()
+                _send_msg(self.request, ("ok", None))
+            else:
+                _send_msg(self.request, ("ok", None))
+
+    srv = _Srv((ip, port), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    srv._elastic_epochs = epochs
+    srv._elastic_lock = lock
+    return srv
+
+
+def _wait_for_byes(master_srv, epoch, nnodes, timeout=20.0):
+    """Node 0 lingers until every peer has observed the final verdict
+    (or a grace timeout), so shutting the rendezvous down can't race a
+    peer's last status poll."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with master_srv._elastic_lock:
+            byes = master_srv._elastic_epochs.get(epoch, {}).get(
+                "byes", set())
+            if len(byes) >= nnodes - 1:
+                return
+        time.sleep(0.2)
+
+
+def _launch_elastic(args) -> int:
+    ip, port_s = args.master.rsplit(":", 1)
+    base_port = int(port_s)
+    master_srv = None
+    if args.node_rank == 0:
+        master_srv = _start_elastic_master(ip, base_port, args.nnodes)
+    try:
+        rc = 1
+        for epoch in range(args.max_restarts + 1):
+            _elastic_call(args.master, "join", (args.node_rank, epoch))
+            job_master = f"{ip}:{base_port + 1 + epoch}"
+            rc = _launch_once(args, epoch, master_override=job_master,
+                              elastic=(args.master, args.node_rank, epoch))
+            _elastic_call(args.master, "report",
+                          (args.node_rank, epoch, rc))
+            # wait for the epoch's verdict: every node reported OK, or
+            # someone failed. A dead peer LAUNCHER (machine loss before
+            # it could report) would otherwise hang this loop forever —
+            # bound it and treat expiry as a failure.
+            verdict_deadline = time.time() + float(os.environ.get(
+                "PADDLE_ELASTIC_VERDICT_TIMEOUT", "900"))
+            while True:
+                if time.time() > verdict_deadline:
+                    print(f"paddle_tpu.launch: node {args.node_rank}: "
+                          f"epoch {epoch} verdict timed out (a peer "
+                          "launcher died without reporting)",
+                          file=sys.stderr, flush=True)
+                    return 1
+                st = _elastic_call(args.master, "status", epoch)
+                if st["done"]:
+                    if args.node_rank != 0:
+                        # tell node 0 we saw the verdict so it can take
+                        # the rendezvous down without racing us
+                        try:
+                            _elastic_call(args.master, "bye",
+                                          (args.node_rank, epoch),
+                                          retries=1)
+                        except ConnectionError:
+                            pass
+                    else:
+                        _wait_for_byes(master_srv, epoch, args.nnodes)
+                    return 0
+                if st["failed"]:
+                    break
+                time.sleep(0.3)
+            if epoch < args.max_restarts:
+                print(f"paddle_tpu.launch: node {args.node_rank}: epoch "
+                      f"{epoch} failed; coordinated restart "
+                      f"{epoch + 1}/{args.max_restarts}",
+                      file=sys.stderr, flush=True)
+        return rc if rc != 0 else 1
+    finally:
+        if master_srv is not None:
+            master_srv.shutdown()
+            master_srv.server_close()
+
+
+def _launch_once(args, restart_count: int, master_override: str = None,
+                 elastic=None) -> int:
     world = args.nnodes * args.nproc_per_node
-    master = args.master
+    master = master_override or args.master
     if master is None:
         # fresh coordinator port per attempt: the previous epoch's
         # jax.distributed service may still own the old one
@@ -172,6 +343,8 @@ def _launch_once(args, restart_count: int) -> int:
 
     old_term = signal.signal(signal.SIGTERM, _reap)
     old_int = signal.signal(signal.SIGINT, _reap)
+    last_elastic_poll = time.time()
+    poll_errs = 0
     try:
         while procs:
             alive = []
@@ -186,6 +359,25 @@ def _launch_once(args, restart_count: int) -> int:
             else:
                 procs = alive
                 if procs:
+                    if elastic is not None and \
+                            time.time() - last_elastic_poll > 0.5:
+                        # a peer NODE may have failed: kill this node's
+                        # healthy ranks so the whole job restarts as one
+                        last_elastic_poll = time.time()
+                        ep_master, _node, epoch = elastic
+                        try:
+                            st = _elastic_call(ep_master, "status", epoch,
+                                               retries=2)
+                            poll_errs = 0
+                        except ConnectionError:
+                            # transient blips must not burn a restart
+                            # epoch — only consecutive failures mean the
+                            # rendezvous is gone
+                            poll_errs += 1
+                            st = {"failed": poll_errs >= 3}
+                        if st.get("failed"):
+                            rc = -15
+                            break
                     time.sleep(0.2)
                 continue
             break

@@ -148,7 +148,14 @@ def max_in_flight(order: List[Unit], num_stages: int) -> List[int]:
 
 class ScheduleExecutor:
     """Runs a unit order against a PipelineLayer, cutting autograd at
-    part boundaries so each B unit touches only its part's params."""
+    part boundaries so each B unit touches only its part's params.
+
+    Stage activations may be arbitrary PYTREES of Tensors — a
+    transformer stage threading (hidden, attention_mask, position_ids)
+    tuples works under every schedule; the cut detaches each Tensor
+    leaf, and the B unit back-propagates into every inexact leaf that
+    received a cotangent (ref: the reference's p2p layer negotiating
+    tuple activations, pp_utils/p2p_communication.py:87-157)."""
 
     def __init__(self, pipe, loss_fn, scaler=None):
         self._pipe = pipe
@@ -157,15 +164,26 @@ class ScheduleExecutor:
         self._cotangent = {}
         self.executed: List[Tuple[str, int, int]] = []  # (kind, part, m)
 
+    @staticmethod
+    def _is_leaf(v):
+        from ...core.tensor import Tensor
+        return isinstance(v, Tensor)
+
+    def _tree_leaves(self, tree):
+        import jax
+        return jax.tree_util.tree_flatten(tree, is_leaf=self._is_leaf)
+
     def run(self, order: List[Unit], micro_inputs, micro_labels,
             forward_only=False):
+        import jax
+        import jax.numpy as jnp
         from ...core.tensor import Tensor
         from ...autograd.tape import run_backward
 
         pipe = self._pipe
         n_parts = pipe.num_parts
         n = len(micro_inputs)
-        # saved[(part, m)] = (input_leaf, output)
+        # saved[(part, m)] = (input_tree, output_tree)
         saved = {}
         total = None
         for u in order:
@@ -179,20 +197,31 @@ class ScheduleExecutor:
                         # no B unit will pop it — release now, or eval
                         # holds every micro-batch at every part
                         del saved[key]
-                    x = pipe.transfer_to_part(prev_out, u.part)
-                if not isinstance(x, Tensor):
-                    raise TypeError(
-                        "scheduled pipeline needs single-Tensor "
-                        f"stage activations, got {type(x)}")
+                    x = jax.tree_util.tree_map(
+                        lambda t: pipe.transfer_to_part(t, u.part)
+                        if isinstance(t, Tensor) else t,
+                        prev_out, is_leaf=self._is_leaf)
                 if not forward_only:
-                    x = x.detach()
-                    x.stop_gradient = False
+                    def cut(t):
+                        if not isinstance(t, Tensor):
+                            return t
+                        d = t.detach()
+                        if jnp.issubdtype(d._data.dtype, jnp.inexact):
+                            d.stop_gradient = False
+                        return d
+                    x = jax.tree_util.tree_map(cut, x,
+                                               is_leaf=self._is_leaf)
                 out = pipe.forward_part(x, u.part)
                 if u.part == n_parts - 1:
                     loss = out
                     if self._loss_fn is not None and \
                             micro_labels[u.micro] is not None:
                         loss = self._loss_fn(out, micro_labels[u.micro])
+                    if not isinstance(loss, Tensor):
+                        raise RuntimeError(
+                            "the last pipeline stage must produce a "
+                            "Tensor loss (set loss_fn on the "
+                            "PipelineLayer for pytree outputs)")
                     loss = loss / n
                     if self._scaler is not None:
                         out = self._scaler.scale(loss)
@@ -214,12 +243,29 @@ class ScheduleExecutor:
                             "(set loss_fn on the PipelineLayer)")
                     run_backward([out], [None])
                 else:
-                    g = self._cotangent.pop((u.part, u.micro))
-                    run_backward([out], [g])
+                    # flat cotangent list aligned with the downstream
+                    # part's input leaves == this part's output leaves
+                    # (None pytree entries vanish on flatten, so the
+                    # cotangents travel as an explicit flat list)
+                    g_leaves = self._cotangent.pop((u.part, u.micro))
+                    out_leaves, _ = self._tree_leaves(out)
+                    pairs = [(o, g) for o, g in zip(out_leaves, g_leaves)
+                             if isinstance(o, Tensor) and g is not None
+                             and not o.stop_gradient]
+                    if pairs:
+                        run_backward([o for o, _ in pairs],
+                                     [g for _, g in pairs])
                 if u.part > 0:
-                    ct = x.grad
-                    x._grad = None
-                    ct = pipe.transfer_cotangent(ct, u.part - 1)
-                    self._cotangent[(u.part - 1, u.micro)] = ct
+                    def pop_grad(t):
+                        if not isinstance(t, Tensor):
+                            return None
+                        ct = t._grad
+                        t._grad = None
+                        if ct is None:
+                            return None
+                        return pipe.transfer_cotangent(ct, u.part - 1)
+                    x_leaves, _ = self._tree_leaves(x)
+                    self._cotangent[(u.part - 1, u.micro)] = [
+                        pop_grad(t) for t in x_leaves]
                 self.executed.append(("B", u.part, u.micro))
         return total

@@ -86,7 +86,7 @@ def send_uv(x, y, src_index, dst_index, compute_op="add"):
                      y[dst_index.astype(jnp.int32)], compute_op)
 
 
-@register_op("segment_pool")
+@register_op("segment_pool", cacheable=False)  # eager/traced row counts
 def segment_pool(x, segment_ids, pool_type="sum", out_size=None):
     """ref: phi/kernels/gpu/segment_pool_kernel.cu (paddle.incubate
     .segment_* family). segment_ids must be sorted ascending. Eager use

@@ -187,9 +187,10 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             if full_graph:
                 from .dy2static import ast_transform
                 fwd = ast_transform(fwd) or fwd
-            sf = StaticFunction(fwd, layer=fn,
-                                input_spec=input_spec,
-                                full_graph=full_graph)
+                sf = StaticFunction(fwd, layer=fn, input_spec=input_spec,
+                                    full_graph=True)
+            else:
+                sf = GraphBreakFunction(fwd, layer=fn)
             fn.forward = sf
             return fn
         layer = getattr(fn, "__self__", None)
@@ -199,12 +200,49 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             # tensor-predicate if/while stage into lax.cond/while_loop
             from .dy2static import ast_transform
             fn = ast_transform(fn) or fn
-        return StaticFunction(fn, layer=layer, input_spec=input_spec,
-                              full_graph=full_graph)
+            return StaticFunction(fn, layer=layer, input_spec=input_spec,
+                                  full_graph=True)
+        return GraphBreakFunction(fn, layer=layer)
 
     if function is not None:
         return decorate(function)
     return decorate
+
+
+class GraphBreakFunction:
+    """full_graph=False: SOT-style partial compilation (ref:
+    python/paddle/jit/sot/translate.py:31). The function body is split
+    into maximal stageable regions — each compiled+cached as one traced
+    op — with the unsupported statements (data-dependent if/while,
+    loops, return-in-branch) executing eagerly between them, under
+    ordinary Python semantics. `region_count` / `staged_calls` expose
+    the break structure for tests and debugging."""
+
+    def __init__(self, function, layer: Optional[Layer] = None):
+        from .dy2static import graph_break_transform
+        self._layer = layer
+        r = graph_break_transform(function)
+        if r is None:
+            # no source or nothing to stage: plain eager execution (ops
+            # still dispatch through the registry one by one)
+            self._fn, self._regions = function, []
+        else:
+            self._fn, self._regions = r
+        functools.update_wrapper(self, function)
+
+    @property
+    def region_count(self):
+        return len(self._regions)
+
+    @property
+    def regions(self):
+        return list(self._regions)
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is not None and getattr(
+                self._fn, "__self__", None) is None:
+            return self._fn(self._layer, *args, **kwargs)
+        return self._fn(*args, **kwargs)
 
 
 def not_to_static(fn):
@@ -448,7 +486,7 @@ def save(layer, path, input_spec=None, **config):
     import numpy as np
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    if isinstance(layer, StaticFunction):
+    if isinstance(layer, (StaticFunction, GraphBreakFunction)):
         layer = layer._layer
     state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
     with open(path + ".pdiparams", "wb") as f:

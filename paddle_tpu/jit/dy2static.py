@@ -263,8 +263,11 @@ def _assigned_names(body):
 
 
 def _escapes_control_flow(body):
-    """True if the statements contain a `return`, or a `break`/`continue`
-    bound to an ENCLOSING loop (i.e. not inside a nested loop here)."""
+    """True if the statements contain a `return`, a `global`/`nonlocal`
+    declaration (rewriting the assignment into a branch-function local
+    would silently drop the outer binding — ADVICE r2), or a
+    `break`/`continue` bound to an ENCLOSING loop (i.e. not inside a
+    nested loop here)."""
     found = False
 
     def walk(node, in_loop):
@@ -272,7 +275,7 @@ def _escapes_control_flow(body):
         if found or isinstance(node, _SKIP_SCOPES):
             return
         if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom,
-                             ast.Await)):
+                             ast.Await, ast.Global, ast.Nonlocal)):
             found = True
             return
         if isinstance(node, (ast.Break, ast.Continue)) and not in_loop:
@@ -417,3 +420,370 @@ def ast_transform(fn: Callable) -> Optional[Callable]:
     new_fn.__kwdefaults__ = fn.__kwdefaults__
     new_fn.__wrapped_dy2static__ = fn
     return new_fn
+
+
+# ======================= SOT-style graph-break fallback =======================
+# full_graph=False contract (ref: the reference's SOT bytecode translator,
+# /root/reference/python/paddle/jit/sot/translate.py:31 and
+# sot/opcode_translator/executor/opcode_executor.py:1457): instead of
+# erroring on unsupported control flow, compile the MAXIMAL supported
+# regions and run the unsupported statements eagerly between them. The
+# TPU rendering splits at the AST level: maximal runs of simple
+# statements become staged region ops (traced+cached per signature, tape-
+# recorded so grads flow); compound statements (data-dependent if/while,
+# loops, try, returns) execute eagerly — where Tensor predicates are
+# concrete and ordinary Python semantics (return-in-branch etc.) apply.
+
+_SIMPLE_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr)
+
+
+def _reads_before_store(stmts):
+    """Names loaded before being stored within `stmts` (region inputs)."""
+    stored: set = set()
+    reads: list = []
+
+    def walk(node):
+        if isinstance(node, _SKIP_SCOPES):
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                if node.id not in stored and node.id not in reads:
+                    reads.append(node.id)
+            else:
+                stored.add(node.id)
+            return
+        # rhs before lhs for assignments
+        if isinstance(node, ast.Assign):
+            walk(node.value)
+            for t in node.targets:
+                walk(t)
+            return
+        if isinstance(node, (ast.AugAssign,)):
+            # aug reads AND stores the target
+            walk(node.value)
+            tgt = node.target
+            if isinstance(tgt, ast.Name):
+                if tgt.id not in stored and tgt.id not in reads:
+                    reads.append(tgt.id)
+                stored.add(tgt.id)
+            else:
+                walk(tgt)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                walk(node.value)
+            walk(node.target)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for s in stmts:
+        walk(s)
+    return reads
+
+
+class _BoundParams:
+    """Opaque holder for Layer param/buffer Tensor objects: NOT a pytree
+    of Tensors, so dispatch leaves it intact (hashable by identity for
+    the executable-cache key; one instance per (region, layer set))."""
+
+    __slots__ = ("ptensors", "btensors")
+
+    def __init__(self, ptensors, btensors):
+        self.ptensors = tuple(ptensors)
+        self.btensors = tuple(btensors)
+
+
+class StagedRegion:
+    """One compiled region of a graph-broken function.
+
+    Wraps the extracted region function: on call it probes stageability
+    once per input signature (jax.eval_shape); stageable regions dispatch
+    through the op registry as ONE traced op (whole-region XLA graph,
+    tape-recorded vjp + per-signature executable cache — the OpDef is
+    built once per region so the cache can key on its identity; Layer
+    params found among the inputs are functionalized so they train); a
+    region whose helpers branch on tensor VALUES degrades to eager
+    statement-by-statement execution, exactly like a SOT graph break
+    inside a call."""
+
+    def __init__(self, raw_fn, name):
+        self.raw_fn = raw_fn
+        self.name = name
+        self._probed: dict = {}
+        self._opdef = None
+        self._bound_cache: dict = {}  # layer-ids -> _BoundParams
+        # (statics, spec) of the region's output per input signature —
+        # needed on executable-cache hits, when the trace (and its
+        # side-channel) does not re-run. A region whose outputs include
+        # non-array statics is marked uncacheable: a cached executable
+        # could not refresh them.
+        self._out_meta: dict = {}
+        self.staged_calls = 0
+        self.eager_calls = 0
+
+    def _signature(self, vals):
+        sig = []
+        for v in vals:
+            from ..core.tensor import Tensor
+            if isinstance(v, Tensor):
+                sig.append(("T", tuple(v._data.shape), str(v._data.dtype)))
+            else:
+                sig.append(("S", type(v).__name__))
+        return tuple(sig)
+
+    def _get_opdef(self):
+        from . import _functional_params
+        from ..core.generator import rng_scope
+        from ..core.tensor import Tensor
+        from ..ops.registry import OpDef
+        from ..autograd import tape
+
+        if self._opdef is not None:
+            return self._opdef
+        region = self
+
+        def raw(seed, params, buffers, bound, inputs, sig):
+            # `bound` is an opaque (non-pytree) holder of the Layer
+            # param/buffer Tensor OBJECTS — dispatch must not unwrap
+            # them; the traced param ARRAYS arrive via params/buffers
+            def run():
+                with rng_scope(seed):
+                    with tape.no_grad():
+                        return region.raw_fn(*inputs)
+            if bound.ptensors or bound.btensors:
+                with _functional_params(
+                        list(bound.ptensors) + list(bound.btensors),
+                        list(params) + list(buffers)):
+                    out = run()
+            else:
+                out = run()
+            # only array-like outputs ride through the traced op; python
+            # statics (ints, strings, configs) side-channel around it
+            arrs, statics, spec = _flatten_vars(out)
+            region._out_meta[sig] = (statics, spec)
+            return tuple(arrs)
+
+        self._opdef = OpDef(self.name, raw)
+        return self._opdef
+
+    def __call__(self, *vals):
+        import jax
+
+        from . import _collect_params
+        from ..core.generator import next_key
+        from ..core.tensor import Tensor
+        from ..nn.layer import Layer
+        from ..ops.registry import dispatch
+
+        layers = [v for v in vals if isinstance(v, Layer)]
+        lkey = tuple(id(L) for L in layers)
+        bound = self._bound_cache.get(lkey)
+        if bound is None:
+            ptensors, btensors = [], []
+            for L in layers:
+                _, pt_, _, bt_ = _collect_params(L)
+                ptensors += pt_
+                btensors += bt_
+            bound = _BoundParams(ptensors, btensors)
+            self._bound_cache[lkey] = bound
+        ptensors, btensors = bound.ptensors, bound.btensors
+
+        opdef = self._get_opdef()
+        sig = self._signature(vals)
+        stageable = self._probed.get(sig)
+        seed = next_key()
+        if stageable is None:
+            # non-array inputs (Layer self, python configs) ride the probe
+            # as closure statics — eval_shape only abstracts the arrays
+            arr_pos = [i for i, v in enumerate(vals)
+                       if isinstance(v, (Tensor, jax.Array))]
+            base = [v._data if isinstance(v, Tensor) else v for v in vals]
+
+            def probe(s, p, b, arr_vals):
+                iv = list(base)
+                for pos, a in zip(arr_pos, arr_vals):
+                    iv[pos] = a
+                return opdef.fn(s, p, b, bound, iv, sig)
+
+            try:
+                jax.eval_shape(
+                    probe, seed,
+                    [p._data for p in ptensors],
+                    [b._data for b in btensors],
+                    [base[i] for i in arr_pos])
+                stageable = True
+                if any(k == "s" for k in self._out_meta[sig][1]):
+                    # non-array outputs cannot refresh through a cached
+                    # executable — stage, but never cache this region
+                    opdef.cacheable = False
+            except Exception:
+                # any abstract-eval failure (tracer bool/int conversion,
+                # .numpy() on a tracer, host round-trips...) = graph break
+                # inside a helper call. Falling back to eager is safe: a
+                # genuine bug reproduces there with a clearer traceback.
+                stageable = False
+            self._probed[sig] = stageable
+        if not stageable:
+            self.eager_calls += 1
+            return self.raw_fn(*vals)
+        self.staged_calls += 1
+        out = dispatch(opdef, (seed, list(ptensors), list(btensors),
+                               bound, list(vals), sig), {})
+        flat = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))[0]
+        # rebuild the region's (tensor..., static...) output order from
+        # the per-signature meta (valid on executable-cache hits too)
+        statics, spec = self._out_meta[sig]
+        rebuilt, ia, istat = [], 0, 0
+        for kind in spec:
+            if kind in ("t", "a"):
+                rebuilt.append(flat[ia])
+                ia += 1
+            else:
+                rebuilt.append(statics[istat])
+                istat += 1
+        return tuple(rebuilt)
+
+
+def graph_break_transform(fn: Callable):
+    """Split fn's top-level body into staged regions + eager statements.
+    Returns (rewritten_fn, [StagedRegion, ...]) or None when the source
+    is unavailable / nothing is worth staging."""
+    if inspect.ismethod(fn):
+        r = graph_break_transform(fn.__func__)
+        if r is None:
+            return None
+        new_fn, regions = r
+        return new_fn.__get__(fn.__self__), regions
+    if hasattr(fn, "__wrapped__"):
+        return None
+    if "__class__" in fn.__code__.co_freevars:
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return None
+    fdef.decorator_list = []
+
+    arg_names = [a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                                 + fdef.args.kwonlyargs)]
+    if fdef.args.vararg:
+        arg_names.append(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        arg_names.append(fdef.args.kwarg.arg)
+
+    def _stageable_stmt(stmt):
+        """A region statement must bind only plain Names: mutations of
+        attributes/subscripts (self.cache = ..., x[i] = ...) executed
+        under the region's jit trace would store TRACERS into live
+        objects — they run eagerly instead. Non-docstring bare Exprs
+        (e.g. list.append(tensor)) can mutate state the same way."""
+        if not isinstance(stmt, _SIMPLE_STMTS):
+            return False
+        if any(isinstance(n, (ast.Yield, ast.YieldFrom, ast.Await,
+                              ast.NamedExpr, ast.Lambda, ast.ListComp,
+                              ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp))
+               for n in ast.walk(stmt)):
+            # comprehensions/lambdas open scopes _reads_before_store does
+            # not analyze — their free variables would be missed as
+            # region inputs; run such statements eagerly instead
+            return False
+        if isinstance(stmt, ast.Expr):
+            return isinstance(stmt.value, ast.Constant)  # docstring only
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        for t in targets:
+            for n in ast.walk(t):
+                # ast.walk also yields ctx markers (Store/Load)
+                if isinstance(n, (ast.Name, ast.Tuple, ast.List,
+                                  ast.Starred, ast.Store, ast.Load)):
+                    continue
+                return False  # Attribute / Subscript target
+        return True
+
+    # group maximal runs of simple statements
+    groups = []  # (is_region, [stmts])
+    cur: list = []
+    for stmt in fdef.body:
+        simple = _stageable_stmt(stmt)
+        if simple:
+            cur.append(stmt)
+        else:
+            if cur:
+                groups.append((True, cur))
+                cur = []
+            groups.append((False, [stmt]))
+    if cur:
+        groups.append((True, cur))
+    n_regions = sum(1 for is_r, _ in groups if is_r)
+    if n_regions == 0:
+        return None
+
+    bound_so_far = set(arg_names)
+    new_body = []
+    region_defs = []
+    k = 0
+    for is_region, stmts in groups:
+        if not is_region:
+            new_body.extend(stmts)
+            bound_so_far |= set(_assigned_names(stmts))
+            continue
+        reads = [n for n in _reads_before_store(stmts) if n in bound_so_far]
+        outs = _assigned_names(stmts)
+        rname = f"__jsr_fn_{k}"
+        region_defs.append(_fndef(rname, reads, stmts, tail_return=outs))
+        call = f"__jsr_staged_{k}({', '.join(reads)})"
+        if outs:
+            new_body.append(_stmt(f"({', '.join(outs)},) = {call}"))
+        else:
+            new_body.append(_stmt(call))
+        bound_so_far |= set(outs)
+        k += 1
+
+    # region defs hoist to module level: StagedRegion wraps the compiled
+    # object once, not a fresh local per call
+    fdef.body = new_body
+    tree.body = region_defs + [fdef]
+    ast.fix_missing_locations(tree)
+
+    glb = dict(fn.__globals__)
+    import sys
+    glb["_jst"] = sys.modules[__name__]
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                return None
+    loc: dict = {}
+    try:
+        code = compile(tree, filename=f"<graph_break {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, glb, loc)
+    except Exception:
+        return None
+    regions = []
+    for i in range(k):
+        raw = loc.get(f"__jsr_fn_{i}")
+        if raw is None:
+            return None
+        staged = StagedRegion(raw, f"sot_region_{fn.__name__}_{i}")
+        glb[f"__jsr_staged_{i}"] = staged
+        regions.append(staged)
+    new_fn = loc.get(fdef.name)
+    if new_fn is None:
+        return None
+    # region defs were exec'd with `glb` as globals; the rewritten fn also
+    # needs __jsr_staged_* visible — both live in glb, and exec(code, glb,
+    # loc) gives module-level defs access to glb at call time only if they
+    # were compiled with glb as their __globals__; they were (exec globals)
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__graph_break_regions__ = regions
+    return new_fn, regions

@@ -1,0 +1,29 @@
+"""paddle.onnx surface (ref: /root/reference/python/paddle/onnx/export.py,
+which delegates to the external paddle2onnx converter).
+
+DESIGN STANCE — documented exclusion, not an omission: on TPU the
+portable interchange format is StableHLO, not ONNX. `paddle_tpu.jit.save`
+already exports any traced function/Layer as StableHLO bytecode that
+reloads WITHOUT the Python class (tests/test_inference_export.py), and
+`paddle_tpu.inference.Predictor` serves it — that pair covers the
+export/serve capability paddle.onnx.export + onnxruntime provide in the
+reference. An ONNX writer would re-encode the same jaxpr into a second
+IR that no TPU runtime consumes natively; teams that need ONNX for
+third-party CPU/GPU serving can convert the StableHLO artifact with the
+openly available onnx-mlir / IREE toolchains.
+
+`export` exists so reference code paths fail LOUDLY with guidance
+instead of AttributeError.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ref API: paddle.onnx.export(layer, path, input_spec, ...)."""
+    raise NotImplementedError(
+        "paddle_tpu does not emit ONNX: StableHLO is the TPU-native "
+        "interchange. Use paddle_tpu.jit.save(layer, path, input_spec) "
+        "to export a portable StableHLO artifact (reloadable without "
+        "the Python class, servable via paddle_tpu.inference.Predictor)"
+        "; convert that artifact with onnx-mlir/IREE if a third-party "
+        "runtime requires ONNX specifically.")

@@ -35,9 +35,11 @@ OPS: Dict[str, "OpDef"] = {}
 
 
 class OpDef:
-    __slots__ = ("name", "fn", "sig", "amp_policy", "n_grad_exempt", "tags")
+    __slots__ = ("name", "fn", "sig", "amp_policy", "n_grad_exempt",
+                 "tags", "cacheable")
 
-    def __init__(self, name, fn, amp_policy=None, tags=()):
+    def __init__(self, name, fn, amp_policy=None, tags=(),
+                 cacheable=True):
         self.name = name
         self.fn = fn
         self.sig = inspect.signature(fn)
@@ -45,6 +47,10 @@ class OpDef:
         # 'black' (force fp32), 'keep' (never cast)
         self.amp_policy = amp_policy
         self.tags = tags
+        # executable-cache opt-out: ops whose EAGER semantics depend on
+        # input concreteness (data-dependent output row counts) and
+        # dynamically-generated region ops set this False
+        self.cacheable = cacheable
 
 
 def _is_tensor(x):
@@ -53,6 +59,146 @@ def _is_tensor(x):
 
 def _diffable(t: Tensor) -> bool:
     return (not t.stop_gradient) and jnp.issubdtype(t._data.dtype, jnp.inexact)
+
+
+# ---------------------------------------------------------------------------
+# per-(op, shapes, dtypes, statics) executable cache
+#
+# Eager per-op dispatch-to-XLA has brutal latency without it — the
+# reference built PHI exactly because of this cost
+# (/root/reference/paddle/phi/README.md §1.2.1); SURVEY §7.3 hard-part 1.
+# The cached entry holds jitted executables:
+#   fwd:  the op's forward over its array leaves
+#   bwd:  cotangent contraction re-derived from the primals inside jit —
+#         XLA DCEs whatever part of the recomputed forward the backward
+#         doesn't need, so matmul-class bwd costs exactly its two matmuls
+# Keyed on (op, argument treedef, leaf avals, static-leaf fingerprint,
+# diff positions). Falls back to the uncached path for unhashable
+# statics and inside outer traces (TrainStep/jit — XLA already owns the
+# whole graph there).
+# ---------------------------------------------------------------------------
+_EXEC_CACHE: Dict = {}
+_EXEC_CACHE_MAX = 4096
+_UNCACHEABLE = object()  # ops that consume RNG during their trace: a
+# jitted executable would bake the key (same dropout mask forever) and
+# fwd/bwd would trace with DIFFERENT keys — permanently excluded
+
+
+def _rng_stamp():
+    from ..core import generator as G
+    if G._scope_stack:
+        sc = G._scope_stack[-1]
+        return ("scope", sc, sc.counter)
+    return ("gen", G._default_generator.get_state())
+
+
+def _rng_restore(stamp):
+    """Rewind RNG state to a stamp: when a cacheability probe consumed
+    keys and got discarded, the eager fallback must draw from the SAME
+    offsets — seeded runs stay bit-identical to the uncached path."""
+    from ..core import generator as G
+    kind = stamp[0]
+    if kind == "scope":
+        stamp[1].counter = stamp[2]
+    else:
+        G._default_generator.set_state(stamp[1])
+
+
+class _ExecEntry:
+    __slots__ = ("fwd", "bwd", "out_tree", "bwd_ok", "_run_raw", "_opdef")
+
+    def __init__(self, fwd, bwd, opdef):
+        self.fwd = fwd
+        self.bwd = bwd
+        self.out_tree = None
+        # flips False when the jitted bwd can't express this op's
+        # gradient (e.g. an eager concrete-predicate while-loop becomes
+        # a non-differentiable lax.while_loop under the bwd trace) —
+        # grads then re-derive eagerly from concrete primals
+        self.bwd_ok = True
+        self._run_raw = None
+        self._opdef = opdef  # pins id(opdef) for the cache key's lifetime
+
+
+def _static_fingerprint(v):
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        if isinstance(v, (list, tuple)):
+            return tuple(_static_fingerprint(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, _static_fingerprint(x))
+                                for k, x in v.items()))
+        return None  # unhashable: caller skips the cache
+
+
+def _cache_key(opdef, treedef, leaves, tensor_pos, diff_pos):
+    if not getattr(opdef, "cacheable", True):
+        return None
+    # identity of the OpDef, not just its name: dynamically-created defs
+    # (StagedRegion) may share names; the cached entry holds the opdef
+    # strongly so the id cannot be recycled while the entry lives
+    parts = [id(opdef), opdef.name, treedef, tuple(diff_pos)]
+    for i, leaf in enumerate(leaves):
+        if i in tensor_pos:
+            d = leaf._data if _is_tensor(leaf) else leaf
+            parts.append((tuple(d.shape), str(d.dtype)))
+        else:
+            fp = _static_fingerprint(leaf)
+            if fp is None and leaf is not None:
+                return None
+            parts.append(("s", fp))
+    key = tuple(parts)
+    try:
+        hash(key)  # full tuple as the dict key: no collision hazard
+    except TypeError:
+        return None
+    return key
+
+
+def _get_exec_entry(opdef, treedef, leaves, tensor_pos, diff_pos,
+                    const_vals):
+    key = _cache_key(opdef, treedef, leaves, tensor_pos, diff_pos)
+    if key is None:
+        return None, None
+    entry = _EXEC_CACHE.get(key)
+    if entry is _UNCACHEABLE:
+        return None, None
+    if entry is not None:
+        return entry, key
+    fn = opdef.fn
+    arr_pos = list(tensor_pos)
+    statics = [None if i in set(arr_pos) else v
+               for i, v in enumerate(const_vals)]
+    diff_set = set(diff_pos)
+    nondiff_arr_pos = [i for i in arr_pos if i not in diff_set]
+
+    def run(diff_arrs, nondiff_arrs):
+        vals = list(statics)
+        for p, a in zip(diff_pos, diff_arrs):
+            vals[p] = a
+        for p, a in zip(nondiff_arr_pos, nondiff_arrs):
+            vals[p] = a
+        out = fn(**jax.tree_util.tree_unflatten(treedef, vals))
+        flat, out_tree = jax.tree_util.tree_flatten(out)
+        run._out_tree = out_tree
+        return tuple(flat)
+
+    def bwd(diff_arrs, nondiff_arrs, cots):
+        _, vjp_fn = jax.vjp(lambda *d: run(d, nondiff_arrs), *diff_arrs)
+        return vjp_fn(tuple(cots))
+
+    entry = _ExecEntry(jax.jit(run), jax.jit(bwd), opdef)
+    entry._run_raw = run  # out_tree side channel fires during trace
+    if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+        # flush executables but KEEP the uncacheable blacklist: wiping
+        # it would re-probe RNG ops (double-draw) after every flush
+        for k in [k for k, v in _EXEC_CACHE.items()
+                  if v is not _UNCACHEABLE]:
+            del _EXEC_CACHE[k]
+    _EXEC_CACHE[key] = entry
+    return entry, key
 
 
 def dispatch(opdef: OpDef, args, kwargs):
@@ -66,36 +212,134 @@ def dispatch(opdef: OpDef, args, kwargs):
 
     leaves, treedef = jax.tree_util.tree_flatten(
         arguments, is_leaf=_is_tensor)
-    tensor_pos = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    tensor_pos = [i for i, l in enumerate(leaves)
+                  if _is_tensor(l) or isinstance(l, jax.Array)]
     record = tape.is_grad_enabled() and any(
-        _diffable(leaves[i]) for i in tensor_pos)
+        _is_tensor(leaves[i]) and _diffable(leaves[i])
+        for i in tensor_pos)
 
     fn = opdef.fn
+    const_vals = list(leaves)
+    for i in tensor_pos:
+        if _is_tensor(leaves[i]):
+            const_vals[i] = leaves[i]._data
+    in_trace = any(isinstance(const_vals[i], jax.core.Tracer)
+                   for i in tensor_pos)
+    # committed multi-device inputs (NamedSharding etc.) bypass the
+    # cache: a plain jitted executable would not preserve the explicit
+    # output shardings distributed ops establish (reshard, mpu layers)
+    if not in_trace:
+        for i in tensor_pos:
+            sh = getattr(const_vals[i], "sharding", None)
+            if sh is not None and type(sh).__name__ != \
+                    "SingleDeviceSharding":
+                in_trace = True  # reuse the no-cache path
+                break
 
     if not record:
-        vals = list(leaves)
-        for i in tensor_pos:
-            vals[i] = leaves[i]._data
+        if not in_trace:
+            entry, key = _get_exec_entry(opdef, treedef, leaves,
+                                         tensor_pos, [], const_vals)
+            if entry is not None:
+                arrs = [const_vals[i] for i in tensor_pos]
+                first = entry.out_tree is None
+                stamp = _rng_stamp() if first else None
+                try:
+                    flat_out = entry.fwd([], arrs)
+                except Exception:
+                    if not first:
+                        raise
+                    # not jittable (dynamic output shapes, host sync...)
+                    _EXEC_CACHE[key] = _UNCACHEABLE
+                    entry = None
+                if first and entry is not None:
+                    if _rng_stamp() != stamp:
+                        # op consumed RNG during its trace: the key is
+                        # baked into the executable — never cache it.
+                        # Rewind the stream so the eager fallback draws
+                        # the same keys a cache-free run would.
+                        _EXEC_CACHE[key] = _UNCACHEABLE
+                        _rng_restore(stamp)
+                        entry = None
+                    else:
+                        entry.out_tree = entry._run_raw._out_tree
+                if entry is not None:
+                    out = jax.tree_util.tree_unflatten(entry.out_tree,
+                                                       list(flat_out))
+                    return _wrap_outputs(opdef, out, node=None)
+        vals = list(const_vals)
         out = fn(**jax.tree_util.tree_unflatten(treedef, vals))
         return _wrap_outputs(opdef, out, node=None)
 
-    diff_pos = [i for i in tensor_pos if _diffable(leaves[i])]
-    const_vals = list(leaves)
-    for i in tensor_pos:
-        const_vals[i] = leaves[i]._data
+    diff_pos = [i for i in tensor_pos
+                if _is_tensor(leaves[i]) and _diffable(leaves[i])]
 
-    def g(*diff_arrs):
-        vals = list(const_vals)
-        for p, a in zip(diff_pos, diff_arrs):
-            vals[p] = a
-        out = fn(**jax.tree_util.tree_unflatten(treedef, vals))
-        flat, out_tree = jax.tree_util.tree_flatten(out)
-        g._out_tree = out_tree
-        return tuple(flat)
+    entry = key = None
+    if not in_trace:
+        entry, key = _get_exec_entry(opdef, treedef, leaves, tensor_pos,
+                                     diff_pos, const_vals)
+    if entry is not None:
+        diff_set = set(diff_pos)
+        nondiff_arr_pos = [i for i in tensor_pos if i not in diff_set]
+        primals = tuple(const_vals[i] for i in diff_pos)
+        nondiff_arrs = [const_vals[i] for i in nondiff_arr_pos]
+        first = entry.out_tree is None
+        stamp = _rng_stamp() if first else None
+        try:
+            flat_out = entry.fwd(primals, nondiff_arrs)
+        except Exception:
+            if not first:
+                raise
+            _EXEC_CACHE[key] = _UNCACHEABLE  # not jittable
+            entry = None
+        if first and entry is not None:
+            if _rng_stamp() != stamp:
+                # RNG consumed: baked key AND fwd/bwd would trace with
+                # different keys (wrong dropout grads) — blacklist,
+                # rewind the stream, and recompute through the
+                # single-trace vjp path below
+                _EXEC_CACHE[key] = _UNCACHEABLE
+                _rng_restore(stamp)
+                entry = None
+            else:
+                entry.out_tree = entry._run_raw._out_tree
+    if entry is not None:
+        out_tree = entry.out_tree
 
-    primals = tuple(const_vals[i] for i in diff_pos)
-    flat_out, vjp_fn = jax.vjp(g, *primals)
-    out_tree = g._out_tree
+        def vjp_fn(cots, _e=entry, _p=primals, _nd=nondiff_arrs):
+            if _e.bwd_ok and not any(
+                    getattr(c, "dtype", None) == jax.dtypes.float0
+                    for c in cots):
+                try:
+                    return _e.bwd(_p, _nd, tuple(cots))
+                except Exception:
+                    _e.bwd_ok = False
+            # eager re-derivation from the concrete primals: handles
+            # float0 cotangents and ops whose gradient only exists on
+            # the concrete path (python-loop while, host callbacks)
+            _, vf = jax.vjp(lambda *d: _e._run_raw(d, _nd), *_p)
+            return vf(tuple(cots))
+
+        def g(*diff_arrs):
+            vals = list(const_vals)
+            for p, a in zip(diff_pos, diff_arrs):
+                vals[p] = a
+            o = fn(**jax.tree_util.tree_unflatten(treedef, vals))
+            flat, _ = jax.tree_util.tree_flatten(o)
+            return tuple(flat)
+    else:
+        def g(*diff_arrs):
+            vals = list(const_vals)
+            for p, a in zip(diff_pos, diff_arrs):
+                vals[p] = a
+            out = fn(**jax.tree_util.tree_unflatten(treedef, vals))
+            flat, out_tree = jax.tree_util.tree_flatten(out)
+            g._out_tree = out_tree
+            return tuple(flat)
+
+        primals = tuple(const_vals[i] for i in diff_pos)
+        flat_out, vjp_fn = jax.vjp(g, *primals)
+        out_tree = g._out_tree
 
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in flat_out]
     # replay info (g + forward-time primals) enables create_graph=True:
@@ -138,17 +382,20 @@ def _check_nan_inf(op_name, arr):
             f"NaN or Inf detected in output of op `{op_name}`")
 
 
-def register_op(name: str = None, amp_policy: str = None, tags=()):
+def register_op(name: str = None, amp_policy: str = None, tags=(),
+                cacheable=True):
     """Register a pure-jnp forward as a framework op.
 
     The decorated function must be pure (jnp in, jnp out); Tensor arguments
     arrive unwrapped as jax arrays. The returned wrapper is the public eager
     API and accepts Tensors, arrays, and python scalars.
-    """
+    cacheable=False opts out of the per-signature executable cache (for
+    ops whose eager semantics depend on input concreteness)."""
 
     def deco(fn: Callable):
         op_name = name or fn.__name__
-        opdef = OpDef(op_name, fn, amp_policy=amp_policy, tags=tags)
+        opdef = OpDef(op_name, fn, amp_policy=amp_policy, tags=tags,
+                      cacheable=cacheable)
         OPS[op_name] = opdef
 
         @functools.wraps(fn)

@@ -1,0 +1,28 @@
+"""Payload for the multi-node elastic restart test: at epoch 0, rank 1
+(the second NODE's worker) crashes mid-job; at epoch 1 every rank
+finishes. Rank 0 sleeps long enough that only a COORDINATED kill (the
+elastic rendezvous noticing the peer node's failure) can end its epoch-0
+run — proving whole-job restart, not per-node retry."""
+import os
+import sys
+import time
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    epoch = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    print(f"ELASTIC_START rank={rank} epoch={epoch}", flush=True)
+    if epoch == 0:
+        if rank == 1:
+            time.sleep(0.5)
+            print(f"ELASTIC_CRASH rank={rank} epoch={epoch}", flush=True)
+            sys.exit(7)
+        # healthy rank: block far longer than the test timeout — only
+        # the launcher's coordinated kill can end this epoch
+        time.sleep(300)
+        sys.exit(0)
+    print(f"ELASTIC_OK rank={rank} epoch={epoch}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

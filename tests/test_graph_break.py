@@ -1,0 +1,128 @@
+"""SOT-style graph-break fallback for @to_static(full_graph=False)
+(VERDICT r2 missing #4; ref: python/paddle/jit/sot/translate.py:31 —
+compile supported subgraphs, run the rest eagerly under guards)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+from paddle_tpu.jit import to_static, GraphBreakFunction
+
+
+@to_static(full_graph=False)
+def fn_return_in_branch(x):
+    y = ops.sin(x) * 2.0          # region 0 (staged)
+    z = y + 1.0
+    if float(z.sum().numpy()) > 0:  # eager break (return-in-branch)
+        return z * 10.0
+    w = ops.tanh(z)               # region 1 (staged)
+    w = w - 3.0
+    return w
+
+
+@to_static(full_graph=False)
+def fn_tensor_predicate(x):
+    s = (x * x).sum()             # region 0
+    if s > 3.0:                   # eager tensor-bool per call
+        out = s * 2.0
+    else:
+        out = s - 1.0
+    return out
+
+
+class TestGraphBreak:
+    def test_return_in_branch_runs_correctly(self):
+        x = pt.to_tensor(np.ones((4,), np.float32))
+        out = fn_return_in_branch(x)
+        ref = (np.sin(np.ones(4)) * 2 + 1) * 10
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+        # other branch
+        x2 = pt.to_tensor(-2 * np.ones((4,), np.float32))
+        z2 = np.sin(-2 * np.ones(4)) * 2 + 1
+        assert z2.sum() <= 0
+        np.testing.assert_allclose(fn_return_in_branch(x2).numpy(),
+                                   np.tanh(z2) - 3, atol=1e-5)
+
+    def test_staged_region_count(self):
+        assert isinstance(fn_return_in_branch, GraphBreakFunction)
+        # two simple-statement runs around the eager `if`
+        assert fn_return_in_branch.region_count == 2
+        x = pt.to_tensor(np.ones((4,), np.float32))
+        fn_return_in_branch(x)
+        r0, r1 = fn_return_in_branch.regions
+        assert r0.staged_calls > 0           # region 0 always runs
+        fn_return_in_branch(pt.to_tensor(-2 * np.ones((4,), np.float32)))
+        assert r1.staged_calls > 0           # region 1 via the else path
+
+    def test_tensor_predicate_branches_per_call(self):
+        small = fn_tensor_predicate(pt.to_tensor(np.ones(2, np.float32)))
+        big = fn_tensor_predicate(pt.to_tensor(np.ones(8, np.float32)))
+        np.testing.assert_allclose(float(small.numpy()), 1.0, atol=1e-5)
+        np.testing.assert_allclose(float(big.numpy()), 16.0, atol=1e-5)
+        assert fn_tensor_predicate.region_count >= 1
+
+    def test_gradients_flow_through_staged_regions(self):
+        x = pt.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+        out = fn_return_in_branch(x)
+        out.sum().backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   10 * 2 * np.cos(np.ones(4)), atol=1e-4)
+
+    def test_layer_params_train_through_regions(self):
+        class M(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = pt.nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.lin(x)          # region (self.lin params train)
+                h = h * 2.0
+                if float(h.sum().numpy()) > 1e9:  # eager break
+                    return h
+                out = ops.tanh(h)        # region
+                return out
+
+        m = M()
+        sf = to_static(m.forward, full_graph=False)
+        assert sf.region_count == 2
+        x = pt.to_tensor(np.random.RandomState(0).randn(2, 4).astype(
+            np.float32))
+        out = sf(x)
+        (out ** 2).sum().backward()
+        assert m.lin.weight.grad is not None
+
+    def test_break_inside_helper_degrades_to_eager(self):
+        def helper(z):
+            # data-dependent python branch INSIDE a call — not stageable
+            if float(z.sum().numpy()) > 0:
+                return z * 2.0
+            return z * 3.0
+
+        def f(x):
+            y = x + 1.0
+            w = helper(y)       # breaks the region's trace probe
+            return w
+
+        sf = to_static(f, full_graph=False)
+        x = pt.to_tensor(np.ones((3,), np.float32))
+        out = sf(x)
+        np.testing.assert_allclose(out.numpy(), 4.0 * np.ones(3),
+                                   atol=1e-6)
+        # the probe detected the break and fell back to eager execution
+        assert all(r.staged_calls == 0 for r in sf.regions) or \
+            any(r.eager_calls > 0 for r in sf.regions)
+
+    def test_loops_execute_eagerly(self):
+        @to_static(full_graph=False)
+        def f(x, n):
+            acc = x * 0.0                 # region
+            for _ in range(n):            # eager python loop
+                acc = acc + x
+            out = acc * 2.0               # region
+            return out
+
+        x = pt.to_tensor(np.ones((3,), np.float32))
+        np.testing.assert_allclose(f(x, 3).numpy(), 6 * np.ones(3),
+                                   atol=1e-6)
+        assert f.region_count == 2

@@ -82,3 +82,50 @@ class TestBootstrapEnv:
         monkeypatch.delenv("PADDLE_MASTER", raising=False)
         monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
         assert parallel._maybe_init_jax_distributed() is False
+
+
+class TestMultiNodeElastic:
+    """Coordinated whole-job restart across nodes (VERDICT r2 missing #5;
+    ref: fleet/elastic/manager.py:126 ElasticManager). Two node-launchers
+    share one elastic rendezvous on localhost; killing one node's worker
+    must restart BOTH nodes' workers at epoch 1."""
+
+    def test_two_node_coordinated_restart(self, tmp_path):
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        master = f"127.0.0.1:{port}"
+        payload = os.path.join(REPO, "tests", "elastic_payload.py")
+        env = _scrubbed_env()
+
+        def node(rank, log_dir):
+            return subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--backend", "cpu", "--nnodes", "2",
+                 "--node_rank", str(rank), "--nproc_per_node", "1",
+                 "--master", master, "--max_restarts", "1",
+                 "--log_dir", log_dir, payload],
+                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        d0, d1 = str(tmp_path / "n0"), str(tmp_path / "n1")
+        p0 = node(0, d0)
+        p1 = node(1, d1)
+        out0, _ = p0.communicate(timeout=180)
+        out1, _ = p1.communicate(timeout=180)
+        logs = ""
+        for d, rank in ((d0, 0), (d1, 1)):
+            with open(os.path.join(d, f"workerlog.{rank}")) as f:
+                logs += f.read()
+        assert p0.returncode == 0, (out0, out1, logs)
+        assert p1.returncode == 0, (out0, out1, logs)
+        # epoch 0: both ranks started, rank 1 crashed
+        assert "ELASTIC_START rank=0 epoch=0" in logs
+        assert "ELASTIC_CRASH rank=1 epoch=0" in logs
+        # the COORDINATED restart: rank 0's healthy 300s sleeper was
+        # killed and BOTH ranks completed epoch 1
+        assert "ELASTIC_OK rank=0 epoch=1" in logs
+        assert "ELASTIC_OK rank=1 epoch=1" in logs
+        # launcher announced the coordinated restart
+        assert "coordinated restart" in out0 + out1
