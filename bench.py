@@ -272,7 +272,7 @@ def bench_dispatch(on_tpu):
     import paddle_tpu as pt
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.optimizer import SGD
-    from paddle_tpu.ops.registry import _EXEC_CACHE
+    from paddle_tpu.ops.registry import exec_cache_size
 
     dev = jax.devices()[0]
     lin1 = pt.nn.Linear(256, 256)
@@ -327,7 +327,7 @@ def bench_dispatch(on_tpu):
         "extra": {
             "trainstep_steps_per_sec": round(steps / dt_train, 1),
             "eager_over_trainstep_time": round(dt_eager / dt_train, 2),
-            "exec_cache_entries": len(_EXEC_CACHE),
+            "exec_cache_entries": exec_cache_size(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "steps": steps,
         },

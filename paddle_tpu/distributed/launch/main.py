@@ -190,10 +190,18 @@ def _start_elastic_master(ip: str, port: int, nnodes: int):
                 return
             if kind == "join":
                 node, epoch = body
+                deadline = time.time() + float(os.environ.get(
+                    "PADDLE_ELASTIC_JOIN_TIMEOUT", "300"))
                 with cond:
                     data(epoch)["joined"].add(node)
                     cond.notify_all()
                     while len(data(epoch)["joined"]) < nnodes:
+                        if time.time() > deadline:
+                            _send_msg(self.request,
+                                      ("err", "join timeout: a peer "
+                                       "launcher never joined epoch "
+                                       f"{epoch}"))
+                            return
                         cond.wait(timeout=1.0)
                 _send_msg(self.request, ("ok", epoch))
             elif kind == "report":
@@ -250,12 +258,25 @@ def _launch_elastic(args) -> int:
     try:
         rc = 1
         for epoch in range(args.max_restarts + 1):
-            _elastic_call(args.master, "join", (args.node_rank, epoch))
+            try:
+                _elastic_call(args.master, "join", (args.node_rank, epoch))
+            except (ConnectionError, RuntimeError) as e:
+                # rendezvous dead or a peer never joined: fail THIS node
+                # cleanly instead of hanging or dying with a traceback
+                print(f"paddle_tpu.launch: node {args.node_rank}: "
+                      f"elastic join failed ({e})", file=sys.stderr,
+                      flush=True)
+                return rc if rc != 0 else 1
             job_master = f"{ip}:{base_port + 1 + epoch}"
             rc = _launch_once(args, epoch, master_override=job_master,
                               elastic=(args.master, args.node_rank, epoch))
-            _elastic_call(args.master, "report",
-                          (args.node_rank, epoch, rc))
+            try:
+                _elastic_call(args.master, "report",
+                              (args.node_rank, epoch, rc))
+            except ConnectionError:
+                # master gone (it may have exited on the final verdict
+                # before our report): surface the local rc
+                return rc if rc != 0 else 1
             # wait for the epoch's verdict: every node reported OK, or
             # someone failed. A dead peer LAUNCHER (machine loss before
             # it could report) would otherwise hang this loop forever —
@@ -269,7 +290,10 @@ def _launch_elastic(args) -> int:
                           "launcher died without reporting)",
                           file=sys.stderr, flush=True)
                     return 1
-                st = _elastic_call(args.master, "status", epoch)
+                try:
+                    st = _elastic_call(args.master, "status", epoch)
+                except ConnectionError:
+                    return rc if rc != 0 else 1
                 if st["done"]:
                     if args.node_rank != 0:
                         # tell node 0 we saw the verdict so it can take
@@ -284,6 +308,19 @@ def _launch_elastic(args) -> int:
                         _wait_for_byes(master_srv, epoch, args.nnodes)
                     return 0
                 if st["failed"]:
+                    if epoch >= args.max_restarts:
+                        # final epoch failed: ack so node 0 can take the
+                        # rendezvous down without racing our last polls
+                        if args.node_rank != 0:
+                            try:
+                                _elastic_call(args.master, "bye",
+                                              (args.node_rank, epoch),
+                                              retries=1)
+                            except ConnectionError:
+                                pass
+                        else:
+                            _wait_for_byes(master_srv, epoch, args.nnodes,
+                                           timeout=10.0)
                     break
                 time.sleep(0.3)
             if epoch < args.max_restarts:

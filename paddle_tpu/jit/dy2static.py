@@ -592,7 +592,6 @@ class StagedRegion:
         opdef = self._get_opdef()
         sig = self._signature(vals)
         stageable = self._probed.get(sig)
-        seed = next_key()
         if stageable is None:
             # non-array inputs (Layer self, python configs) ride the probe
             # as closure statics — eval_shape only abstracts the arrays
@@ -607,8 +606,11 @@ class StagedRegion:
                 return opdef.fn(s, p, b, bound, iv, sig)
 
             try:
+                # abstract eval only — a fixed probe key keeps the real
+                # RNG stream untouched (an eager-fallback region must
+                # not burn generator offsets plain eager code wouldn't)
                 jax.eval_shape(
-                    probe, seed,
+                    probe, jax.random.PRNGKey(0),
                     [p._data for p in ptensors],
                     [b._data for b in btensors],
                     [base[i] for i in arr_pos])
@@ -628,6 +630,7 @@ class StagedRegion:
             self.eager_calls += 1
             return self.raw_fn(*vals)
         self.staged_calls += 1
+        seed = next_key()
         out = dispatch(opdef, (seed, list(ptensors), list(btensors),
                                bound, list(vals), sig), {})
         flat = jax.tree_util.tree_flatten(

@@ -5,6 +5,7 @@ from .layer import Layer, Parameter  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from . import initializer  # noqa: F401
 from . import functional  # noqa: F401
+from . import quant  # noqa: F401  (weight-only quantization)
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
 )

@@ -1,0 +1,122 @@
+"""paddle.nn.quant parity — weight-only quantization for LLM serving
+(ref: /root/reference/python/paddle/nn/quant/quantized_linear.py:39
+weight_quantize / weight_dequantize / weight_only_linear /
+llm_int8_linear).
+
+TPU stance: the reference's CUDA path feeds int8 weights to cutlass
+mixed-precision GEMMs; here the quantized weight lives in HBM at 1 byte
+(or packed int4 nibble pairs) per element — the 2-4x HBM-footprint /
+bandwidth win that weight-only quantization exists for — and is
+dequantized on the fly in-register ahead of the MXU matmul (XLA fuses
+the dequant multiply into the GEMM epilogue's operand load). Per-channel
+absmax scales, layout [in, out] -> quantized [out, in] transposed, as in
+the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+@register_op("weight_quantize")
+def weight_quantize(x, algo="weight_only_int8", arch=None,
+                    group_size=-1):
+    """[in, out] float weight -> (q [out, in] int8, scale [out] f32).
+    int4 packs two nibbles per int8 byte along the LAST axis
+    ([out, in//2]), low nibble first."""
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unknown algo {algo!r}")
+    w = x.astype(jnp.float32).T                      # [out, in]
+    amax = jnp.max(jnp.abs(w), axis=1)               # per out-channel
+    if algo == "weight_only_int4":
+        if w.shape[1] % 2:
+            raise ValueError(
+                "weight_only_int4 packs nibble PAIRS along the input "
+                f"dim, which must be even; got in-dim {w.shape[1]}")
+        scale = amax / 7.0
+        q = jnp.clip(jnp.round(w / jnp.where(scale == 0, 1, scale)[:, None]),
+                     -7, 7).astype(jnp.int8)
+        # pack nibble pairs: byte = (hi << 4) | (lo & 0xF)
+        lo = q[:, 0::2].astype(jnp.int32) & 0xF
+        hi = q[:, 1::2].astype(jnp.int32) & 0xF
+        packed = (lo | (hi << 4)).astype(jnp.uint8).view(jnp.int8)
+        return packed, scale.astype(jnp.float32)
+    scale = amax / 127.0
+    q = jnp.clip(jnp.round(w / jnp.where(scale == 0, 1, scale)[:, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _unpack_int4(q):
+    """[out, in//2] packed int8 -> [out, in] signed int4 values."""
+    b = q.view(jnp.uint8).astype(jnp.int32)
+    lo = b & 0xF
+    hi = (b >> 4) & 0xF
+    def sign4(v):
+        return jnp.where(v >= 8, v - 16, v)
+    out = jnp.stack([sign4(lo), sign4(hi)], axis=-1)
+    return out.reshape(q.shape[0], -1)
+
+
+@register_op("weight_dequantize")
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float16", group_size=-1):
+    """(q [out, in], scale [out]) -> [in, out] float weight."""
+    from ...core import dtype as dtypes
+    dt = dtypes.to_jnp(out_dtype)
+    vals = (_unpack_int4(x) if algo == "weight_only_int4"
+            else x.astype(jnp.int32))
+    w = vals.astype(jnp.float32) * scale[:, None]
+    return w.T.astype(dt)
+
+
+@register_op("weight_only_linear", amp_policy="white")
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """x [.., in] @ dequant(weight [out, in(/2)]) + bias (ref
+    quantized_linear.py weight_only_linear). The dequant multiply fuses
+    into the MXU matmul's operand load under XLA."""
+    vals = (_unpack_int4(weight) if weight_dtype == "int4"
+            else weight.astype(jnp.int32))
+    w = vals.astype(jnp.float32)
+    if weight_scale is not None:
+        w = w * weight_scale.astype(jnp.float32)[:, None]
+    out = jnp.matmul(x.astype(jnp.float32), w.T,
+                     preferred_element_type=jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@register_op("llm_int8_linear", amp_policy="white")
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8() (ref quantized_linear.py llm_int8_linear): activation
+    columns whose absmax exceeds `threshold` run in full precision
+    against the dequantized weight; the rest run int8xint8 with
+    per-channel rescale. TPU rendering keeps the outlier decomposition
+    semantics with the int8 pathway expressed as a rescaled MXU matmul."""
+    xf = x.astype(jnp.float32)
+    col_amax = jnp.max(jnp.abs(xf), axis=tuple(range(xf.ndim - 1)))
+    outlier = col_amax > threshold                      # [in]
+    wdq = weight.astype(jnp.float32)
+    if weight_scale is not None:
+        wdq = wdq * weight_scale.astype(jnp.float32)[:, None]
+    # int8 path: quantize non-outlier activation columns per-tensor
+    x_in = jnp.where(outlier, 0.0, xf)
+    x_out = jnp.where(outlier, xf, 0.0)
+    a_scale = jnp.max(jnp.abs(x_in)) / 127.0
+    a_scale = jnp.where(a_scale == 0, 1.0, a_scale)
+    xq = jnp.clip(jnp.round(x_in / a_scale), -127, 127)
+    out = (jnp.matmul(xq, wdq.T, preferred_element_type=jnp.float32)
+           * a_scale)
+    out = out + jnp.matmul(x_out, wdq.T,
+                           preferred_element_type=jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)

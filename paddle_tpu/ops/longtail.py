@@ -25,6 +25,8 @@ __all__ = [
     "histogram_bin_edges", "histogramdd", "pdist", "cdist", "polar",
     "view_as_complex", "view_as_real", "cond", "matrix_exp", "addbmm",
     "baddbmm", "cholesky_inverse", "geqrf", "orgqr", "reverse",
+    "mean_all", "numel", "shape_op", "fill", "fill_diagonal_tensor",
+    "view_dtype", "accuracy_op", "auc_op",
 ]
 
 
@@ -392,3 +394,79 @@ def orgqr(x, tau):
     """alias of householder_product (ref: linalg.py orgqr)."""
     from . import householder_product
     return householder_product(x, tau)
+
+
+# ---------------- misc YAML ops (round-3 batch 2) ----------------
+
+@register_op("mean_all")
+def mean_all(x):
+    """ref: legacy mean op — mean over ALL elements."""
+    return jnp.mean(x)
+
+
+@register_op("numel")
+def numel(x):
+    """ref: numel op — element count as a 0-d integer tensor."""
+    n = int(np.prod(x.shape)) if x.shape else 1
+    return jnp.asarray(n, jnp.int32)
+
+
+@register_op("shape_op")
+def shape_op(x):
+    """ref: shape op — runtime shape as an int32 vector (static under
+    XLA, which is the point: shapes are compile-time facts)."""
+    return jnp.asarray(np.array(x.shape, np.int32))
+
+
+@register_op("fill")
+def fill(x, value):
+    """ref: fill op — whole-tensor fill (functional: returns the filled
+    tensor; eager 'in-place' callers rebind)."""
+    return jnp.full(x.shape, value, x.dtype)
+
+
+@register_op("fill_diagonal_tensor")
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """ref: fill_diagonal_tensor op — write tensor y onto the
+    (dim1, dim2) diagonal of x."""
+    return diagonal_scatter.raw_fn(x, y, offset=offset, axis1=dim1,
+                                   axis2=dim2)
+
+
+def view_dtype(x, dtype):
+    """ref: view_dtype — reinterpret the underlying bytes (manipulation
+    view family)."""
+    from ..core.tensor import Tensor
+    from ..core import dtype as dtypes
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._wrap(arr.view(dtypes.to_jnp(dtype)))
+
+
+@register_op("accuracy_op")
+def accuracy_op(x, label, k=1):
+    """ref: accuracy op (phi accuracy_kernel) — top-k accuracy of
+    prediction scores x [N, C] against labels [N] or [N, 1]."""
+    lbl = label.reshape(-1).astype(jnp.int32)
+    kk = int(min(k, x.shape[-1]))
+    _, topk = jax.lax.top_k(x, kk)
+    hit = jnp.any(topk == lbl[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+@register_op("auc_op")
+def auc_op(predict, label):
+    """ref: auc op — binary ROC-AUC via the rank statistic
+    (Mann-Whitney U with MIDRANKS for ties: a fully-tied pos/neg pair
+    must score 0.5, matching the reference's threshold-bucketed AUC)."""
+    score = predict[..., -1].reshape(-1) if predict.ndim > 1 \
+        else predict.reshape(-1)
+    y = label.reshape(-1).astype(jnp.float32)
+    srt = jnp.sort(score)
+    lo = jnp.searchsorted(srt, score, side="left").astype(jnp.float32)
+    hi = jnp.searchsorted(srt, score, side="right").astype(jnp.float32)
+    ranks = (lo + hi + 1.0) / 2.0            # midrank, 1-based
+    npos = jnp.sum(y)
+    nneg = y.shape[0] - npos
+    u = jnp.sum(ranks * y) - npos * (npos + 1) / 2.0
+    denom = jnp.where(npos * nneg == 0, 1.0, npos * nneg)
+    return jnp.where(npos * nneg == 0, 0.5, u / denom)

@@ -36,7 +36,7 @@ OPS: Dict[str, "OpDef"] = {}
 
 class OpDef:
     __slots__ = ("name", "fn", "sig", "amp_policy", "n_grad_exempt",
-                 "tags", "cacheable")
+                 "tags", "cacheable", "exec_cache")
 
     def __init__(self, name, fn, amp_policy=None, tags=(),
                  cacheable=True):
@@ -51,6 +51,11 @@ class OpDef:
         # input concreteness (data-dependent output row counts) and
         # dynamically-generated region ops set this False
         self.cacheable = cacheable
+        # per-OpDef executable cache (see _get_exec_entry): living on
+        # the OpDef means a dropped dynamic op (StagedRegion over a
+        # deleted model) releases its executables AND the params they
+        # close over — no global pinning
+        self.exec_cache: Dict = {}
 
 
 def _is_tensor(x):
@@ -77,11 +82,19 @@ def _diffable(t: Tensor) -> bool:
 # statics and inside outer traces (TrainStep/jit — XLA already owns the
 # whole graph there).
 # ---------------------------------------------------------------------------
-_EXEC_CACHE: Dict = {}
-_EXEC_CACHE_MAX = 4096
+_EXEC_CACHE_MAX_PER_OP = 512  # executables per op; sentinels
+# (uncacheable signatures) are bounded separately so they can never
+# force executable flushes
 _UNCACHEABLE = object()  # ops that consume RNG during their trace: a
 # jitted executable would bake the key (same dropout mask forever) and
 # fwd/bwd would trace with DIFFERENT keys — permanently excluded
+
+
+def exec_cache_size():
+    """Total cached executables across the registry (bench metric)."""
+    total = len([v for o in OPS.values()
+                 for v in o.exec_cache.values() if v is not _UNCACHEABLE])
+    return total
 
 
 def _rng_stamp():
@@ -105,9 +118,9 @@ def _rng_restore(stamp):
 
 
 class _ExecEntry:
-    __slots__ = ("fwd", "bwd", "out_tree", "bwd_ok", "_run_raw", "_opdef")
+    __slots__ = ("fwd", "bwd", "out_tree", "bwd_ok", "_run_raw")
 
-    def __init__(self, fwd, bwd, opdef):
+    def __init__(self, fwd, bwd):
         self.fwd = fwd
         self.bwd = bwd
         self.out_tree = None
@@ -117,36 +130,47 @@ class _ExecEntry:
         # grads then re-derive eagerly from concrete primals
         self.bwd_ok = True
         self._run_raw = None
-        self._opdef = opdef  # pins id(opdef) for the cache key's lifetime
+
+
+_UNFINGERPRINTABLE = object()
 
 
 def _static_fingerprint(v):
+    """Type-aware fingerprint: 2, 2.0 and True are ==/hash-equal but
+    must NOT share an executable (an int exponent compiles an int-result
+    power). Unhashables return a sentinel the caller treats as
+    cache-ineligible (never a value that could collide with None)."""
     try:
         hash(v)
-        return v
+        return (type(v).__name__, v)
     except TypeError:
         if isinstance(v, (list, tuple)):
-            return tuple(_static_fingerprint(x) for x in v)
+            inner = tuple(_static_fingerprint(x) for x in v)
+            if any(x is _UNFINGERPRINTABLE for x in inner):
+                return _UNFINGERPRINTABLE
+            return (type(v).__name__, inner)
         if isinstance(v, dict):
-            return tuple(sorted((k, _static_fingerprint(x))
-                                for k, x in v.items()))
-        return None  # unhashable: caller skips the cache
+            inner = tuple(sorted((k, _static_fingerprint(x))
+                                 for k, x in v.items()))
+            if any(x is _UNFINGERPRINTABLE for _, x in inner):
+                return _UNFINGERPRINTABLE
+            return ("dict", inner)
+        return _UNFINGERPRINTABLE
 
 
 def _cache_key(opdef, treedef, leaves, tensor_pos, diff_pos):
+    """Key within the opdef's own cache (opdef identity is implied by
+    WHICH cache dict the key lives in)."""
     if not getattr(opdef, "cacheable", True):
         return None
-    # identity of the OpDef, not just its name: dynamically-created defs
-    # (StagedRegion) may share names; the cached entry holds the opdef
-    # strongly so the id cannot be recycled while the entry lives
-    parts = [id(opdef), opdef.name, treedef, tuple(diff_pos)]
+    parts = [treedef, tuple(diff_pos)]
     for i, leaf in enumerate(leaves):
         if i in tensor_pos:
             d = leaf._data if _is_tensor(leaf) else leaf
             parts.append((tuple(d.shape), str(d.dtype)))
         else:
             fp = _static_fingerprint(leaf)
-            if fp is None and leaf is not None:
+            if fp is _UNFINGERPRINTABLE:
                 return None
             parts.append(("s", fp))
     key = tuple(parts)
@@ -162,7 +186,8 @@ def _get_exec_entry(opdef, treedef, leaves, tensor_pos, diff_pos,
     key = _cache_key(opdef, treedef, leaves, tensor_pos, diff_pos)
     if key is None:
         return None, None
-    entry = _EXEC_CACHE.get(key)
+    cache = opdef.exec_cache
+    entry = cache.get(key)
     if entry is _UNCACHEABLE:
         return None, None
     if entry is not None:
@@ -189,15 +214,20 @@ def _get_exec_entry(opdef, treedef, leaves, tensor_pos, diff_pos,
         _, vjp_fn = jax.vjp(lambda *d: run(d, nondiff_arrs), *diff_arrs)
         return vjp_fn(tuple(cots))
 
-    entry = _ExecEntry(jax.jit(run), jax.jit(bwd), opdef)
+    entry = _ExecEntry(jax.jit(run), jax.jit(bwd))
     entry._run_raw = run  # out_tree side channel fires during trace
-    if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
-        # flush executables but KEEP the uncacheable blacklist: wiping
-        # it would re-probe RNG ops (double-draw) after every flush
-        for k in [k for k, v in _EXEC_CACHE.items()
-                  if v is not _UNCACHEABLE]:
-            del _EXEC_CACHE[k]
-    _EXEC_CACHE[key] = entry
+    live = [k for k, v in cache.items() if v is not _UNCACHEABLE]
+    if len(live) >= _EXEC_CACHE_MAX_PER_OP:
+        # flush this op's executables; the uncacheable sentinels stay
+        # (re-probing RNG ops would double-draw the stream) and are
+        # bounded on their own
+        for k in live:
+            del cache[k]
+    sentinels = [k for k, v in cache.items() if v is _UNCACHEABLE]
+    if len(sentinels) >= 4 * _EXEC_CACHE_MAX_PER_OP:
+        for k in sentinels[: len(sentinels) // 2]:
+            del cache[k]
+    cache[key] = entry
     return entry, key
 
 
@@ -250,7 +280,7 @@ def dispatch(opdef: OpDef, args, kwargs):
                     if not first:
                         raise
                     # not jittable (dynamic output shapes, host sync...)
-                    _EXEC_CACHE[key] = _UNCACHEABLE
+                    opdef.exec_cache[key] = _UNCACHEABLE
                     entry = None
                 if first and entry is not None:
                     if _rng_stamp() != stamp:
@@ -258,7 +288,7 @@ def dispatch(opdef: OpDef, args, kwargs):
                         # baked into the executable — never cache it.
                         # Rewind the stream so the eager fallback draws
                         # the same keys a cache-free run would.
-                        _EXEC_CACHE[key] = _UNCACHEABLE
+                        opdef.exec_cache[key] = _UNCACHEABLE
                         _rng_restore(stamp)
                         entry = None
                     else:
@@ -290,7 +320,7 @@ def dispatch(opdef: OpDef, args, kwargs):
         except Exception:
             if not first:
                 raise
-            _EXEC_CACHE[key] = _UNCACHEABLE  # not jittable
+            opdef.exec_cache[key] = _UNCACHEABLE  # not jittable
             entry = None
         if first and entry is not None:
             if _rng_stamp() != stamp:
@@ -298,7 +328,7 @@ def dispatch(opdef: OpDef, args, kwargs):
                 # different keys (wrong dropout grads) — blacklist,
                 # rewind the stream, and recompute through the
                 # single-trace vjp path below
-                _EXEC_CACHE[key] = _UNCACHEABLE
+                opdef.exec_cache[key] = _UNCACHEABLE
                 _rng_restore(stamp)
                 entry = None
             else:

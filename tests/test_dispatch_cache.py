@@ -9,11 +9,16 @@ from paddle_tpu import ops
 import paddle_tpu.ops.registry as R
 
 
+def _clear_all():
+    for od in R.OPS.values():
+        od.exec_cache.clear()
+
+
 @pytest.fixture(autouse=True)
 def _fresh_cache():
-    R._EXEC_CACHE.clear()
+    _clear_all()
     yield
-    R._EXEC_CACHE.clear()
+    _clear_all()
 
 
 def _t(x, sg=False):
@@ -24,14 +29,14 @@ class TestExecCache:
     def test_cache_populates_and_hits(self):
         x = _t(np.random.RandomState(0).randn(4, 4))
         y = ops.tanh(x)
-        n1 = len(R._EXEC_CACHE)
+        n1 = R.exec_cache_size()
         assert n1 >= 1
         y2 = ops.tanh(x)  # same signature: cache hit, no new entry
-        assert len(R._EXEC_CACHE) == n1
+        assert R.exec_cache_size() == n1
         np.testing.assert_array_equal(np.asarray(y.numpy()),
                                       np.asarray(y2.numpy()))
         ops.tanh(_t(np.random.RandomState(1).randn(2, 8)))  # new shape
-        assert len(R._EXEC_CACHE) > n1
+        assert R.exec_cache_size() > n1
 
     def test_cached_grads_match_uncached(self):
         rng = np.random.RandomState(1)
@@ -66,7 +71,9 @@ class TestExecCache:
         assert not np.array_equal(np.asarray(a.numpy()),
                                   np.asarray(b.numpy()))
         # the blacklist sentinel, not an executable, is what got stored
-        assert any(v is R._UNCACHEABLE for v in R._EXEC_CACHE.values())
+        assert any(v is R._UNCACHEABLE
+                   for od in R.OPS.values()
+                   for v in od.exec_cache.values())
 
     def test_dynamic_shape_ops_fall_back(self):
         x = _t(np.array([1.0, 0.0, 2.0, 0.0]))
@@ -93,3 +100,16 @@ class TestExecCache:
         (g,) = pt.autograd.grad(y, [x], create_graph=True)
         (g2,) = pt.autograd.grad(g.sum(), [x])
         np.testing.assert_allclose(g2.numpy(), [12.0, 18.0], rtol=1e-5)
+
+    def test_static_type_distinction(self):
+        """2, 2.0 and True are ==/hash-equal python values but must not
+        share an executable (int exponent -> int result)."""
+        x = _t(np.array([2.0, 3.0]))
+        xi = pt.to_tensor(np.array([2, 3], np.int32))
+        a = ops.pow(xi, 2)
+        b = ops.pow(xi, 2.0)
+        assert "int" in str(a.dtype)
+        assert "float" in str(b.dtype)
+        np.testing.assert_allclose(np.asarray(b.numpy()), [4.0, 9.0])
+        c = ops.pow(x, True)   # bool exponent: own cache slot
+        np.testing.assert_allclose(np.asarray(c.numpy()), [2.0, 3.0])

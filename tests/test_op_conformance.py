@@ -430,6 +430,9 @@ class TestRegistryCoverage:
         "roi_align", "box_coder", "fused_dropout_add",
         "fused_bias_dropout_residual_layer_norm",
         "fused_linear_activation", "npair_loss",
+        "mean_all", "numel", "shape_op", "fill", "fill_diagonal_tensor",
+        "accuracy_op", "auc_op", "weight_quantize", "weight_dequantize",
+        "weight_only_linear", "llm_int8_linear",
     }
 
     def test_coverage_accounting(self):

@@ -684,3 +684,83 @@ def test_geqrf_batched():
     for b in range(2):
         Q = npy(ops.orgqr(t(npy(a)[b]), t(npy(tau)[b])))
         np.testing.assert_allclose(Q.T @ Q, np.eye(3), atol=1e-4)
+
+
+class TestWeightOnlyQuant:
+    """paddle.nn.quant parity (ref: nn/quant/quantized_linear.py:39).
+    Oracle: explicit numpy per-channel absmax quantization."""
+
+    def _w(self):
+        return rng.standard_normal((32, 16)).astype(np.float32)  # [in,out]
+
+    def test_weight_quantize_int8_roundtrip(self):
+        from paddle_tpu.nn.quant import weight_quantize, weight_dequantize
+        w = self._w()
+        q, scale = weight_quantize(t(w), algo="weight_only_int8")
+        assert list(q.shape) == [16, 32] and str(q.dtype).endswith("int8")
+        assert list(scale.shape) == [16]
+        np.testing.assert_allclose(npy(scale),
+                                   np.abs(w).max(0) / 127.0, rtol=1e-6)
+        wd = weight_dequantize(q, scale, out_dtype="float32")
+        # dequantized weight within one quantization step per channel
+        step = npy(scale)[None, :]
+        assert np.all(np.abs(npy(wd) - w) <= step * 0.5 + 1e-6)
+
+    def test_weight_quantize_int4_roundtrip(self):
+        from paddle_tpu.nn.quant import weight_quantize, weight_dequantize
+        w = self._w()
+        q, scale = weight_quantize(t(w), algo="weight_only_int4")
+        assert list(q.shape) == [16, 16]  # packed nibble pairs
+        wd = npy(weight_dequantize(q, scale, algo="weight_only_int4",
+                                   out_dtype="float32"))
+        step = npy(scale)[None, :]
+        assert np.all(np.abs(wd - w) <= step * 0.5 + 1e-5)
+
+    def test_weight_only_linear_matches_dequant_matmul(self):
+        from paddle_tpu.nn.quant import (weight_quantize,
+                                         weight_only_linear)
+        w = self._w()
+        x = rng.standard_normal((4, 32)).astype(np.float32)
+        b = rng.standard_normal(16).astype(np.float32)
+        q, scale = weight_quantize(t(w))
+        out = npy(weight_only_linear(t(x), q, bias=t(b),
+                                     weight_scale=scale))
+        wd = npy(q).astype(np.float32) * npy(scale)[:, None]
+        ref = x @ wd.T + b
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_llm_int8_linear_outlier_decomposition(self):
+        from paddle_tpu.nn.quant import weight_quantize, llm_int8_linear
+        w = self._w()
+        x = rng.standard_normal((4, 32)).astype(np.float32)
+        x[:, 3] *= 50.0  # an outlier activation column
+        q, scale = weight_quantize(t(w), algo="llm.int8")
+        out = npy(llm_int8_linear(t(x), q, weight_scale=scale,
+                                  threshold=6.0))
+        wd = npy(q).astype(np.float32) * npy(scale)[:, None]
+        ref = x @ wd.T
+        # int8 path quantizes the non-outlier part: allow quant error
+        np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.2)
+
+
+def test_misc_yaml_batch2():
+    np.testing.assert_allclose(float(npy(ops.mean_all(t(A46)))),
+                               A46.mean(), rtol=1e-6)
+    assert int(npy(ops.numel(t(A46)))) == 24
+    np.testing.assert_array_equal(npy(ops.shape_op(t(A345))), [3, 4, 5])
+    np.testing.assert_array_equal(npy(ops.fill(t(A23), 2.5)),
+                                  np.full((2, 3), 2.5, np.float32))
+    got = npy(ops.fill_diagonal_tensor(t(np.zeros((3, 4), np.float32)),
+                                       t(np.ones(3, np.float32))))
+    np.testing.assert_array_equal(got, np.eye(3, 4, dtype=np.float32))
+    v = npy(ops.view_dtype(t(np.zeros(4, np.float32)), "int32"))
+    assert v.dtype == np.int32 and v.shape == (4,)
+    acc = float(npy(ops.accuracy_op(
+        t(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32)),
+        t(np.array([0, 1, 1], np.int64)))))
+    np.testing.assert_allclose(acc, 2.0 / 3.0, rtol=1e-6)
+    # AUC vs sklearn-equivalent rank computation
+    score = np.array([0.1, 0.4, 0.35, 0.8], np.float32)
+    y = np.array([0, 0, 1, 1], np.float32)
+    auc = float(npy(ops.auc_op(t(score), t(y))))
+    np.testing.assert_allclose(auc, 0.75, rtol=1e-6)  # known value
