@@ -185,3 +185,27 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
 def softmax_(x, axis=-1):
     return softmax(x, axis)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (ref API:
+    python/paddle/nn/functional/loss.py:1953, backed there by the
+    dynloaded warprnnt CUDA library; here by an exact log-semiring
+    lax.scan DP — ops.rnnt_loss_op). input: [B, T, U+1, V] logits.
+
+    Deviation: fastemit_lambda > 0 (a regularizer inside warprnnt's
+    gradient) is not implemented — raises rather than silently ignoring.
+    """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "fastemit_lambda > 0 is not implemented on the TPU RNN-T "
+            "path; pass fastemit_lambda=0.0")
+    from ...ops import rnnt_loss_op
+    per_sample = rnnt_loss_op(input, label, input_lengths, label_lengths,
+                              blank=blank)
+    if reduction == "mean":
+        return per_sample.mean()
+    if reduction == "sum":
+        return per_sample.sum()
+    return per_sample

@@ -26,7 +26,7 @@ __all__ = [
     "view_as_complex", "view_as_real", "cond", "matrix_exp", "addbmm",
     "baddbmm", "cholesky_inverse", "geqrf", "orgqr", "reverse",
     "mean_all", "numel", "shape_op", "fill", "fill_diagonal_tensor",
-    "view_dtype", "accuracy_op", "auc_op",
+    "view_dtype", "accuracy_op", "auc_op", "rnnt_loss_op",
 ]
 
 
@@ -470,3 +470,87 @@ def auc_op(predict, label):
     u = jnp.sum(ranks * y) - npos * (npos + 1) / 2.0
     denom = jnp.where(npos * nneg == 0, 1.0, npos * nneg)
     return jnp.where(npos * nneg == 0, 0.5, u / denom)
+
+
+# ---------------- RNN-T loss (warprnnt parity) ----------------
+
+@register_op("warprnnt", amp_policy="black")
+def rnnt_loss_op(input, label, input_lengths, label_lengths, blank=0,
+                 fastemit_lambda=0.0):
+    if fastemit_lambda:
+        # paddle DEFAULTS to 0.001 — fail loudly at the op itself so no
+        # entry point silently trains with a different loss than asked
+        raise NotImplementedError(
+            "fastemit_lambda > 0 is not implemented on the TPU RNN-T "
+            "path; pass fastemit_lambda=0.0")
+    """RNN-Transducer loss (ref: the dynloaded warprnnt library behind
+    python/paddle/nn/functional/loss.py:1953 rnnt_loss).
+
+    input: [B, T, U+1, V] log-probs or logits (normalized here),
+    label: [B, U] int, lengths per sample. TPU rendering: the exact
+    log-semiring alpha recursion as a lax.scan over time with a scan
+    over label positions inside — O(T*U) sequential DP, matmul-free
+    (a loss op, not a training hot path); padding positions are masked
+    with -inf and each sample reads its own (T_b, U_b) corner."""
+    logp = jax.nn.log_softmax(input, axis=-1)
+    b, t_max, u1_max, v = logp.shape
+    u_max = u1_max - 1
+    lbl = label.astype(jnp.int32)
+    in_len = input_lengths.astype(jnp.int32)
+    lb_len = label_lengths.astype(jnp.int32)
+
+    blank_lp = logp[..., blank]                          # [B, T, U+1]
+    # emit log-prob of label u at grid (t, u): gather along V
+    lbl_pad = jnp.concatenate(
+        [lbl, jnp.zeros((b, 1), jnp.int32)], axis=1)[:, :u1_max]
+    emit_lp = jnp.take_along_axis(
+        logp, lbl_pad[:, None, :, None], axis=-1)[..., 0]  # [B, T, U+1]
+
+    neg_inf = jnp.asarray(-1e30, logp.dtype)
+    u_idx = jnp.arange(u1_max)
+
+    # t = 0 row: alpha[0, u] = sum of emit probs along u at t=0
+    # t = 0 row is a plain prefix sum in log space: alpha0[u] =
+    # sum_{k<u} emit_lp[:, 0, k]
+    alpha0 = jnp.concatenate(
+        [jnp.zeros((b, 1), logp.dtype),
+         jnp.cumsum(emit_lp[:, 0, :-1], axis=1)], axis=1)
+    # mask u > label_len (invalid grid columns)
+    valid_u = u_idx[None, :] <= lb_len[:, None]
+    alpha0 = jnp.where(valid_u, alpha0, neg_inf)
+
+    def scan_t(alpha_prev, xs):
+        blank_tm1, emit_t, t = xs
+        stay = alpha_prev + blank_tm1
+        emit_in = jnp.concatenate(
+            [jnp.full((b, 1), neg_inf, logp.dtype),
+             emit_t[:, :-1]], axis=1)
+
+        def u_scan(u, carry):
+            prev = carry["prev"]
+            val = jnp.where(
+                u == 0, stay[:, 0],
+                jnp.logaddexp(stay[:, u], prev + emit_in[:, u]))
+            carry["alpha"] = carry["alpha"].at[:, u].set(val)
+            carry["prev"] = val
+            return carry
+        carry = {"alpha": jnp.full((b, u1_max), neg_inf, logp.dtype),
+                 "prev": jnp.full((b,), neg_inf, logp.dtype)}
+        alpha_t = jax.lax.fori_loop(0, u1_max, u_scan, carry)["alpha"]
+        alpha_t = jnp.where(valid_u, alpha_t, neg_inf)
+        # frozen past each sample's own T
+        alpha_t = jnp.where((t < in_len)[:, None], alpha_t, alpha_prev)
+        return alpha_t, None
+
+    ts = jnp.arange(1, t_max)
+    # emit at current t, blank consumed from t-1
+    xs = (jnp.moveaxis(blank_lp[:, :-1], 1, 0),
+          jnp.moveaxis(emit_lp[:, 1:], 1, 0), ts)
+    alpha_T, _ = jax.lax.scan(scan_t, alpha0, xs)
+
+    # total log-prob: alpha[T-1, U] + blank[T-1, U] per sample
+    tb = jnp.clip(in_len - 1, 0, t_max - 1)
+    ub = jnp.clip(lb_len, 0, u_max)
+    a_final = jnp.take_along_axis(alpha_T, ub[:, None], axis=1)[:, 0]
+    blank_final = blank_lp[jnp.arange(b), tb, ub]
+    return -(a_final + blank_final)

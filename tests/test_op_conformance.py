@@ -432,7 +432,7 @@ class TestRegistryCoverage:
         "fused_linear_activation", "npair_loss",
         "mean_all", "numel", "shape_op", "fill", "fill_diagonal_tensor",
         "accuracy_op", "auc_op", "weight_quantize", "weight_dequantize",
-        "weight_only_linear", "llm_int8_linear",
+        "weight_only_linear", "llm_int8_linear", "warprnnt",
     }
 
     def test_coverage_accounting(self):

@@ -764,3 +764,50 @@ def test_misc_yaml_batch2():
     y = np.array([0, 0, 1, 1], np.float32)
     auc = float(npy(ops.auc_op(t(score), t(y))))
     np.testing.assert_allclose(auc, 0.75, rtol=1e-6)  # known value
+
+
+def test_rnnt_loss_matches_numpy_dp():
+    """RNN-T loss vs an independent numpy log-semiring DP (warprnnt
+    parity, ref nn/functional/loss.py:1953), incl. per-sample lengths
+    and gradient flow."""
+    import paddle_tpu.nn.functional as F2
+
+    def np_rnnt(logits, labels, T, U, blank=0):
+        e = np.exp(logits - np.max(logits, -1, keepdims=True))
+        lp = np.log(e.astype(np.float64) / e.sum(-1, keepdims=True))
+        alpha = np.full((T, U + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for ti in range(T):
+            for u in range(U + 1):
+                if ti == 0 and u == 0:
+                    continue
+                cands = []
+                if ti > 0:
+                    cands.append(alpha[ti - 1, u] + lp[ti - 1, u, blank])
+                if u > 0:
+                    cands.append(alpha[ti, u - 1]
+                                 + lp[ti, u - 1, labels[u - 1]])
+                alpha[ti, u] = np.logaddexp.reduce(cands)
+        return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+    rng2 = np.random.default_rng(23)
+    B, T, U, V = 2, 5, 3, 6
+    logits = rng2.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    labels = rng2.integers(1, V, (B, U)).astype(np.int32)
+    Ts = np.array([5, 4], np.int32)
+    Us = np.array([3, 2], np.int32)
+    got = npy(ops.rnnt_loss_op(t(logits), t(labels), t(Ts), t(Us)))
+    ref = np.array([np_rnnt(logits[b], labels[b], Ts[b], Us[b])
+                    for b in range(B)])
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+    # reduction + gradient flow through the DP
+    xt = pt.to_tensor(logits, stop_gradient=False)
+    loss = F2.rnnt_loss(xt, t(labels), t(Ts), t(Us), reduction="mean")
+    np.testing.assert_allclose(float(npy(loss)), ref.mean(), rtol=1e-5)
+    loss.backward()
+    assert xt.grad is not None
+    g = np.asarray(xt.grad.numpy())
+    assert np.all(np.isfinite(g)) and np.abs(g).sum() > 0
+    with pytest.raises(NotImplementedError):
+        F2.rnnt_loss(t(logits), t(labels), t(Ts), t(Us),
+                     fastemit_lambda=0.001)
