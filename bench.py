@@ -170,7 +170,7 @@ def bench_resnet50(on_tpu):
     import paddle_tpu.ops as ops
 
     dev = jax.devices()[0]
-    batch, hw, steps = (128, 224, 10) if on_tpu else (4, 32, 2)
+    batch, hw, steps = (256, 224, 10) if on_tpu else (4, 32, 2)
     model = resnet50()
     model.train()
     opt = Momentum(learning_rate=0.1, momentum=0.9,

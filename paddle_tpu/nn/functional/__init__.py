@@ -194,16 +194,18 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     dynloaded warprnnt CUDA library; here by an exact log-semiring
     lax.scan DP — ops.rnnt_loss_op). input: [B, T, U+1, V] logits.
 
-    Deviation: fastemit_lambda > 0 (a regularizer inside warprnnt's
-    gradient) is not implemented — raises rather than silently ignoring.
+    Deviations from the reference: fastemit_lambda > 0 (a regularizer
+    inside warprnnt's gradient) is not implemented and RAISES rather
+    than silently ignoring — and because of that, the DEFAULT here is
+    0.0 where paddle defaults to 0.001 (pass the reference default
+    explicitly to get the loud error instead of a silent difference).
     """
-    if fastemit_lambda:
-        raise NotImplementedError(
-            "fastemit_lambda > 0 is not implemented on the TPU RNN-T "
-            "path; pass fastemit_lambda=0.0")
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(
+            f"reduction must be 'mean', 'sum' or 'none'; got {reduction!r}")
     from ...ops import rnnt_loss_op
     per_sample = rnnt_loss_op(input, label, input_lengths, label_lengths,
-                              blank=blank)
+                              blank=blank, fastemit_lambda=fastemit_lambda)
     if reduction == "mean":
         return per_sample.mean()
     if reduction == "sum":

@@ -477,12 +477,6 @@ def auc_op(predict, label):
 @register_op("warprnnt", amp_policy="black")
 def rnnt_loss_op(input, label, input_lengths, label_lengths, blank=0,
                  fastemit_lambda=0.0):
-    if fastemit_lambda:
-        # paddle DEFAULTS to 0.001 — fail loudly at the op itself so no
-        # entry point silently trains with a different loss than asked
-        raise NotImplementedError(
-            "fastemit_lambda > 0 is not implemented on the TPU RNN-T "
-            "path; pass fastemit_lambda=0.0")
     """RNN-Transducer loss (ref: the dynloaded warprnnt library behind
     python/paddle/nn/functional/loss.py:1953 rnnt_loss).
 
@@ -492,6 +486,12 @@ def rnnt_loss_op(input, label, input_lengths, label_lengths, blank=0,
     over label positions inside — O(T*U) sequential DP, matmul-free
     (a loss op, not a training hot path); padding positions are masked
     with -inf and each sample reads its own (T_b, U_b) corner."""
+    if fastemit_lambda:
+        # paddle DEFAULTS to 0.001 — fail loudly at the op itself so no
+        # entry point silently trains with a different loss than asked
+        raise NotImplementedError(
+            "fastemit_lambda > 0 is not implemented on the TPU RNN-T "
+            "path; pass fastemit_lambda=0.0")
     logp = jax.nn.log_softmax(input, axis=-1)
     b, t_max, u1_max, v = logp.shape
     u_max = u1_max - 1
