@@ -218,3 +218,25 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     if not pre_layer_norm:
         x = fused_layer_norm(x, ln2_scale, ln2_bias, ln2_epsilon)
     return x
+
+
+@register_op("fused_softmax_mask", amp_policy="black")
+def fused_softmax_mask(x, mask):
+    """softmax(x + mask) over the last axis (ref:
+    incubate/nn/functional/softmax_mask_fuse.py -> fused_softmax_mask
+    CUDA kernel; here one fused XLA expression). x: [b, h, s_q, s_k],
+    mask broadcastable (e.g. [b, 1, s_q, s_k])."""
+    return jax.nn.softmax(x.astype(jnp.float32)
+                          + mask.astype(jnp.float32),
+                          axis=-1).astype(x.dtype)
+
+
+@register_op("fused_softmax_mask_upper_triangle", amp_policy="black")
+def fused_softmax_mask_upper_triangle(x):
+    """softmax with the strictly-upper triangle masked out — the causal
+    attention score softmax (ref: softmax_mask_fuse_upper_triangle.py).
+    x: [b, h, s, s]."""
+    s = x.shape[-1]
+    keep = jnp.tril(jnp.ones((s, s), bool))
+    z = jnp.where(keep, x.astype(jnp.float32), -1e30)
+    return jax.nn.softmax(z, axis=-1).astype(x.dtype)

@@ -433,6 +433,7 @@ class TestRegistryCoverage:
         "mean_all", "numel", "shape_op", "fill", "fill_diagonal_tensor",
         "accuracy_op", "auc_op", "weight_quantize", "weight_dequantize",
         "weight_only_linear", "llm_int8_linear", "warprnnt",
+        "fused_softmax_mask", "fused_softmax_mask_upper_triangle",
     }
 
     def test_coverage_accounting(self):

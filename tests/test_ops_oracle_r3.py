@@ -811,3 +811,22 @@ def test_rnnt_loss_matches_numpy_dp():
     with pytest.raises(NotImplementedError):
         F2.rnnt_loss(t(logits), t(labels), t(Ts), t(Us),
                      fastemit_lambda=0.001)
+
+
+def test_fused_softmax_mask_family():
+    from paddle_tpu.incubate.nn.functional import (
+        fused_softmax_mask, fused_softmax_mask_upper_triangle)
+    x = rng.standard_normal((2, 2, 4, 4)).astype(np.float32)
+    m = np.where(rng.random((2, 1, 4, 4)) < 0.3, -10000.0, 0.0).astype(
+        np.float32)
+    got = npy(fused_softmax_mask(t(x), t(m)))
+    ref = TF.softmax(torch.tensor(x) + torch.tensor(m), dim=-1).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    got = npy(fused_softmax_mask_upper_triangle(t(x)))
+    mask = np.triu(np.ones((4, 4), bool), k=1)
+    z = np.where(mask, -1e30, x)
+    ref = TF.softmax(torch.tensor(z), dim=-1).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    # rows are normalized and causal (no mass above the diagonal)
+    assert np.allclose(got.sum(-1), 1.0, atol=1e-5)
+    assert np.all(got[..., mask] < 1e-6)
