@@ -27,6 +27,8 @@ __all__ = [
     "baddbmm", "cholesky_inverse", "geqrf", "orgqr", "reverse",
     "mean_all", "numel", "shape_op", "fill", "fill_diagonal_tensor",
     "view_dtype", "accuracy_op", "auc_op", "rnnt_loss_op",
+    "assign_value", "check_numerics", "full_batch_size_like",
+    "index_select_strided", "trans_layout",
 ]
 
 
@@ -554,3 +556,49 @@ def rnnt_loss_op(input, label, input_lengths, label_lengths, blank=0,
     a_final = jnp.take_along_axis(alpha_T, ub[:, None], axis=1)[:, 0]
     blank_final = blank_lp[jnp.arange(b), tb, ub]
     return -(a_final + blank_final)
+
+
+@register_op("assign_value")
+def assign_value(shape, dtype, values):
+    """ref: assign_value op — materialize a constant tensor."""
+    from ..core import dtype as dtypes
+    return jnp.asarray(np.array(values).reshape(shape),
+                       dtypes.to_jnp(dtype))
+
+
+@register_op("check_numerics", cacheable=False)
+def check_numerics(x, message=""):
+    """ref: check_numerics op — raise on NaN/Inf in EAGER mode (a debug
+    op; under a trace it is the identity — FLAGS_check_nan_inf is the
+    per-op traced-mode sanitizer)."""
+    if not isinstance(x, jax.core.Tracer):
+        if jnp.issubdtype(x.dtype, jnp.inexact) and bool(
+                jnp.logical_not(jnp.all(jnp.isfinite(x)))):
+            raise FloatingPointError(
+                f"check_numerics: NaN or Inf found. {message}")
+    return x
+
+
+@register_op("full_batch_size_like")
+def full_batch_size_like(input, shape, value, input_dim_idx=0,
+                         output_dim_idx=0, dtype=None):
+    """ref: full_batch_size_like op — fill `shape` but copy the batch
+    dim from `input`."""
+    from ..core import dtype as dtypes
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    dt = dtypes.to_jnp(dtype) if dtype is not None else input.dtype
+    return jnp.full(shape, value, dt)
+
+
+@register_op("index_select_strided")
+def index_select_strided(x, index, axis=0):
+    """ref: index_select_strided (view-input variant — buffers here are
+    always dense, so it IS index_select)."""
+    return jnp.take(x, index.astype(jnp.int32), axis=axis)
+
+
+@register_op("trans_layout")
+def trans_layout(x, perm):
+    """ref: trans_layout op (layout-change transpose)."""
+    return jnp.transpose(x, list(perm))

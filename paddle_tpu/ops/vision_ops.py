@@ -168,6 +168,11 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
     """Max pool returning flat argmax indices (ref: pool2d_with_index in
     ops.yaml; feeds unpool). Patch-extraction rendering so the argmax is a
     plain reduction over a static window axis."""
+    if isinstance(padding, str):
+        raise ValueError(
+            "max_pool2d_with_index needs explicit integer padding (the "
+            "index contract is defined on the unpadded input); use "
+            "max_pool2d for 'same'/'valid'")
     kh, kw = _norm_tuple(kernel_size, 2)
     sh, sw = _norm_tuple(stride if stride is not None else kernel_size, 2)
     pad = _conv_padding(padding, 2)
@@ -188,16 +193,29 @@ def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
     flat = patches.reshape(N, C, Ho, Wo, kh * kw)
     arg = jnp.argmax(flat, axis=-1)
     out = jnp.max(flat, axis=-1)
-    # flat index into the UNPADDED input, matching the reference contract
-    yy = jnp.broadcast_to(rows, (Ho, Wo, kh, kw)).reshape(Ho, Wo, kh * kw)
-    xx = jnp.broadcast_to(colx, (Ho, Wo, kh, kw)).reshape(Ho, Wo, kh * kw)
-    pick = lambda grid: jnp.take_along_axis(
+    # flat index into the UNPADDED input, matching the reference
+    # contract — ONE combined int grid + gather (not one per axis)
+    grid = ((jnp.broadcast_to(rows, (Ho, Wo, kh, kw)) - pad[0][0]) * W
+            + (jnp.broadcast_to(colx, (Ho, Wo, kh, kw)) - pad[1][0])
+            ).reshape(Ho, Wo, kh * kw)
+    idx = jnp.take_along_axis(
         jnp.broadcast_to(grid, (N, C, Ho, Wo, kh * kw)),
-        arg[..., None], axis=-1)[..., 0]
-    gy = pick(yy) - pad[0][0]
-    gx = pick(xx) - pad[1][0]
-    idx = (gy * W + gx).astype(jnp.int32)  # x32: int64 truncates
+        arg[..., None], axis=-1)[..., 0].astype(jnp.int32)  # x32
     return out, idx
+
+
+def _unpool_nd(x, indices, out_spatial):
+    """Shared max_unpool scatter: flatten spatial dims, vmap a per-(N,C)
+    .at[].set, reshape to the target spatial shape."""
+    import numpy as _np
+    N, C = x.shape[:2]
+    total = int(_np.prod(out_spatial))
+    flat = jnp.zeros((N, C, total), x.dtype)
+    idx = indices.reshape(N, C, -1).astype(jnp.int32)
+    vals = x.reshape(N, C, -1)
+    flat = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx,
+                                                              vals)
+    return flat.reshape((N, C) + tuple(out_spatial))
 
 
 @register_op("unpool")
@@ -214,11 +232,7 @@ def unpool(x, indices, kernel_size=2, stride=None, padding=0,
         W = (Wo - 1) * sw - pad[1][0] - pad[1][1] + kw
     else:
         H, W = output_size[-2:]
-    flat = jnp.zeros((N, C, H * W), x.dtype)
-    idx = indices.reshape(N, C, Ho * Wo).astype(jnp.int32)
-    vals = x.reshape(N, C, Ho * Wo)
-    flat = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
-    return flat.reshape(N, C, H, W)
+    return _unpool_nd(x, indices, (H, W))
 
 
 # ======================= roi pooling =======================
@@ -531,3 +545,72 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=100,
         return out, jnp.sum(ok)
 
     return jax.vmap(one_image)(bboxes, scores)
+
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0):
+    """3-D max pool returning flat argmax indices (ref:
+    max_pool3d_with_index in ops.yaml; feeds unpool3d). Same
+    patch-extraction rendering as the 2-D variant: argmax becomes a
+    plain reduction over a static window axis."""
+    if isinstance(padding, str):
+        raise ValueError(
+            "max_pool3d_with_index needs explicit integer padding (the "
+            "index contract is defined on the unpadded input); use "
+            "max_pool3d for 'same'/'valid'")
+    kd, kh, kw = _norm_tuple(kernel_size, 3)
+    sd, sh, sw = _norm_tuple(stride if stride is not None else kernel_size,
+                             3)
+    pad = _conv_padding(padding, 3)
+    N, C, D, H, W = x.shape
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    xp = jnp.pad(x, ((0, 0), (0, 0), pad[0], pad[1], pad[2]),
+                 constant_values=neg)
+    Dp, Hp, Wp = xp.shape[2:]
+    Do = (Dp - kd) // sd + 1
+    Ho = (Hp - kh) // sh + 1
+    Wo = (Wp - kw) // sw + 1
+    iz = (jnp.arange(Do) * sd)[:, None, None, None, None, None]
+    iy = (jnp.arange(Ho) * sh)[None, :, None, None, None, None]
+    ix = (jnp.arange(Wo) * sw)[None, None, :, None, None, None]
+    wz = jnp.arange(kd)[None, None, None, :, None, None]
+    wy = jnp.arange(kh)[None, None, None, None, :, None]
+    wx = jnp.arange(kw)[None, None, None, None, None, :]
+    zz = iz + wz   # [Do,1,1,kd,1,1]
+    yy = iy + wy
+    xx = ix + wx
+    patches = xp[:, :, zz, yy, xx]     # [N,C,Do,Ho,Wo,kd,kh,kw]
+    k3 = kd * kh * kw
+    flat = patches.reshape(N, C, Do, Ho, Wo, k3)
+    arg = jnp.argmax(flat, axis=-1)
+    out = jnp.max(flat, axis=-1)
+    full = (Do, Ho, Wo, kd, kh, kw)
+    # ONE combined unpadded-flat-index grid + gather (not one per axis)
+    grid = (((jnp.broadcast_to(zz, full) - pad[0][0]) * H
+             + (jnp.broadcast_to(yy, full) - pad[1][0])) * W
+            + (jnp.broadcast_to(xx, full) - pad[2][0])
+            ).reshape(Do, Ho, Wo, k3)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(grid, (N, C, Do, Ho, Wo, k3)),
+        arg[..., None], axis=-1)[..., 0].astype(jnp.int32)
+    return out, idx
+
+
+@register_op("unpool3d")
+def unpool3d(x, indices, kernel_size=2, stride=None, padding=0,
+             output_size=None):
+    """max_unpool3d: scatter pooled values to their argmax positions
+    (ref: phi/kernels/gpu/unpool_kernel.cu Unpool3d)."""
+    N, C, Do, Ho, Wo = x.shape
+    if output_size is None:
+        kd, kh, kw = _norm_tuple(kernel_size, 3)
+        sd, sh, sw = _norm_tuple(
+            stride if stride is not None else kernel_size, 3)
+        pad = _conv_padding(padding, 3)
+        D = (Do - 1) * sd - pad[0][0] - pad[0][1] + kd
+        H = (Ho - 1) * sh - pad[1][0] - pad[1][1] + kh
+        W = (Wo - 1) * sw - pad[2][0] - pad[2][1] + kw
+    else:
+        D, H, W = output_size[-3:]
+    return _unpool_nd(x, indices, (D, H, W))

@@ -830,3 +830,32 @@ def test_fused_softmax_mask_family():
     # rows are normalized and causal (no mass above the diagonal)
     assert np.allclose(got.sum(-1), 1.0, atol=1e-5)
     assert np.all(got[..., mask] < 1e-6)
+
+
+def test_max_pool3d_with_index_unpool3d():
+    """3-D pool-with-index vs torch, and unpool3d round-trip."""
+    x = rng.standard_normal((1, 2, 4, 6, 6)).astype(np.float32)
+    out, idx = ops.max_pool3d_with_index(t(x), 2, 2)
+    tout, tidx = TF.max_pool3d(torch.tensor(x), 2, 2, return_indices=True)
+    np.testing.assert_allclose(npy(out), tout.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(npy(idx), tidx.numpy())
+    up = ops.unpool3d(out, idx, 2, 2)
+    tup = TF.max_unpool3d(tout, tidx, 2, 2)
+    np.testing.assert_allclose(npy(up), tup.numpy(), atol=1e-6)
+
+
+def test_shim_ops_batch3():
+    got = npy(ops.assign_value([2, 2], "float32", [1.0, 2.0, 3.0, 4.0]))
+    np.testing.assert_array_equal(got, [[1, 2], [3, 4]])
+    # check_numerics: pass-through on finite, raises on NaN (eager)
+    np.testing.assert_array_equal(npy(ops.check_numerics(t(A23))), A23)
+    with pytest.raises(FloatingPointError):
+        ops.check_numerics(t(np.array([1.0, np.nan], np.float32)))
+    got = npy(ops.full_batch_size_like(t(A46), [7, 5], 2.5))
+    assert got.shape == (4, 5) and np.all(got == 2.5)
+    np.testing.assert_array_equal(
+        npy(ops.index_select_strided(t(A46), t(np.array([2, 0])), 0)),
+        A46[[2, 0]])
+    np.testing.assert_array_equal(
+        npy(ops.trans_layout(t(A345), [2, 0, 1])),
+        A345.transpose(2, 0, 1))
