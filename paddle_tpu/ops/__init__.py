@@ -42,6 +42,7 @@ from .vision_ops import (  # noqa: F401
     depthwise_conv2d, conv3d_transpose, deformable_conv, fold,
     max_pool2d_with_index, unpool, roi_pool, psroi_pool, prior_box,
     yolo_box, matrix_nms, multiclass_nms, max_pool3d_with_index, unpool3d,
+    generate_proposals, distribute_fpn_proposals,
 )
 from .sequence_ops import (  # noqa: F401
     ctc_loss, viterbi_decode, gather_tree, top_p_sampling, edit_distance,

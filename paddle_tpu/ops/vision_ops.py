@@ -508,19 +508,8 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=100,
         k = min(nms_top_k, M)
         top_sc, order = jax.lax.top_k(sc, k)
         b = boxes[order]
-        iou = _iou_matrix(b, b, normalized)
-
-        def body(i, carry):
-            keep, thr = carry
-            sup = (iou[i] > thr) & keep[i] & (jnp.arange(k) > i)
-            # adaptive NMS (ref nms_util.h:171): decay the threshold
-            # after each surviving anchor box once it exceeds 0.5
-            thr = jnp.where((nms_eta < 1.0) & (thr > 0.5) & keep[i],
-                            thr * nms_eta, thr)
-            return keep & ~sup, thr
-        keep, _ = jax.lax.fori_loop(
-            0, k, body, (top_sc > score_threshold,
-                         jnp.float32(nms_threshold)))
+        keep = _greedy_nms_keep(b, top_sc > score_threshold,
+                                nms_threshold, normalized, eta=nms_eta)
         return jnp.where(keep, top_sc, -1.0), order
 
     def one_image(boxes, sc):
@@ -614,3 +603,157 @@ def unpool3d(x, indices, kernel_size=2, stride=None, padding=0,
     else:
         D, H, W = output_size[-3:]
     return _unpool_nd(x, indices, (D, H, W))
+
+
+def _greedy_nms_keep(boxes, live, thresh, normalized=True, eta=1.0):
+    """Greedy NMS over score-DESC-sorted candidates: returns the bool
+    keep mask (sorted order). `live` marks candidates in play (padding /
+    below-score-threshold come in False). O(k) memory: each step
+    computes ONE IoU row against the loop box instead of materializing
+    the k x k matrix (pre_nms pools run to 6000+). eta < 1 is the
+    reference's adaptive NMS: the threshold decays after each survivor
+    once it exceeds 0.5 (nms_util.h:171)."""
+    k = boxes.shape[0]
+
+    def body(i, carry):
+        keep, thr = carry
+        bi = jax.lax.dynamic_slice_in_dim(boxes, i, 1, axis=0)
+        iou_i = _iou_matrix(bi, boxes, normalized)[0]        # [k]
+        sup = (iou_i > thr) & keep[i] & (jnp.arange(k) > i)
+        thr = jnp.where((eta < 1.0) & (thr > 0.5) & keep[i],
+                        thr * eta, thr)
+        return keep & jnp.logical_not(sup), thr
+
+    keep, _ = jax.lax.fori_loop(
+        0, k, body, (live, jnp.float32(thresh)))
+    return keep
+
+
+@register_op("generate_proposals")
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False):
+    """RPN proposal generation (ref:
+    phi/kernels/gpu/generate_proposals_kernel.cu, python API
+    vision/ops.py generate_proposals).
+
+    scores [N, A, H, W], bbox_deltas [N, 4A, H, W], img_size [N, 2]
+    (h, w), anchors [H, W, A, 4], variances [H, W, A, 4].
+    Static rendering: per image, top pre_nms_top_n anchors decode +
+    clip + min-size filter (filtered = -inf score), greedy NMS, then
+    the top post_nms_top_n survivors — outputs are PADDED to
+    post_nms_top_n with rois_num giving the live count per image
+    (XLA needs static shapes; the reference returns ragged LoD)."""
+    if eta < 1.0:
+        # ref generate_proposals_kernel.cu:472: adaptive NMS is
+        # explicitly rejected for proposal generation
+        raise ValueError("generate_proposals does not support adaptive "
+                         "NMS (eta < 1.0), matching the reference")
+    min_size = max(float(min_size), 1.0)  # ref :392 floors at 1.0
+    n, a, h, w = scores.shape
+    anc = anchors.reshape(-1, 4)           # [H*W*A, 4]
+    var = variances.reshape(-1, 4)
+    off = 1.0 if pixel_offset else 0.0
+
+    def one(sc, dl, im):
+        # [A,H,W] -> [H,W,A] flat, matching anchors' [H,W,A] order
+        s_flat = jnp.transpose(sc, (1, 2, 0)).reshape(-1)
+        d_flat = jnp.transpose(dl.reshape(a, 4, h, w),
+                               (2, 3, 0, 1)).reshape(-1, 4)
+        # pre_nms_top_n <= 0 means "use all anchors" (ref :365)
+        k = (s_flat.shape[0] if pre_nms_top_n <= 0
+             else min(pre_nms_top_n, s_flat.shape[0]))
+        top_s, order = jax.lax.top_k(s_flat, k)
+        anc_k = anc[order]
+        var_k = var[order]
+        d_k = d_flat[order]
+        # center-size decode with variances (ref box_coder decode)
+        aw = anc_k[:, 2] - anc_k[:, 0] + off
+        ah = anc_k[:, 3] - anc_k[:, 1] + off
+        acx = anc_k[:, 0] + aw * 0.5
+        acy = anc_k[:, 1] + ah * 0.5
+        cx = var_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = var_k[:, 1] * d_k[:, 1] * ah + acy
+        # kBBoxClipDefault = log(1000/16) (ref :41) caps decoded w/h
+        clip = float(np.log(1000.0 / 16.0))
+        bw = jnp.exp(jnp.minimum(var_k[:, 2] * d_k[:, 2], clip)) * aw
+        bh = jnp.exp(jnp.minimum(var_k[:, 3] * d_k[:, 3], clip)) * ah
+        boxes = jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                           cx + bw * 0.5 - off, cy + bh * 0.5 - off],
+                          axis=1)
+        # clip to image
+        imh, imw = im[0], im[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, imw - off),
+            jnp.clip(boxes[:, 1], 0, imh - off),
+            jnp.clip(boxes[:, 2], 0, imw - off),
+            jnp.clip(boxes[:, 3], 0, imh - off)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        valid = (ws >= min_size) & (hs >= min_size)
+        top_s = jnp.where(valid, top_s, -jnp.inf)
+        keep = _greedy_nms_keep(boxes, top_s > -jnp.inf, nms_thresh,
+                                normalized=not pixel_offset)
+        kept_s = jnp.where(keep, top_s, -jnp.inf)
+        m = min(post_nms_top_n, k)
+        out_s, sel = jax.lax.top_k(kept_s, m)
+        out_b = boxes[sel]
+        live = out_s > -jnp.inf
+        out_b = out_b * live[:, None].astype(out_b.dtype)
+        out_s = jnp.where(live, out_s, 0.0)
+        return out_b, out_s, jnp.sum(live.astype(jnp.int32))
+
+    rois, probs, nums = jax.vmap(one)(scores, bbox_deltas,
+                                      img_size.astype(scores.dtype))
+    return rois, probs, nums
+
+
+@register_op("distribute_fpn_proposals")
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None,
+                             pixel_offset=False):
+    """Assign RoIs to FPN pyramid levels by scale (ref:
+    phi/kernels/gpu/distribute_fpn_proposals_kernel.cu):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)).
+
+    Static rendering: rois are [R, 4] (padded rows allowed via
+    rois_num); returns per-level PADDED [R, 4] tensors with per-level
+    counts `multi_rois_num`, plus restore_index mapping the
+    level-concatenated order back to the input order — the reference's
+    ragged multi-level output expressed with static shapes. Per-level
+    tensors keep the level's rois SORTED FIRST (original order) then
+    zero padding."""
+    r = fpn_rois.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    ws = fpn_rois[:, 2] - fpn_rois[:, 0] + off
+    hs = fpn_rois[:, 3] - fpn_rois[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-12))
+    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-12))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    if rois_num is not None:
+        total = jnp.sum(rois_num.astype(jnp.int32))
+        live = jnp.arange(r) < total
+    else:
+        live = jnp.ones((r,), bool)
+    lvl = jnp.where(live, lvl, max_level + 1)  # padding past every level
+
+    multi_rois, multi_nums = [], []
+    pos_in_concat = jnp.zeros((r,), jnp.int32)
+    base = 0
+    for level in range(min_level, max_level + 1):
+        mask = lvl == level
+        cnt = jnp.sum(mask.astype(jnp.int32))
+        # stable front-pack of this level's rois
+        order = jnp.argsort(jnp.where(mask, jnp.arange(r), r + 1))
+        packed = fpn_rois[order] * (jnp.arange(r) < cnt)[:, None].astype(
+            fpn_rois.dtype)
+        multi_rois.append(packed)
+        multi_nums.append(cnt)
+        # position of each input roi inside the concatenated output
+        rank_in_level = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        pos_in_concat = jnp.where(mask, base + rank_in_level,
+                                  pos_in_concat)
+        base = base + cnt
+    restore_index = pos_in_concat[:, None]
+    return (*multi_rois, jnp.stack(multi_nums), restore_index)

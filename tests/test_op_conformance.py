@@ -434,6 +434,7 @@ class TestRegistryCoverage:
         "accuracy_op", "auc_op", "weight_quantize", "weight_dequantize",
         "weight_only_linear", "llm_int8_linear", "warprnnt",
         "fused_softmax_mask", "fused_softmax_mask_upper_triangle",
+        "generate_proposals", "distribute_fpn_proposals",
         "max_pool3d_with_index", "unpool3d", "assign_value",
         "check_numerics", "full_batch_size_like", "index_select_strided",
         "trans_layout",

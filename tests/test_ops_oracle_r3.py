@@ -859,3 +859,105 @@ def test_shim_ops_batch3():
     np.testing.assert_array_equal(
         npy(ops.trans_layout(t(A345), [2, 0, 1])),
         A345.transpose(2, 0, 1))
+
+
+class TestProposalOps:
+    """RPN pipeline completion (ref generate_proposals /
+    distribute_fpn_proposals kernels) — numpy reference oracles."""
+
+    def test_generate_proposals_semantics(self):
+        h = w = 4
+        a = 2
+        anchors = np.zeros((h, w, a, 4), np.float32)
+        for i in range(h):
+            for j in range(w):
+                anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 15, i * 8 + 15]
+                anchors[i, j, 1] = [j * 8, i * 8, j * 8 + 31, i * 8 + 31]
+        var = np.ones((h, w, a, 4), np.float32)
+        scores = rng.random((1, a, h, w)).astype(np.float32)
+        deltas = np.zeros((1, 4 * a, h, w), np.float32)  # identity decode
+        img = np.array([[32.0, 32.0]], np.float32)
+        rois, probs, nums = ops.generate_proposals(
+            t(scores), t(deltas), t(img), t(anchors), t(var),
+            pre_nms_top_n=32, post_nms_top_n=8, nms_thresh=0.7,
+            min_size=4.0)
+        n_live = int(npy(nums)[0])
+        assert 1 <= n_live <= 8
+        rois_np = npy(rois)[0][:n_live]
+        probs_np = npy(probs)[0][:n_live]
+        # scores come back sorted descending; every roi inside the image
+        assert np.all(np.diff(probs_np) <= 1e-6)
+        assert np.all(rois_np >= 0) and np.all(rois_np <= 32.0)
+        # with zero deltas, every roi is exactly a clipped anchor
+        clipped = np.clip(anchors.reshape(-1, 4), 0, 32.0)
+        for rrow in rois_np:
+            assert np.any(np.all(np.isclose(clipped, rrow, atol=1e-4),
+                                 axis=1))
+        # nms actually suppressed: overlapping shifted boxes collapse
+        def iou(b1, b2):
+            lt = np.maximum(b1[:2], b2[:2])
+            rb = np.minimum(b1[2:], b2[2:])
+            inter = np.prod(np.clip(rb - lt, 0, None))
+            a1 = np.prod(b1[2:] - b1[:2])
+            a2 = np.prod(b2[2:] - b2[:2])
+            return inter / (a1 + a2 - inter)
+        for i in range(n_live):
+            for j in range(i + 1, n_live):
+                assert iou(rois_np[i], rois_np[j]) <= 0.7 + 1e-5
+
+    def test_distribute_fpn_proposals(self):
+        rois = np.array([
+            [0, 0, 20, 20],      # sqrt(400)=20 -> low level
+            [0, 0, 200, 200],    # 200 -> refer level
+            [0, 0, 800, 800],    # 800 -> high level
+            [0, 0, 210, 190],    # ~refer level
+        ], np.float32)
+        outs = ops.distribute_fpn_proposals(
+            t(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        *levels, nums, restore = outs
+        nums = npy(nums)
+        assert nums.sum() == 4
+        lv = {2 + i: npy(l) for i, l in enumerate(levels)}
+        # 20-scale roi sits at the bottom level, 800 at the top
+        assert nums[0] >= 1 and np.allclose(lv[2][0], rois[0])
+        assert np.allclose(lv[5][0], rois[2])
+        # restore index is a permutation of the concatenated order
+        ri = npy(restore).reshape(-1)
+        assert sorted(ri.tolist()) == [0, 1, 2, 3]
+        concat = np.concatenate([lv[L][:nums[L - 2]] for L in (2, 3, 4, 5)])
+        np.testing.assert_allclose(concat[ri], rois, atol=1e-5)
+
+    def test_distribute_with_rois_num_padding(self):
+        rois = np.zeros((6, 4), np.float32)
+        rois[:3] = [[0, 0, 30, 30], [0, 0, 300, 300], [0, 0, 700, 700]]
+        outs = ops.distribute_fpn_proposals(
+            t(rois), 2, 5, 4, 224,
+            rois_num=t(np.array([3], np.int32)))
+        *levels, nums, restore = outs
+        assert npy(nums).sum() == 3  # padding rows assigned to no level
+
+    def test_generate_proposals_conformance_details(self):
+        """Reference details: min_size floors at 1.0, exp clip at
+        log(1000/16), eta<1 rejected, pre_nms_top_n<=0 = all anchors."""
+        h = w = 2
+        a = 1
+        anchors = np.zeros((h, w, a, 4), np.float32)
+        for i in range(h):
+            for j in range(w):
+                anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 7, i * 8 + 7]
+        var = np.ones((h, w, a, 4), np.float32)
+        scores = rng.random((1, a, h, w)).astype(np.float32)
+        deltas = np.zeros((1, 4, h, w), np.float32)
+        deltas[0, 2:, :, :] = 6.0   # huge dw/dh: must clip at log(1000/16)
+        img = np.array([[4000.0, 4000.0]], np.float32)
+        rois, probs, nums = ops.generate_proposals(
+            t(scores), t(deltas), t(img), t(anchors), t(var),
+            pre_nms_top_n=-1, post_nms_top_n=4, nms_thresh=0.99,
+            min_size=0.0)
+        rois_np = npy(rois)[0][: int(npy(nums)[0])]
+        wmax = (rois_np[:, 2] - rois_np[:, 0]).max()
+        assert wmax <= 8 * 1000.0 / 16.0 + 1e-3   # clipped decode
+        with pytest.raises(ValueError, match="adaptive"):
+            ops.generate_proposals(t(scores), t(deltas), t(img),
+                                   t(anchors), t(var), eta=0.9)
