@@ -98,6 +98,17 @@ def _job_rpc_token(args=None) -> str:
             # without a side channel — hash the rendezvous endpoint.
             # Export PADDLE_RPC_TOKEN on all nodes for real isolation.
             import hashlib
+            import warnings
+            warnings.warn(
+                "multi-node launch without PADDLE_RPC_TOKEN: the RPC "
+                "HMAC key is derived from the (public) rendezvous "
+                "endpoint, so any host that can reach the master port "
+                "can forge frames (pickle payloads => code execution). "
+                "Export the same secret PADDLE_RPC_TOKEN on every node.",
+                RuntimeWarning, stacklevel=2)
+            print("[paddle-tpu launch] WARNING: no PADDLE_RPC_TOKEN set "
+                  "for a multi-node job; RPC authentication is weak "
+                  "(endpoint-derived key).", file=sys.stderr)
             tok = hashlib.sha256(
                 f"paddle-tpu-job:{args.master}".encode()).hexdigest()[:32]
         if not tok:

@@ -758,8 +758,10 @@ def graph_break_transform(fn: Callable):
     glb = dict(fn.__globals__)
     import sys
     glb["_jst"] = sys.modules[__name__]
+    closure_cells = {}
     if fn.__closure__:
-        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+        closure_cells = dict(zip(fn.__code__.co_freevars, fn.__closure__))
+        for name, cell in closure_cells.items():
             try:
                 glb[name] = cell.cell_contents
             except ValueError:
@@ -788,5 +790,41 @@ def graph_break_transform(fn: Callable):
     # were compiled with glb as their __globals__; they were (exec globals)
     new_fn.__defaults__ = fn.__defaults__
     new_fn.__kwdefaults__ = fn.__kwdefaults__
+    if closure_cells:
+        # free variables must track later REBINDING in the enclosing scope
+        # (plain eager re-reads cells per call): refresh the exec-globals
+        # snapshot from the live cells on every invocation, and flush the
+        # staged regions' caches when a cell's VALUE changed — staged
+        # traces bake captured non-tensor values in as constants.
+        # Limitation (documented): in-place mutation of a captured mutable
+        # (cfg["k"] = v on the same dict object) is invisible here — the
+        # cell still holds the same object, so staged regions keep the
+        # value they baked in. Rebind the cell to a new object to refresh.
+        inner = new_fn
+        import functools
+        last_seen = {}
+
+        @functools.wraps(inner)
+        def new_fn(*a, **kw):
+            dirty = False
+            for _name, _cell in closure_cells.items():
+                try:
+                    v = _cell.cell_contents
+                except ValueError:
+                    continue
+                if _name not in last_seen or _static_differs(
+                        last_seen[_name], v):
+                    dirty = dirty or (_name in last_seen)
+                    last_seen[_name] = v
+                    glb[_name] = v
+            if dirty:
+                for r in regions:
+                    r._probed.clear()
+                    r._out_meta.clear()
+                    r._bound_cache.clear()
+                    if r._opdef is not None:
+                        r._opdef.exec_cache.clear()
+            return inner(*a, **kw)
+
     new_fn.__graph_break_regions__ = regions
     return new_fn, regions

@@ -109,9 +109,25 @@ def take(x, index, mode="raise"):
     n = flat.shape[0]
     if mode == "wrap":
         idx = ((idx % n) + n) % n
-    else:  # 'raise' cannot raise under XLA; clip is the safe rendering
+    else:  # 'raise' validates on concrete inputs via eager_check below;
+        # under a trace XLA cannot raise, so clip is the safe rendering
         idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
     return jnp.take(flat, idx)
+
+
+def _take_eager_check(x, index, mode="raise"):
+    if mode != "raise":
+        return
+    n = int(np.prod(x.shape))
+    idx = np.asarray(index)
+    if idx.size and (int(idx.min()) < -n or int(idx.max()) >= n):
+        raise IndexError(
+            f"take(mode='raise'): index out of range for input with "
+            f"{n} elements (got range [{int(idx.min())}, "
+            f"{int(idx.max())}])")
+
+
+take.op_def.eager_check = _take_eager_check
 
 
 @register_op("block_diag")

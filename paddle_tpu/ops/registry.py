@@ -36,7 +36,7 @@ OPS: Dict[str, "OpDef"] = {}
 
 class OpDef:
     __slots__ = ("name", "fn", "sig", "amp_policy", "n_grad_exempt",
-                 "tags", "cacheable", "exec_cache")
+                 "tags", "cacheable", "exec_cache", "eager_check")
 
     def __init__(self, name, fn, amp_policy=None, tags=(),
                  cacheable=True):
@@ -56,6 +56,11 @@ class OpDef:
         # deleted model) releases its executables AND the params they
         # close over — no global pinning
         self.exec_cache: Dict = {}
+        # optional host-side validation run only on concrete (eager,
+        # untraced) inputs — the analog of the reference's per-kernel
+        # PADDLE_ENFORCE input checks, which XLA-traced bodies cannot
+        # express (no data-dependent raise under trace)
+        self.eager_check = None
 
 
 def _is_tensor(x):
@@ -191,6 +196,9 @@ def _get_exec_entry(opdef, treedef, leaves, tensor_pos, diff_pos,
     if entry is _UNCACHEABLE:
         return None, None
     if entry is not None:
+        # LRU: move the hit to the end so eviction order tracks recency
+        # (python dicts preserve insertion order)
+        cache[key] = cache.pop(key)
         return entry, key
     fn = opdef.fn
     arr_pos = list(tensor_pos)
@@ -218,10 +226,11 @@ def _get_exec_entry(opdef, treedef, leaves, tensor_pos, diff_pos,
     entry._run_raw = run  # out_tree side channel fires during trace
     live = [k for k, v in cache.items() if v is not _UNCACHEABLE]
     if len(live) >= _EXEC_CACHE_MAX_PER_OP:
-        # flush this op's executables; the uncacheable sentinels stay
-        # (re-probing RNG ops would double-draw the stream) and are
-        # bounded on their own
-        for k in live:
+        # LRU eviction: drop only the least-recently-used executables
+        # (hits are moved to the dict tail above), so workloads cycling
+        # through >cap signatures don't recompile the whole working set
+        n_evict = len(live) - _EXEC_CACHE_MAX_PER_OP + 1
+        for k in live[:n_evict]:
             del cache[k]
     sentinels = [k for k, v in cache.items() if v is _UNCACHEABLE]
     if len(sentinels) >= 4 * _EXEC_CACHE_MAX_PER_OP:
@@ -253,8 +262,9 @@ def dispatch(opdef: OpDef, args, kwargs):
     for i in tensor_pos:
         if _is_tensor(leaves[i]):
             const_vals[i] = leaves[i]._data
-    in_trace = any(isinstance(const_vals[i], jax.core.Tracer)
-                   for i in tensor_pos)
+    has_tracer = any(isinstance(const_vals[i], jax.core.Tracer)
+                     for i in tensor_pos)
+    in_trace = has_tracer
     # committed multi-device inputs (NamedSharding etc.) bypass the
     # cache: a plain jitted executable would not preserve the explicit
     # output shardings distributed ops establish (reshard, mpu layers)
@@ -265,6 +275,12 @@ def dispatch(opdef: OpDef, args, kwargs):
                     "SingleDeviceSharding":
                 in_trace = True  # reuse the no-cache path
                 break
+
+    # gate on actual tracer presence, not in_trace: sharded concrete
+    # inputs reuse the no-cache path but are still host-checkable
+    if opdef.eager_check is not None and not has_tracer:
+        opdef.eager_check(
+            **jax.tree_util.tree_unflatten(treedef, const_vals))
 
     if not record:
         if not in_trace:

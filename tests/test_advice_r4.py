@@ -1,0 +1,118 @@
+"""Round-4 advisor-fix regression tests (ADVICE.md round 3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_take_raise_validates_eager():
+    x = paddle.to_tensor(np.arange(6.0).reshape(2, 3))
+    idx = paddle.to_tensor(np.array([0, 5], np.int64))
+    out = paddle.take(x, idx, mode="raise")
+    np.testing.assert_allclose(np.asarray(out._data), [0.0, 5.0])
+    bad = paddle.to_tensor(np.array([0, 6], np.int64))
+    with pytest.raises(IndexError):
+        paddle.take(x, bad, mode="raise")
+    neg_bad = paddle.to_tensor(np.array([-7], np.int64))
+    with pytest.raises(IndexError):
+        paddle.take(x, neg_bad, mode="raise")
+    # wrap mode still never raises
+    out = paddle.take(x, bad, mode="wrap")
+    np.testing.assert_allclose(np.asarray(out._data), [0.0, 0.0])
+
+
+def test_take_raise_clips_under_trace():
+    import jax
+    x = paddle.to_tensor(np.arange(6.0))
+
+    def f(i):
+        return paddle.take(x, paddle.to_tensor(i), mode="raise")._data
+
+    out = jax.jit(f)(np.array([7], np.int64))
+    # traced path cannot raise; clips to the last element
+    np.testing.assert_allclose(np.asarray(out), [5.0])
+
+
+def test_exec_cache_lru_eviction(monkeypatch):
+    from paddle_tpu.ops import registry
+
+    monkeypatch.setattr(registry, "_EXEC_CACHE_MAX_PER_OP", 4)
+    opdef = paddle.take.op_def
+    opdef.exec_cache.clear()
+    x = paddle.to_tensor(np.arange(8.0))
+    # fill 4 distinct signatures (different index lengths)
+    for n in range(1, 5):
+        paddle.take(x, paddle.to_tensor(np.arange(n, dtype=np.int64)))
+    keys_before = [k for k, v in opdef.exec_cache.items()]
+    assert len(keys_before) == 4
+    # touch signature n=1 so it becomes most-recent
+    paddle.take(x, paddle.to_tensor(np.arange(1, dtype=np.int64)))
+    # a 5th signature evicts exactly one entry — the LRU one (n=2),
+    # NOT the whole cache
+    paddle.take(x, paddle.to_tensor(np.arange(5, dtype=np.int64)))
+    keys_after = list(opdef.exec_cache.keys())
+    assert len(keys_after) == 4
+    assert keys_before[0] in keys_after  # n=1 survived (was touched)
+    assert keys_before[1] not in keys_after  # n=2 was the LRU victim
+    opdef.exec_cache.clear()
+
+
+def test_graph_break_closure_reads_fresh_cell():
+    from paddle_tpu.jit import to_static
+
+    scale = 2.0
+
+    @to_static(full_graph=False)
+    def f(x):
+        y = x * scale
+        print("break here")  # forces a graph break region boundary
+        return y + scale
+
+    x = paddle.to_tensor(np.array([1.0, 2.0]))
+    out1 = np.asarray(f(x)._data)
+    np.testing.assert_allclose(out1, [4.0, 6.0])
+    scale = 3.0  # noqa: F841 — mutated closed-over variable
+    out2 = np.asarray(f(x)._data)
+    np.testing.assert_allclose(out2, [6.0, 9.0])
+
+
+def test_flash_attn_unpadded_traced_cu_seqlens():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+
+    total, heads, dim = 8, 2, 4
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((total, heads, dim)), jnp.float32)
+
+    def run(cu):
+        out = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            cu_seqlens_q=cu, cu_seqlens_k=cu,
+            max_seqlen_q=total, max_seqlen_k=total, causal=True)
+        return out[0]._data if isinstance(out, tuple) else out._data
+
+    cu = jnp.asarray([0, 5, 8], jnp.int32)
+    eager = np.asarray(run(cu))
+    jitted = np.asarray(jax.jit(run)(cu))  # must not raise TracerError
+    np.testing.assert_allclose(eager, jitted, rtol=2e-2, atol=2e-2)
+
+
+def test_multinode_token_warning(capsys):
+    import argparse
+    import importlib
+    launch_main = importlib.import_module(
+        "paddle_tpu.distributed.launch.main")
+
+    launch_main._RPC_TOKEN_CACHE = None
+    args = argparse.Namespace(nnodes=2, master="10.0.0.1:8765")
+    import os
+    old = os.environ.pop("PADDLE_RPC_TOKEN", None)
+    try:
+        with pytest.warns(RuntimeWarning, match="PADDLE_RPC_TOKEN"):
+            tok = launch_main._job_rpc_token(args)
+        assert len(tok) == 32
+    finally:
+        launch_main._RPC_TOKEN_CACHE = None
+        if old is not None:
+            os.environ["PADDLE_RPC_TOKEN"] = old
