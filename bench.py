@@ -30,12 +30,16 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def peak_flops(device) -> float:
+def _device_lookup(device, table, default):
     kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    for key, val in PEAK_BF16_FLOPS.items():
+    for key, val in table.items():
         if key in kind:
             return val
-    return 197e12  # conservative default: v5e
+    return default
+
+
+def peak_flops(device) -> float:
+    return _device_lookup(device, PEAK_BF16_FLOPS, 197e12)  # v5e default
 
 
 def _require_pallas(batch, seq, heads, head_dim, kv_heads=None):
@@ -334,12 +338,105 @@ def bench_dispatch(on_tpu):
     }
 
 
+HBM_BYTES_PER_SEC = {
+    # per-chip HBM bandwidth
+    "v5e": 819e9, "v5litepod": 819e9, "v5p": 2765e9, "v4": 1228e9,
+    "v3": 900e9, "v6e": 1640e9,
+}
+
+
+def hbm_bw(device) -> float:
+    return _device_lookup(device, HBM_BYTES_PER_SEC, 819e9)
+
+
+def bench_decode(on_tpu):
+    """LLM serving decode tokens/s (VERDICT r3 missing #1c): greedy
+    decode on the 1.3B config through the fused single-executable
+    donated-cache scan loop (models/generation.py _build_fused_loop).
+    vs_baseline is measured against the HBM roofline — bs-1 decode is
+    bandwidth-bound (every step streams all weights + the KV cache), so
+    roofline tok/s = b * BW / (param_bytes + b * cache_bytes)."""
+    import jax
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.models.gpt import GPTConfig, num_params
+
+    dev = jax.devices()[0]
+    if on_tpu:
+        kw = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                  num_heads=16, max_position_embeddings=2048,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        prompt_len, n_new, batches = 128, 128, (1, 8)
+    else:
+        kw = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                  num_heads=4, max_position_embeddings=256,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        prompt_len, n_new, batches = 8, 8, (1, 2)
+    cfg = GPTConfig(**kw)
+    model = GPTForCausalLM(cfg).bfloat16()
+    model.eval()
+    n = num_params(cfg)
+    param_bytes = 2.0 * n
+    bw = hbm_bw(dev)
+
+    rng = np.random.default_rng(0)
+    results = {}
+    for b in batches:
+        ids = rng.integers(0, cfg.vocab_size,
+                           (b, prompt_len)).astype(np.int32)
+        import paddle_tpu as pt
+        tids = pt.to_tensor(ids)
+        # warmup compiles prefill + the fused decode loop; generate()'s
+        # 128-wide cache bucketing makes every call below share the SAME
+        # executables (prompt+1 .. prompt+n_new all land in one bucket)
+        generate(model, tids, max_new_tokens=n_new).numpy()
+        generate(model, tids, max_new_tokens=1).numpy()
+
+        def timed(n):
+            t0 = time.perf_counter()
+            generate(model, tids, max_new_tokens=n).numpy()
+            return time.perf_counter() - t0
+
+        # min-of-3 on each leg: the tunnel to the chip is shared, and a
+        # contention spike inside either leg otherwise corrupts the
+        # prefill subtraction
+        t_prefill = min(timed(1) for _ in range(3))
+        t_full = min(timed(n_new) for _ in range(3))
+        dt = max(t_full - t_prefill, 1e-9)
+        tok_s = b * (n_new - 1) / dt
+        # per-step HBM traffic: all weights once + this row's KV cache
+        cache_bytes = (2 * cfg.num_layers * cfg.num_heads * cfg.head_dim
+                       * (prompt_len + n_new) * 2.0)
+        roofline = b * bw / (param_bytes + b * cache_bytes)
+        results[b] = (tok_s, roofline)
+
+    bmain = batches[-1]
+    tok_s, roofline = results[bmain]
+    return {
+        "metric": "gpt_1p3b_decode_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tok_s / roofline, 4),
+        "extra": {
+            "batch": bmain, "prompt_len": prompt_len, "new_tokens": n_new,
+            "params": n, "dtype": "bfloat16",
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "roofline_tokens_per_sec": round(roofline, 1),
+            **{f"bs{b}_tokens_per_sec": round(r[0], 1)
+               for b, r in results.items()},
+            **{f"bs{b}_vs_roofline": round(r[0] / r[1], 4)
+               for b, r in results.items()},
+        },
+    }
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2_small,
     "gpt1p3b": bench_gpt_1p3b,
     "resnet50": bench_resnet50,
     "bert": bench_bert_base,
     "dispatch": bench_dispatch,
+    "decode": bench_decode,
 }
 
 

@@ -240,3 +240,14 @@ def fused_softmax_mask_upper_triangle(x):
     keep = jnp.tril(jnp.ones((s, s), bool))
     z = jnp.where(keep, x.astype(jnp.float32), -1e30)
     return jax.nn.softmax(z, axis=-1).astype(x.dtype)
+
+
+# --- LLM serving / decode family (ref: incubate/nn/functional/
+# masked_multihead_attention.py, block_multihead_attention.py,
+# fused_transformer.py:976, variable_length_memory_efficient_attention.py)
+from .serving import (  # noqa: E402,F401
+    masked_multihead_attention,
+    block_multihead_attention,
+    fused_multi_transformer,
+    variable_length_memory_efficient_attention,
+)

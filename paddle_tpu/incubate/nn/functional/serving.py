@@ -1,0 +1,577 @@
+"""LLM serving / decode-phase fused attention family.
+
+Capability match for the reference's inference-deployment ops:
+  - masked_multihead_attention
+    (ref: python/paddle/incubate/nn/functional/masked_multihead_attention.py:19)
+  - block_multihead_attention — paged KV cache
+    (ref: python/paddle/incubate/nn/functional/block_multihead_attention.py:19)
+  - fused_multi_transformer — whole-stack serving transformer
+    (ref: python/paddle/incubate/nn/functional/fused_transformer.py:976)
+  - variable_length_memory_efficient_attention
+    (ref: .../variable_length_memory_efficient_attention.py:28)
+
+TPU-native design notes (NOT a translation of the CUDA kernels):
+  - Every op is a pure jnp function with STATIC shapes: caches are
+    preallocated at max length (dense [2,B,H,max_seq,D] or paged
+    [max_blocks, kvH, block_size, D]) and written with XLA scatters, so
+    one compiled executable serves every step of a decode loop.
+    In-place semantics come from buffer donation at the jit boundary
+    (models/generation.py donates the cache pytree), which XLA turns
+    into a true aliased update — the TPU analog of the reference's
+    `_C_ops.masked_multihead_attention_` inplace contract.
+  - The decode-step attention (1 query token against a padded cache) is
+    bandwidth-bound, not MXU-bound: it is expressed as two einsums over
+    the padded cache with position masking, which XLA fuses into a
+    single pass over HBM. A Pallas kernel buys nothing at seq<=8k/step;
+    the win is fusing the WHOLE step (all layers) into one executable.
+  - Quantised-cache variants (qkv_out_scale / cache_k_quant_scales...)
+    raise: weight-only quant lives in nn.quant; KV-cache int8 is a
+    documented exclusion (README).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+__all__ = [
+    "masked_multihead_attention",
+    "block_multihead_attention",
+    "fused_multi_transformer",
+    "variable_length_memory_efficient_attention",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return None if x is None else jnp.asarray(x)
+
+
+def _wrap(x):
+    return Tensor._wrap(x)
+
+
+def _check_no_quant(**kw):
+    bad = [k for k, v in kw.items() if v is not None and v is not False]
+    if bad:
+        raise NotImplementedError(
+            f"quantised-cache serving arguments {bad} are not supported: "
+            "weight-only quantisation lives in paddle_tpu.nn.quant; int8 "
+            "KV caches are a documented exclusion (README)")
+
+
+def _apply_rotary(x, cos, sin, neox):
+    """x: [..., D]; cos/sin: [..., D//2]. neox=True rotates split halves
+    (GPT-NeoX), else adjacent pairs (GPT-J / interleaved)."""
+    d = x.shape[-1]
+    if neox:
+        x1, x2 = x[..., : d // 2], x[..., d // 2:]
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.reshape(x.shape)
+
+
+def _decode_attn_core(q, kc, vc, t, src_mask=None):
+    """Shared decode-attention core: one query token per row against a
+    padded dense cache. q: [B,H,D]; kc/vc: [B,H,L,D]; t: [B] int32 (the
+    position just written, i.e. attend to k-positions <= t).
+    src_mask: additive [B,1,1,Lm] (Lm <= L), reference semantics.
+    f32 accumulation regardless of input dtype."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * scale
+    L = kc.shape[2]
+    kpos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    valid = kpos <= t[:, None]
+    if src_mask is not None:
+        m = src_mask.astype(jnp.float32)[:, 0, 0, :]
+        pad = L - m.shape[-1]
+        if pad > 0:
+            m = jnp.pad(m, ((0, 0), (0, pad)))
+        s = s + m[:, None, :]
+    s = jnp.where(valid[:, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhl,bhld->bhd", p, vc.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def masked_multihead_attention(
+    x,
+    cache_kv=None,
+    bias=None,
+    src_mask=None,
+    cum_offsets=None,
+    sequence_lengths=None,
+    rotary_tensor=None,
+    beam_cache_offset=None,
+    qkv_out_scale=None,
+    out_shift=None,
+    out_smooth=None,
+    seq_len=1,
+    rotary_emb_dims=0,
+    use_neox_rotary_style=False,
+    compute_dtype="default",
+    out_scale=-1,
+    quant_round_type=1,
+    quant_max_bound=127.0,
+    quant_min_bound=-127.0,
+):
+    """Decode-phase masked MHA with an in-place dense KV cache.
+
+    x: [B, 3*H*D] (this step's fused qkv); cache_kv: [2, B, H, max_seq, D].
+    sequence_lengths [B,1]: tokens already cached per row (the write
+    position); if None the position is src_mask.shape[-1] - 1 (the
+    reference's decode convention: src_mask covers the prefix + self).
+    Returns (out [B, H*D], cache_kv_out) — cache_kv_out aliases cache_kv
+    when the caller donates it at a jit boundary.
+    ref: masked_multihead_attention.py:19."""
+    _check_no_quant(beam_cache_offset=beam_cache_offset,
+                    qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+                    out_smooth=out_smooth)
+    xv = _arr(x)
+    cache = _arr(cache_kv)
+    if cache is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    _, B, H, L, D = cache.shape
+    qkv = xv.reshape(B, 3, H, D)
+    bv = _arr(bias)
+    if bv is not None:
+        qkv = qkv + bv.reshape(1, 3, H, D).astype(qkv.dtype)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+
+    sl = _arr(sequence_lengths)
+    if sl is not None:
+        t = sl.reshape(-1).astype(jnp.int32)
+    elif src_mask is not None:
+        t = jnp.full((B,), _arr(src_mask).shape[-1] - 1, jnp.int32)
+    else:
+        raise ValueError(
+            "masked_multihead_attention needs sequence_lengths or "
+            "src_mask to locate the decode position")
+
+    if rotary_tensor is not None and rotary_emb_dims > 0:
+        # rotary_tensor: [B, 1, 1, max_seq, D] (cos∥sin packed per the
+        # reference layout: first half cos, second half sin of D//2 dims)
+        rt = _arr(rotary_tensor).astype(jnp.float32)
+        rows = rt[jnp.arange(B), 0, 0, t]            # [B, D]
+        cos, sin = rows[:, : D // 2], rows[:, D // 2:]
+        q = _apply_rotary(q, cos[:, None, :], sin[:, None, :],
+                          use_neox_rotary_style).astype(q.dtype)
+        k = _apply_rotary(k, cos[:, None, :], sin[:, None, :],
+                          use_neox_rotary_style).astype(k.dtype)
+
+    bidx = jnp.arange(B)
+    kc = cache[0].at[bidx, :, t, :].set(k.astype(cache.dtype))
+    vc = cache[1].at[bidx, :, t, :].set(v.astype(cache.dtype))
+    out = _decode_attn_core(q, kc, vc, t, src_mask=_arr(src_mask))
+    cache_out = jnp.stack([kc, vc])
+    return _wrap(out.reshape(B, H * D)), _wrap(cache_out)
+
+
+def _paged_gather(cache, block_tables):
+    """cache: [NB, kvH, bs, D]; block_tables: [B, npb] -> [B, kvH, C, D]
+    with C = npb*bs. Invalid table entries (<0) read block 0; callers
+    mask by length so the garbage is never attended to."""
+    B, npb = block_tables.shape
+    nb, kvH, bs, D = cache.shape
+    tbl = jnp.maximum(block_tables, 0)
+    g = cache[tbl]                       # [B, npb, kvH, bs, D]
+    g = jnp.transpose(g, (0, 2, 1, 3, 4))
+    return g.reshape(B, kvH, npb * bs, D)
+
+
+def block_multihead_attention(
+    qkv,
+    key_cache,
+    value_cache,
+    seq_lens_encoder,
+    seq_lens_decoder,
+    seq_lens_this_time,
+    padding_offsets,
+    cum_offsets,
+    cu_seqlens_q,
+    cu_seqlens_k,
+    block_tables,
+    pre_key_cache=None,
+    pre_value_cache=None,
+    cache_k_quant_scales=None,
+    cache_v_quant_scales=None,
+    cache_k_dequant_scales=None,
+    cache_v_dequant_scales=None,
+    qkv_out_scale=None,
+    qkv_bias=None,
+    out_shift=None,
+    out_smooth=None,
+    rope_emb=None,
+    mask=None,
+    tgt_mask=None,
+    max_seq_len=-1,
+    block_size=64,
+    use_neox_style=False,
+    use_dynamic_cachekv_quant=False,
+    quant_round_type=1,
+    quant_max_bound=127.0,
+    quant_min_bound=-127.0,
+    out_scale=-1,
+    compute_dtype="default",
+):
+    """Paged-KV-cache attention (vLLM-style block tables), prefill and
+    decode phases in one op.
+
+    qkv: [token_num, (H + 2*kvH) * D] packed (no padding) — sequences
+    concatenated per cu_seqlens_q. key_cache/value_cache:
+    [max_block_num, kvH, block_size, D]. block_tables: [B, blocks_per_seq]
+    maps each sequence's logical pages to physical blocks (-1 = unmapped).
+    Row semantics (reference contract): a row with seq_lens_encoder[b]>0
+    is a prefill row writing positions 0..len-1; a decode row appends ONE
+    token at position seq_lens_decoder[b]. Both reduce to: this step's
+    tokens occupy global positions seq_lens_decoder[b] + [0, stt).
+    Causal masking by GLOBAL position is always applied; `mask`/`tgt_mask`
+    add on top (additive, reference semantics).
+    Returns (out [token_num, H*D], qkv, key_cache_out, value_cache_out).
+    ref: block_multihead_attention.py:19."""
+    _check_no_quant(
+        cache_k_quant_scales=cache_k_quant_scales,
+        cache_v_quant_scales=cache_v_quant_scales,
+        cache_k_dequant_scales=cache_k_dequant_scales,
+        cache_v_dequant_scales=cache_v_dequant_scales,
+        qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+        out_smooth=out_smooth,
+        use_dynamic_cachekv_quant=use_dynamic_cachekv_quant)
+    if pre_key_cache is not None or pre_value_cache is not None:
+        raise NotImplementedError(
+            "pre_key_cache/pre_value_cache (prompt-tuning prefix) is not "
+            "supported; prepend the prefix to the prompt instead")
+
+    qkvv = _arr(qkv)
+    kcache, vcache = _arr(key_cache), _arr(value_cache)
+    nb, kvH, bs, D = kcache.shape
+    if bs != block_size:
+        raise ValueError(
+            f"block_size arg ({block_size}) disagrees with the cache "
+            f"layout ({bs})")
+    T = qkvv.shape[0]
+    H = qkvv.shape[1] // D - 2 * kvH
+    if H <= 0 or H % kvH:
+        raise ValueError(
+            f"qkv width {qkvv.shape[1]} inconsistent with kv heads "
+            f"{kvH} and head_size {D}")
+    if qkv_bias is not None:
+        qkvv = qkvv + _arr(qkv_bias).reshape(1, -1).astype(qkvv.dtype)
+    qt = qkvv[:, : H * D].reshape(T, H, D)
+    kt = qkvv[:, H * D: (H + kvH) * D].reshape(T, kvH, D)
+    vt = qkvv[:, (H + kvH) * D:].reshape(T, kvH, D)
+
+    cu_q = _arr(cu_seqlens_q).reshape(-1).astype(jnp.int32)
+    B = cu_q.shape[0] - 1
+    dec = _arr(seq_lens_decoder).reshape(-1).astype(jnp.int32)
+    tbl = _arr(block_tables).astype(jnp.int32)
+    npb = tbl.shape[1]
+    C = npb * bs
+
+    # --- token geometry (packed -> (row, global position)) ---
+    tok = jnp.arange(T, dtype=jnp.int32)
+    row = jnp.searchsorted(cu_q, tok, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, B - 1)
+    local = tok - cu_q[row]
+    gpos = dec[row] + local                        # global cache position
+    live = tok < cu_q[-1]                          # packed => all live
+
+    if rope_emb is not None:
+        # [2, B, max_seq, 1, D//2]: [0]=cos, [1]=sin at global positions
+        re = _arr(rope_emb).astype(jnp.float32)
+        cos = re[0, row, gpos, 0]                  # [T, D//2]
+        sin = re[1, row, gpos, 0]
+        qt = _apply_rotary(qt, cos[:, None, :], sin[:, None, :],
+                           use_neox_style).astype(qt.dtype)
+        kt = _apply_rotary(kt, cos[:, None, :], sin[:, None, :],
+                           use_neox_style).astype(kt.dtype)
+
+    # --- cache write: one scatter per cache ---
+    page = jnp.clip(gpos // bs, 0, npb - 1)
+    phys = jnp.maximum(tbl[row, page], 0)
+    slot = gpos % bs
+    # dead tokens (past cu_seqlens[-1], only possible if the caller
+    # padded the packed layout) scatter out-of-bounds -> XLA drops them
+    phys = jnp.where(live, phys, nb)
+    kcache = kcache.at[phys, :, slot, :].set(kt.astype(kcache.dtype))
+    vcache = vcache.at[phys, :, slot, :].set(vt.astype(vcache.dtype))
+
+    # --- attention: padded [B, Smax, H, D] q against gathered pages ---
+    # Smax (static padded step width): concrete cu_seqlens give the
+    # exact max; under a trace fall back to max_seq_len (or T)
+    import numpy as _np
+    if not isinstance(cu_q, jax.core.Tracer):
+        Smax = max(1, int(_np.max(_np.diff(_np.asarray(cu_q)))))
+    elif max_seq_len > 0:
+        Smax = min(int(T), int(max_seq_len))
+    else:
+        Smax = int(T)
+    qpad = jnp.zeros((B, Smax, H, D), qt.dtype)
+    lpos = jnp.where((local < Smax) & live, local, Smax)  # OOB -> drop
+    qpad = qpad.at[row, lpos].set(qt)
+    kctx = _paged_gather(kcache, tbl)              # [B, kvH, C, D]
+    vctx = _paged_gather(vcache, tbl)
+    rep = H // kvH
+    kctx = jnp.repeat(kctx, rep, axis=1)
+    vctx = jnp.repeat(vctx, rep, axis=1)
+
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bshd,bhcd->bhsc", qpad.astype(jnp.float32),
+                   kctx.astype(jnp.float32)) * scale
+    cpos = jnp.arange(C, dtype=jnp.int32)
+    qg = dec[:, None] + jnp.arange(Smax, dtype=jnp.int32)[None, :]
+    causal = cpos[None, None, :] <= qg[:, :, None]     # [B, Smax, C]
+    if mask is not None:
+        mv = _arr(mask).astype(jnp.float32)        # [B,1,Sq,Sk] additive
+        s = s + mv[:, :, :Smax, :C]
+    if tgt_mask is not None:
+        tm = _arr(tgt_mask).astype(jnp.float32)    # [B,1,1,Sk] additive
+        s = s + tm[:, :, :, :C]
+    s = jnp.where(causal[:, None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    opad = jnp.einsum("bhsc,bhcd->bshd", p, vctx.astype(jnp.float32))
+    out = opad[row, jnp.minimum(local, Smax - 1)]  # [T, H, D]
+    out = out.astype(qt.dtype).reshape(T, H * D)
+    return (_wrap(out), _wrap(qkvv), _wrap(kcache), _wrap(vcache))
+
+
+def variable_length_memory_efficient_attention(
+    query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+    causal=False, pre_cache_length=0,
+):
+    """Batched attention with per-row q/kv lengths over padded inputs.
+
+    query/key/value: [B, H, S, D] (the reference example layout); rows
+    beyond seq_lens produce zeros. GQA allowed (key/value may have fewer
+    heads). ref: variable_length_memory_efficient_attention.py:28."""
+    q, k, v = _arr(query), _arr(key), _arr(value)
+    if pre_cache_length:
+        raise NotImplementedError(
+            "pre_cache_length: prepend the pre-cache to key/value")
+    B, H, Sq, D = q.shape
+    kvH, Sk = k.shape[1], k.shape[2]
+    if H != kvH:
+        rep = H // kvH
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if scale is None:
+        scale = float(1.0 / math.sqrt(D))
+    ql = _arr(seq_lens).reshape(-1).astype(jnp.int32)
+    kl = _arr(kv_seq_lens).reshape(-1).astype(jnp.int32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = s + _arr(mask).astype(jnp.float32)
+    qpos = jnp.arange(Sq, dtype=jnp.int32)
+    kpos = jnp.arange(Sk, dtype=jnp.int32)
+    valid = kpos[None, None, :] < kl[:, None, None]      # [B,1,Sk]
+    valid = jnp.broadcast_to(valid, (B, Sq, Sk))
+    if causal:
+        # bottom-right alignment (FA2 convention): the LAST q row sees
+        # the last k row; row i sees k <= i + (kl - ql)
+        off = (kl - ql)[:, None, None]
+        valid = valid & (kpos[None, None, :]
+                         <= qpos[None, :, None] + off)
+    s = jnp.where(valid[:, None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)      # fully-masked rows -> 0
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    qvalid = qpos[None, None, :, None] < ql[:, None, None, None]
+    out = jnp.where(qvalid, out, 0.0)
+    return _wrap(out.astype(q.dtype))
+
+
+def _act(name, x):
+    if name == "gelu":
+        return jax.nn.gelu(x)
+    if name == "relu":
+        return jax.nn.relu(x)
+    if name in ("swiglu", "silu"):
+        return jax.nn.silu(x)
+    raise ValueError(f"unsupported activation {name!r}")
+
+
+def fused_multi_transformer(
+    x,
+    ln_scales,
+    ln_biases,
+    qkv_weights,
+    qkv_biases,
+    linear_weights,
+    linear_biases,
+    ffn_ln_scales,
+    ffn_ln_biases,
+    ffn1_weights,
+    ffn1_biases,
+    ffn2_weights,
+    ffn2_biases,
+    pre_layer_norm=True,
+    epsilon=1e-5,
+    cache_kvs=None,
+    pre_caches=None,
+    seq_lens=None,
+    rotary_embs=None,
+    time_step=None,
+    attn_mask=None,
+    dropout_rate=0.0,
+    rotary_emb_dims=0,
+    activation="gelu",
+    training=False,
+    mode="upscale_in_train",
+    trans_qkvw=True,
+    ring_id=-1,
+    name=None,
+):
+    """Whole-stack serving transformer: N pre/post-LN blocks with fused
+    qkv attention + cached decode, one call.
+
+    Prefill (time_step None): x is [B, S, d_model]; every layer's k/v is
+    written to cache_kvs[i][:, :, :, :S]. Decode (time_step = scalar
+    Tensor): x is [B, 1, d_model] and attention runs against the cache
+    through the same core as masked_multihead_attention. Dropout is
+    inference-off (training=True + dropout_rate>0 raises: this op is the
+    serving path). ref: fused_transformer.py:976.
+    """
+    if training and dropout_rate > 0.0:
+        raise NotImplementedError(
+            "fused_multi_transformer is the serving path: "
+            "training-mode dropout is not supported")
+    if pre_caches is not None:
+        raise NotImplementedError(
+            "pre_caches (prompt-tuning prefix) is not supported")
+    if ring_id != -1:
+        raise NotImplementedError(
+            "ring_id tensor-parallel serving: build the layer under "
+            "fleet.meta_parallel instead (mp layers + collectives)")
+
+    h = _arr(x)
+    B, S, dm = h.shape
+    nlayers = len(ln_scales)
+    decode = time_step is not None
+    if decode:
+        ts = _arr(time_step).reshape(()).astype(jnp.int32)
+    sl = None if seq_lens is None else \
+        _arr(seq_lens).reshape(-1).astype(jnp.int32)
+    am = None if attn_mask is None else _arr(attn_mask)
+
+    def dense(a, w, b=None):
+        # operands stay in the weight dtype (bf16 weights run on the
+        # MXU at bf16 rate); accumulation is forced to f32
+        wv = _arr(w)
+        out = jnp.einsum("bsd,df->bsf", a.astype(wv.dtype), wv,
+                         preferred_element_type=jnp.float32)
+        if b is not None:
+            out = out + _arr(b).astype(jnp.float32)
+        return out
+
+    def lnorm(a, scale, bias_):
+        mu = jnp.mean(a, axis=-1, keepdims=True)
+        var = jnp.var(a, axis=-1, keepdims=True)
+        out = (a - mu) * jax.lax.rsqrt(var + epsilon)
+        if scale is not None:
+            out = out * _arr(scale).astype(jnp.float32)
+        if bias_ is not None:
+            out = out + _arr(bias_).astype(jnp.float32)
+        return out
+
+    new_caches = []
+    hf = h.astype(jnp.float32)
+    for i in range(nlayers):
+        ln_b = ln_biases[i] if ln_biases is not None else None
+        residual = hf
+        a = lnorm(hf, ln_scales[i], ln_b) if pre_layer_norm else hf
+        qkw = _arr(qkv_weights[i])
+        if trans_qkvw:
+            # [3, H, D, dm] — the reference's transposed layout
+            _, H, D, _ = qkw.shape
+            qkv = jnp.einsum("bsd,thed->bsthe", a.astype(qkw.dtype),
+                             qkw, preferred_element_type=jnp.float32)
+        else:
+            # [dm, 3, H, D]
+            _, _, H, D = qkw.shape
+            qkv = jnp.einsum("bsd,dthe->bsthe", a.astype(qkw.dtype),
+                             qkw, preferred_element_type=jnp.float32)
+        if qkv_biases is not None and qkv_biases[i] is not None:
+            qkv = qkv + _arr(qkv_biases[i]).astype(jnp.float32)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,H,D]
+
+        if rotary_embs is not None and rotary_emb_dims > 0:
+            # [2, B, 1, max_seq, D or D//2]: [0]=cos, [1]=sin; last dim
+            # D//2 holds per-pair frequencies, D means pair-duplicated
+            # (first half used)
+            re = _arr(rotary_embs).astype(jnp.float32)
+            if decode:
+                pos = jnp.broadcast_to(ts, (B,))[:, None] \
+                    + jnp.arange(S)[None, :]
+            else:
+                pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+            bi = jnp.arange(B)[:, None]
+            cos = re[0, bi, 0, pos][..., : D // 2]      # [B, S, D//2]
+            sin = re[1, bi, 0, pos][..., : D // 2]
+            q = _apply_rotary(q, cos[:, :, None, :], sin[:, :, None, :],
+                              False)
+            k = _apply_rotary(k, cos[:, :, None, :], sin[:, :, None, :],
+                              False)
+
+        cache = None if cache_kvs is None else _arr(cache_kvs[i])
+        if decode:
+            if cache is None:
+                raise ValueError("decode (time_step) requires cache_kvs")
+            t = jnp.broadcast_to(ts, (B,))
+            kc = cache[0].at[jnp.arange(B), :, t, :].set(
+                jnp.transpose(k, (0, 2, 1, 3))[:, :, 0].astype(cache.dtype))
+            vc = cache[1].at[jnp.arange(B), :, t, :].set(
+                jnp.transpose(v, (0, 2, 1, 3))[:, :, 0].astype(cache.dtype))
+            ao = _decode_attn_core(q[:, 0].astype(jnp.float32), kc, vc, t,
+                                   src_mask=am)
+            attn_out = ao[:, None]                    # [B,1,H,D]
+            new_caches.append(jnp.stack([kc, vc]))
+        else:
+            if cache is not None:
+                kc = jax.lax.dynamic_update_slice(
+                    cache[0], jnp.transpose(k, (0, 2, 1, 3))
+                    .astype(cache.dtype), (0, 0, 0, 0))
+                vc = jax.lax.dynamic_update_slice(
+                    cache[1], jnp.transpose(v, (0, 2, 1, 3))
+                    .astype(cache.dtype), (0, 0, 0, 0))
+                new_caches.append(jnp.stack([kc, vc]))
+            scale = 1.0 / math.sqrt(D)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            if am is not None:
+                s = s + am.astype(jnp.float32)[:, :, :S, :S]
+            else:
+                cm = jnp.tril(jnp.ones((S, S), bool))
+                s = jnp.where(cm[None, None], s, -jnp.inf)
+            if sl is not None:
+                kv_ok = jnp.arange(S)[None, :] < sl[:, None]
+                s = jnp.where(kv_ok[:, None, None, :], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            attn_out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        lw = linear_weights[i]
+        lb = linear_biases[i] if linear_biases is not None else None
+        proj = dense(attn_out.reshape(B, S, H * D), lw, lb)
+        hf = residual + proj
+        if not pre_layer_norm:
+            hf = lnorm(hf, ln_scales[i], ln_b)
+
+        ffn_b = ffn_ln_biases[i] if ffn_ln_biases is not None else None
+        residual = hf
+        a = lnorm(hf, ffn_ln_scales[i], ffn_b) if pre_layer_norm else hf
+        f1b = ffn1_biases[i] if ffn1_biases is not None else None
+        f2b = ffn2_biases[i] if ffn2_biases is not None else None
+        a = _act(activation, dense(a, ffn1_weights[i], f1b))
+        hf = residual + dense(a, ffn2_weights[i], f2b)
+        if not pre_layer_norm:
+            hf = lnorm(hf, ffn_ln_scales[i], ffn_b)
+
+    out = _wrap(hf.astype(h.dtype))
+    if cache_kvs is not None:
+        return out, [_wrap(c) for c in new_caches]
+    return out

@@ -119,12 +119,14 @@ def _take_eager_check(x, index, mode="raise"):
     if mode != "raise":
         return
     n = int(np.prod(x.shape))
-    idx = np.asarray(index)
-    if idx.size and (int(idx.min()) < -n or int(idx.max()) >= n):
+    if not getattr(index, "size", 1):
+        return
+    # reduce on-device, sync only two scalars (no full D2H copy)
+    lo, hi = int(jnp.min(index)), int(jnp.max(index))
+    if lo < -n or hi >= n:
         raise IndexError(
             f"take(mode='raise'): index out of range for input with "
-            f"{n} elements (got range [{int(idx.min())}, "
-            f"{int(idx.max())}])")
+            f"{n} elements (got range [{lo}, {hi}])")
 
 
 take.op_def.eager_check = _take_eager_check

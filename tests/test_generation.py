@@ -70,3 +70,20 @@ def test_length_guard(model):
     prompt = np.zeros((1, 250), np.int32)
     with pytest.raises(ValueError, match="max_position_embeddings"):
         model.generate(pt.to_tensor(prompt), max_new_tokens=10)
+
+
+def test_fused_step_matches_eager_path(model):
+    """The single-executable donated-buffer decode step must reproduce
+    the per-op eager decode exactly (greedy and seeded top-p)."""
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 1024, (2, 5)).astype(np.int32)
+    for kw in ({"do_sample": False},
+               {"do_sample": True, "top_p": 0.9, "seed": 11},
+               {"do_sample": False, "eos_token_id": 13}):
+        fused = generate(model, pt.to_tensor(prompt), max_new_tokens=8,
+                         use_fused_step=True, **kw)
+        eager = generate(model, pt.to_tensor(prompt), max_new_tokens=8,
+                         use_fused_step=False, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(fused._data), np.asarray(eager._data),
+            err_msg=f"fused/eager decode diverged for {kw}")

@@ -1,0 +1,368 @@
+"""Serving/decode fused-attention family conformance tests.
+
+Each op is checked against a straightforward dense SDPA oracle computed
+with numpy/jnp — the same strategy the reference uses in
+test/legacy_test/test_block_multihead_attention.py (naive_attention_impl).
+"""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as F
+
+
+def _sdpa(q, k, v, causal_offset=None, lens=None):
+    """q: [B,H,Sq,D], k/v: [B,H,Sk,D] numpy f32. lens masks k columns.
+    causal_offset: per-row int — k col j visible to q row i iff
+    j <= i + off."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+    mask = np.ones((B, Sq, Sk), bool)
+    if lens is not None:
+        mask &= np.arange(Sk)[None, None, :] < np.asarray(lens)[:, None, None]
+    if causal_offset is not None:
+        off = np.asarray(causal_offset).reshape(B, 1, 1)
+        mask &= np.arange(Sk)[None, None, :] <= \
+            np.arange(Sq)[None, :, None] + off
+    s = np.where(mask[:, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    p = np.nan_to_num(p)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+class TestMaskedMultiheadAttention:
+    B, H, D, L = 2, 4, 16, 32
+
+    def _mk(self, t_np, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((self.B, 3 * self.H * self.D)).astype(
+            np.float32)
+        cache = rng.standard_normal(
+            (2, self.B, self.H, self.L, self.D)).astype(np.float32)
+        # zero out positions >= t so the oracle sees the same context
+        for b, t in enumerate(t_np):
+            cache[:, b, :, t:] = 0.0
+        return x, cache
+
+    def _oracle(self, x, cache, t_np):
+        B, H, D, L = self.B, self.H, self.D, self.L
+        qkv = x.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        kc, vc = cache[0].copy(), cache[1].copy()
+        for b, t in enumerate(t_np):
+            kc[b, :, t] = k[b]
+            vc[b, :, t] = v[b]
+        out = _sdpa(q[:, :, None], kc, vc,
+                    lens=np.asarray(t_np) + 1)
+        return out[:, :, 0].reshape(B, H * D), np.stack([kc, vc])
+
+    def test_matches_oracle_with_sequence_lengths(self):
+        t_np = [5, 17]
+        x, cache = self._mk(t_np)
+        want_out, want_cache = self._oracle(x, cache, t_np)
+        out, cache_out = F.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(
+                np.asarray(t_np, np.int32).reshape(-1, 1)))
+        np.testing.assert_allclose(np.asarray(out._data), want_out,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cache_out._data),
+                                   want_cache, rtol=1e-6, atol=1e-6)
+
+    def test_src_mask_position_and_additive(self):
+        t = 9
+        x, cache = self._mk([t, t], seed=1)
+        # additive src_mask covering prefix + self, one row half-masked
+        sm = np.zeros((self.B, 1, 1, t + 1), np.float32)
+        sm[1, 0, 0, :4] = -1e9
+        out, _ = F.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            src_mask=paddle.to_tensor(sm))
+        qkv = x.reshape(self.B, 3, self.H, self.D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        kc, vc = cache[0].copy(), cache[1].copy()
+        kc[:, :, t] = k
+        vc[:, :, t] = v
+        # oracle: rows 4.. only for batch 1
+        kc1, vc1 = kc.copy(), vc.copy()
+        want0 = _sdpa(q[0:1, :, None], kc1[0:1], vc1[0:1],
+                      lens=[t + 1])[0, :, 0]
+        want1 = _sdpa(q[1:2, :, None, :],
+                      kc1[1:2, :, 4:t + 1], vc1[1:2, :, 4:t + 1])[0, :, 0]
+        got = np.asarray(out._data).reshape(self.B, self.H, self.D)
+        np.testing.assert_allclose(got[0], want0, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(got[1], want1, rtol=1e-5, atol=1e-5)
+
+    def test_rotary(self):
+        t_np = [3, 3]
+        x, cache = self._mk(t_np, seed=2)
+        D = self.D
+        inv = 1.0 / (10000 ** (np.arange(0, D, 2) / D))
+        pos = np.arange(self.L)[:, None] * inv[None, :]
+        rt = np.zeros((self.B, 1, 1, self.L, D), np.float32)
+        rt[:, 0, 0, :, : D // 2] = np.cos(pos)
+        rt[:, 0, 0, :, D // 2:] = np.sin(pos)
+        out, _ = F.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(
+                np.asarray(t_np, np.int32).reshape(-1, 1)),
+            rotary_tensor=paddle.to_tensor(rt), rotary_emb_dims=1)
+        assert np.isfinite(np.asarray(out._data)).all()
+        # neox style differs from interleaved on the same inputs
+        out2, _ = F.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(
+                np.asarray(t_np, np.int32).reshape(-1, 1)),
+            rotary_tensor=paddle.to_tensor(rt), rotary_emb_dims=1,
+            use_neox_rotary_style=True)
+        assert not np.allclose(np.asarray(out._data),
+                               np.asarray(out2._data))
+
+    def test_quant_args_raise(self):
+        x, cache = self._mk([1, 1])
+        with pytest.raises(NotImplementedError):
+            F.masked_multihead_attention(
+                paddle.to_tensor(x), paddle.to_tensor(cache),
+                sequence_lengths=paddle.to_tensor(
+                    np.ones((2, 1), np.int32)),
+                qkv_out_scale=paddle.to_tensor(np.ones(3, np.float32)))
+
+
+def _mk_block_inputs(lens_this_time, dec_lens, kvH, H, D, bs, npb,
+                     seed=0):
+    """Build packed qkv + paged caches for a batch of rows."""
+    rng = np.random.default_rng(seed)
+    B = len(lens_this_time)
+    T = int(sum(lens_this_time))
+    qkv = rng.standard_normal((T, (H + 2 * kvH) * D)).astype(np.float32)
+    nb = B * npb + 1
+    kcache = np.zeros((nb, kvH, bs, D), np.float32)
+    vcache = np.zeros((nb, kvH, bs, D), np.float32)
+    tbl = -np.ones((B, npb), np.int32)
+    for b in range(B):
+        for p in range(npb):
+            tbl[b, p] = 1 + b * npb + p  # block 0 left as garbage trap
+    cu = np.zeros(B + 1, np.int32)
+    cu[1:] = np.cumsum(lens_this_time)
+    return qkv, kcache, vcache, tbl, cu
+
+
+class TestBlockMultiheadAttention:
+    def test_prefill_matches_causal_sdpa(self):
+        B, H, kvH, D, bs, npb, S = 2, 4, 4, 16, 8, 4, 10
+        qkv, kc, vc, tbl, cu = _mk_block_inputs([S, S], [0, 0],
+                                                kvH, H, D, bs, npb)
+        enc = np.full((B, 1), S, np.int32)
+        dec = np.zeros((B, 1), np.int32)
+        stt = np.full((B, 1), S, np.int32)
+        out, _, kco, vco = F.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc),
+            paddle.to_tensor(vc), paddle.to_tensor(enc),
+            paddle.to_tensor(dec), paddle.to_tensor(stt),
+            None, None, paddle.to_tensor(cu), paddle.to_tensor(cu),
+            paddle.to_tensor(tbl), max_seq_len=S, block_size=bs)
+        # oracle
+        q = qkv[:, :H * D].reshape(T := 2 * S, H, D)
+        k = qkv[:, H * D:(H + kvH) * D].reshape(T, kvH, D)
+        v = qkv[:, (H + kvH) * D:].reshape(T, kvH, D)
+        for b in range(B):
+            qb = np.transpose(q[b * S:(b + 1) * S], (1, 0, 2))[None]
+            kb = np.transpose(k[b * S:(b + 1) * S], (1, 0, 2))[None]
+            vb = np.transpose(v[b * S:(b + 1) * S], (1, 0, 2))[None]
+            want = _sdpa(qb, kb, vb, causal_offset=[0])[0]  # [H,S,D]
+            got = np.asarray(out._data)[b * S:(b + 1) * S].reshape(
+                S, H, D).transpose(1, 0, 2)
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        # cache got the k/v tokens at the right pages
+        kcon = np.asarray(kco._data)
+        for b in range(B):
+            for i in range(S):
+                blk, slot = tbl[b, i // bs], i % bs
+                np.testing.assert_allclose(
+                    kcon[blk, :, slot], k[b * S + i], rtol=1e-6)
+
+    def test_decode_step_appends_and_attends(self):
+        B, H, kvH, D, bs, npb = 2, 4, 2, 8, 4, 3   # GQA 2:1
+        prior = [5, 9]
+        qkv, kc, vc, tbl, cu = _mk_block_inputs(
+            [1, 1], prior, kvH, H, D, bs, npb, seed=3)
+        rng = np.random.default_rng(7)
+        # pre-populate caches with the prior tokens
+        hist_k = rng.standard_normal((B, max(prior), kvH, D)).astype(
+            np.float32)
+        hist_v = rng.standard_normal((B, max(prior), kvH, D)).astype(
+            np.float32)
+        for b in range(B):
+            for i in range(prior[b]):
+                kc[tbl[b, i // bs], :, i % bs] = hist_k[b, i]
+                vc[tbl[b, i // bs], :, i % bs] = hist_v[b, i]
+        enc = np.zeros((B, 1), np.int32)
+        dec = np.asarray(prior, np.int32).reshape(B, 1)
+        stt = np.ones((B, 1), np.int32)
+        out, _, kco, vco = F.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc),
+            paddle.to_tensor(vc), paddle.to_tensor(enc),
+            paddle.to_tensor(dec), paddle.to_tensor(stt),
+            None, None, paddle.to_tensor(cu), paddle.to_tensor(cu),
+            paddle.to_tensor(tbl), max_seq_len=1, block_size=bs)
+        q = qkv[:, :H * D].reshape(B, H, D)
+        knew = qkv[:, H * D:(H + kvH) * D].reshape(B, kvH, D)
+        vnew = qkv[:, (H + kvH) * D:].reshape(B, kvH, D)
+        got = np.asarray(out._data).reshape(B, H, D)
+        for b in range(B):
+            ctx_k = np.concatenate([hist_k[b, :prior[b]],
+                                    knew[b][None]], 0)  # [t+1,kvH,D]
+            ctx_v = np.concatenate([hist_v[b, :prior[b]],
+                                    vnew[b][None]], 0)
+            rep = H // kvH
+            ck = np.repeat(np.transpose(ctx_k, (1, 0, 2)), rep, 0)[None]
+            cv = np.repeat(np.transpose(ctx_v, (1, 0, 2)), rep, 0)[None]
+            want = _sdpa(q[b][None, :, None], ck, cv)[0, :, 0]
+            np.testing.assert_allclose(got[b], want, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_rope_changes_output(self):
+        B, H, kvH, D, bs, npb, S = 1, 2, 2, 8, 4, 2, 4
+        qkv, kc, vc, tbl, cu = _mk_block_inputs([S], [0], kvH, H, D,
+                                                bs, npb)
+        enc = np.full((B, 1), S, np.int32)
+        dec = np.zeros((B, 1), np.int32)
+        stt = np.full((B, 1), S, np.int32)
+        rope = np.zeros((2, B, bs * npb, 1, D // 2), np.float32)
+        inv = 1.0 / (10000 ** (np.arange(0, D, 2) / D))
+        pos = np.arange(bs * npb)[:, None] * inv[None, :]
+        rope[0, :, :, 0] = np.cos(pos)
+        rope[1, :, :, 0] = np.sin(pos)
+        args = (paddle.to_tensor(qkv), paddle.to_tensor(kc),
+                paddle.to_tensor(vc), paddle.to_tensor(enc),
+                paddle.to_tensor(dec), paddle.to_tensor(stt),
+                None, None, paddle.to_tensor(cu), paddle.to_tensor(cu),
+                paddle.to_tensor(tbl))
+        base, *_ = F.block_multihead_attention(
+            *args, max_seq_len=S, block_size=bs)
+        roped, *_ = F.block_multihead_attention(
+            *args, rope_emb=paddle.to_tensor(rope), max_seq_len=S,
+            block_size=bs)
+        assert not np.allclose(np.asarray(base._data),
+                               np.asarray(roped._data))
+
+
+class TestVariableLengthMemEffAttention:
+    def test_matches_masked_sdpa(self):
+        B, H, S, D = 3, 2, 12, 8
+        rng = np.random.default_rng(0)
+        q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        k = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        v = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        lens = np.asarray([12, 7, 3], np.int32)
+        out = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(lens.reshape(-1, 1)),
+            paddle.to_tensor(lens.reshape(-1, 1)))
+        want = _sdpa(q, k, v, lens=lens)
+        got = np.asarray(out._data)
+        for b in range(B):
+            L = lens[b]
+            np.testing.assert_allclose(got[b, :, :L], want[b, :, :L],
+                                       rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(got[b, :, L:], 0.0)
+
+    def test_causal_and_additive_mask(self):
+        B, H, S, D = 1, 2, 6, 4
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        k = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        v = rng.standard_normal((B, H, S, D)).astype(np.float32)
+        lens = np.full((B, 1), S, np.int32)
+        out = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(lens),
+            paddle.to_tensor(lens), causal=True)
+        want = _sdpa(q, k, v, causal_offset=[0], lens=[S])
+        np.testing.assert_allclose(np.asarray(out._data), want,
+                                   rtol=1e-4, atol=1e-4)
+        # additive mask path
+        m = np.zeros((B, 1, S, S), np.float32)
+        m[:, :, :, 0] = -1e9
+        out2 = F.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(lens),
+            paddle.to_tensor(lens), mask=paddle.to_tensor(m))
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D) + m
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want2 = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(np.asarray(out2._data), want2,
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestFusedMultiTransformer:
+    def _mk_weights(self, nlayers, dm, H, D, ffn, seed=0):
+        rng = np.random.default_rng(seed)
+        t = paddle.to_tensor
+
+        def g(*shape):
+            return t((rng.standard_normal(shape) * 0.05).astype(
+                np.float32))
+
+        w = dict(
+            ln_scales=[t(np.ones(dm, np.float32))] * nlayers,
+            ln_biases=[t(np.zeros(dm, np.float32))] * nlayers,
+            qkv_weights=[g(3, H, D, dm) for _ in range(nlayers)],
+            qkv_biases=[g(3, H, D) for _ in range(nlayers)],
+            linear_weights=[g(H * D, dm) for _ in range(nlayers)],
+            linear_biases=[g(dm) for _ in range(nlayers)],
+            ffn_ln_scales=[t(np.ones(dm, np.float32))] * nlayers,
+            ffn_ln_biases=[t(np.zeros(dm, np.float32))] * nlayers,
+            ffn1_weights=[g(dm, ffn) for _ in range(nlayers)],
+            ffn1_biases=[g(ffn) for _ in range(nlayers)],
+            ffn2_weights=[g(ffn, dm) for _ in range(nlayers)],
+            ffn2_biases=[g(dm) for _ in range(nlayers)],
+        )
+        return w
+
+    def test_prefill_then_decode_matches_full_forward(self):
+        """Decode steps through the cache must reproduce the full
+        (no-cache) forward logits — THE serving-correctness property."""
+        nlayers, dm, H, D, ffn = 2, 32, 4, 8, 64
+        B, S, L = 2, 5, 12
+        w = self._mk_weights(nlayers, dm, H, D, ffn)
+        rng = np.random.default_rng(5)
+        seq = rng.standard_normal((B, S + 2, dm)).astype(np.float32)
+
+        # full forward over S+2 tokens, no cache (causal)
+        full = F.fused_multi_transformer(
+            paddle.to_tensor(seq), **w)
+        full_np = np.asarray(full._data)
+
+        # prefill S tokens, then decode 2 more
+        caches = [paddle.to_tensor(np.zeros((2, B, H, L, D), np.float32))
+                  for _ in range(nlayers)]
+        out, caches = F.fused_multi_transformer(
+            paddle.to_tensor(seq[:, :S]), cache_kvs=caches, **w)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   full_np[:, :S], rtol=1e-4, atol=1e-4)
+        for step in range(2):
+            out, caches = F.fused_multi_transformer(
+                paddle.to_tensor(seq[:, S + step:S + step + 1]),
+                cache_kvs=caches,
+                time_step=paddle.to_tensor(
+                    np.asarray(S + step, np.int32)), **w)
+            np.testing.assert_allclose(
+                np.asarray(out._data)[:, 0], full_np[:, S + step],
+                rtol=1e-4, atol=1e-4,
+                err_msg=f"decode step {step} diverged from full forward")
+
+    def test_post_layer_norm_and_relu(self):
+        nlayers, dm, H, D, ffn = 1, 16, 2, 8, 32
+        w = self._mk_weights(nlayers, dm, H, D, ffn, seed=9)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (1, 3, dm)).astype(np.float32))
+        out = F.fused_multi_transformer(
+            x, pre_layer_norm=False, activation="relu", **w)
+        assert np.isfinite(np.asarray(out._data)).all()
