@@ -218,6 +218,31 @@ class Tensor:
         from ..ops import assign
         return assign(self)
 
+    def to_sparse_coo(self, sparse_dim=None):
+        """Dense -> SparseCooTensor (ref: to_sparse_coo in
+        phi/api/yaml/sparse_ops.yaml; Tensor method in
+        python/paddle/tensor/manipulation.py). sparse_dim < ndim yields
+        a hybrid COO: indices over the leading sparse dims, values keep
+        the trailing dims dense (BCOO n_dense)."""
+        from ..sparse import SparseCooTensor, _dense_to_coo
+        nd = self._data.ndim
+        if sparse_dim is None or int(sparse_dim) == nd:
+            return _dense_to_coo(self._data)
+        sd = int(sparse_dim)
+        if not 1 <= sd <= nd:
+            raise ValueError(
+                f"to_sparse_coo: sparse_dim must be in [1, {nd}], "
+                f"got {sparse_dim}")
+        from jax.experimental import sparse as jsparse
+        return SparseCooTensor(
+            jsparse.BCOO.fromdense(self._data, n_dense=nd - sd))
+
+    def to_sparse_csr(self):
+        """Dense -> SparseCsrTensor (ref: to_sparse_csr,
+        sparse_ops.yaml)."""
+        from ..sparse import _dense_to_csr
+        return _dense_to_csr(self._data)
+
     def to(self, *args, **kwargs):
         """to(dtype) / to(device) / to(device, dtype)."""
         dst_dtype = None

@@ -907,3 +907,62 @@ def _kl_gumbel(p, q):
     return _wrap(jnp.log(q.scale / p.scale) + g * (r - 1)
                  + jnp.exp(-d + jax.scipy.special.gammaln(1 + r))
                  - 1 + d)
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs) (ref:
+    python/paddle/distribution/binomial.py). total_count may be a scalar
+    or a per-element tensor; sampling draws [n_max, ...] Bernoullis and
+    masks rows past each element's own count, so one fixed-shape draw
+    serves heterogeneous counts."""
+
+    def __init__(self, total_count, probs):
+        self.probs_arr = _arr(probs)
+        if np.ndim(total_count) == 0 and not isinstance(total_count,
+                                                        Tensor):
+            self.n_max = int(total_count)
+            self.n_arr = jnp.asarray(float(total_count))
+        else:
+            tc = _arr(total_count)
+            self.n_arr = tc.astype(jnp.float32)
+            self.n_max = int(np.asarray(tc).max())
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.n_arr), jnp.shape(self.probs_arr)))
+
+    @property
+    def mean(self):
+        return _wrap(self.n_arr * self.probs_arr)
+
+    @property
+    def variance(self):
+        return _wrap(self.n_arr * self.probs_arr * (1 - self.probs_arr))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        draws = jax.random.bernoulli(
+            next_key(),
+            jnp.broadcast_to(self.probs_arr, (self.n_max,) + shape))
+        trial = jnp.arange(self.n_max, dtype=jnp.float32).reshape(
+            (self.n_max,) + (1,) * len(shape))
+        live = trial < jnp.broadcast_to(self.n_arr, shape)
+        return _wrap(jnp.sum(draws & live, axis=0).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        n, p = self.n_arr, self.probs_arr
+        logc = (jax.scipy.special.gammaln(n + 1.0)
+                - jax.scipy.special.gammaln(v + 1.0)
+                - jax.scipy.special.gammaln(n - v + 1.0))
+        return _wrap(logc + v * jnp.log(jnp.maximum(p, 1e-30))
+                     + (n - v) * jnp.log(jnp.maximum(1 - p, 1e-30)))
+
+    def entropy(self):
+        # exact sum over the max support; per-element terms past the
+        # element's own n are masked out
+        k = jnp.arange(self.n_max + 1, dtype=jnp.float32)
+        kshape = (self.n_max + 1,) + (1,) * len(self.batch_shape)
+        kb = k.reshape(kshape)
+        lp = self.log_prob(_wrap(kb))._data
+        live = kb <= jnp.broadcast_to(self.n_arr, self.batch_shape)
+        return _wrap(-jnp.sum(jnp.where(live, jnp.exp(lp) * lp, 0.0),
+                              axis=0))

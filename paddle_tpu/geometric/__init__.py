@@ -119,3 +119,93 @@ def segment_max(x, segment_ids, out_size=None):
 
 def segment_min(x, segment_ids, out_size=None):
     return segment_pool(x, segment_ids, "min", out_size=out_size)
+
+
+# ---- graph sampling / reindex (ref: python/paddle/geometric/reindex.py:25
+# reindex_graph; geometric/sampling/neighbors.py sample_neighbors:20,
+# weighted_sample_neighbors:175). Dynamic-output data-prep ops -> host
+# (numpy) eager implementations, like nms: the sampled subgraph is
+# input-pipeline work; the TPU sees the fixed-shape reindexed tensors.
+
+def _np_arr(t):
+    import numpy as np
+    return np.asarray(t._data if isinstance(t, Tensor) else t)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """Reindex node ids to a compact [0, n) range; returns
+    (reindex_src, reindex_dst, out_nodes)."""
+    import numpy as np
+    xv = _np_arr(x).reshape(-1)
+    nb = _np_arr(neighbors).reshape(-1)
+    ct = _np_arr(count).reshape(-1).astype(np.int64)
+    seen = dict.fromkeys(xv.tolist())
+    for v in nb.tolist():
+        seen.setdefault(v, None)
+    out_nodes = np.fromiter(seen.keys(), dtype=xv.dtype,
+                            count=len(seen))
+    lut = {v: i for i, v in enumerate(out_nodes.tolist())}
+    reindex_src = np.array([lut[v] for v in nb.tolist()], xv.dtype)
+    reindex_dst = np.repeat(np.arange(len(xv), dtype=xv.dtype), ct)
+    return (Tensor._wrap(jnp.asarray(reindex_src)),
+            Tensor._wrap(jnp.asarray(reindex_dst)),
+            Tensor._wrap(jnp.asarray(out_nodes)))
+
+
+def _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                           eids, return_eids, weights):
+    import numpy as np
+    rowv = _np_arr(row).reshape(-1)
+    cp = _np_arr(colptr).reshape(-1).astype(np.int64)
+    nodes = _np_arr(input_nodes).reshape(-1)
+    ev = _np_arr(eids).reshape(-1) if eids is not None else None
+    wv = _np_arr(weights).reshape(-1) if weights is not None else None
+    # derive the host RNG from the framework generator so paddle.seed
+    # makes sampling reproducible like every other random op
+    from ..core.generator import next_key
+    rng = np.random.default_rng(
+        np.asarray(next_key()).astype(np.uint32).tolist())
+    outs, cnts, oeids = [], [], []
+    for n in nodes.tolist():
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        elif wv is not None:
+            w = wv[lo:hi].astype(np.float64)
+            p = w / w.sum() if w.sum() > 0 else None
+            pick = lo + rng.choice(deg, size=sample_size,
+                                   replace=False, p=p)
+        else:
+            pick = lo + rng.choice(deg, size=sample_size, replace=False)
+        outs.append(rowv[pick])
+        cnts.append(len(pick))
+        if return_eids:
+            if ev is None:
+                raise ValueError("return_eids=True requires eids")
+            oeids.append(ev[pick])
+    out = np.concatenate(outs) if outs else np.empty(0, rowv.dtype)
+    cnt = np.asarray(cnts, np.int32)
+    res = (Tensor._wrap(jnp.asarray(out)), Tensor._wrap(jnp.asarray(cnt)))
+    if return_eids:
+        oe = np.concatenate(oeids) if oeids else np.empty(0, rowv.dtype)
+        res = res + (Tensor._wrap(jnp.asarray(oe)),)
+    return res
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph; returns
+    (out_neighbors, out_count[, out_eids])."""
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, None)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-proportional neighbor sampling (without replacement) over
+    a CSC graph; returns (out_neighbors, out_count[, out_eids])."""
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, edge_weight)

@@ -1,2 +1,3 @@
 """paddle_tpu.incubate (ref: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -242,6 +242,36 @@ def fused_softmax_mask_upper_triangle(x):
     return jax.nn.softmax(z, axis=-1).astype(x.dtype)
 
 
+@register_op("fused_bias_act")
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu",
+                   compute_dtype="default", quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0):
+    """act(x + bias) with geglu/swiglu gating support (ref:
+    incubate/nn/functional/blha_get_max_len.py sibling fused_bias_act,
+    phi fused_bias_act kernel). Quant/dequant args are a documented
+    exclusion (weight-only quant lives in nn.quant)."""
+    if any(v is not None for v in (dequant_scales, shift, smooth)) or \
+            quant_scale != -1:
+        raise NotImplementedError(
+            "fused_bias_act quant arguments are not supported (int8 "
+            "serving quant is a documented exclusion)")
+    h = x if bias is None else x + bias
+    hf = h.astype(jnp.float32)
+    if act_method in ("geglu", "swiglu"):
+        a, b = jnp.split(hf, 2, axis=-1)
+        g = jax.nn.gelu(a) if act_method == "geglu" else jax.nn.silu(a)
+        return (g * b).astype(x.dtype)
+    if act_method == "gelu":
+        return jax.nn.gelu(hf).astype(x.dtype)
+    if act_method in ("relu",):
+        return jax.nn.relu(hf).astype(x.dtype)
+    if act_method in ("silu", "swish"):
+        return jax.nn.silu(hf).astype(x.dtype)
+    raise ValueError(f"unsupported act_method {act_method!r}")
+
+
 # --- LLM serving / decode family (ref: incubate/nn/functional/
 # masked_multihead_attention.py, block_multihead_attention.py,
 # fused_transformer.py:976, variable_length_memory_efficient_attention.py)

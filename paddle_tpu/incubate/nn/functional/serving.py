@@ -393,8 +393,13 @@ def _act(name, x):
         return jax.nn.gelu(x)
     if name == "relu":
         return jax.nn.relu(x)
-    if name in ("swiglu", "silu"):
+    if name == "silu":
         return jax.nn.silu(x)
+    if name in ("swiglu", "geglu"):
+        # gated: ffn1 produces 2x width, activation gates the halves
+        a, b = jnp.split(x, 2, axis=-1)
+        g = jax.nn.silu(a) if name == "swiglu" else jax.nn.gelu(a)
+        return g * b
     raise ValueError(f"unsupported activation {name!r}")
 
 

@@ -1,0 +1,115 @@
+"""paddle_tpu.incubate.optimizer (ref: python/paddle/incubate/optimizer/
+modelaverage.py:28 ModelAverage, lookahead.py LookAhead).
+
+Both are wrapper optimizers over running copies of the parameters —
+pure elementwise state updates, so each step is a handful of fused XLA
+ops per parameter; apply()/restore() swap the averaged weights in and
+out for evaluation (average_accumulates_ op analog)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..core.tensor import Tensor
+from ..optimizer.optimizer import Optimizer
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging for evaluation
+    (ref: modelaverage.py:28; phi average_accumulates kernel)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p._data)
+                     for p in self._parameter_list}
+        self._num_accumulates = 0
+        self._num_updates = 0
+        self._saved = None
+
+    @no_grad()
+    def step(self):
+        for p in self._parameter_list:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._num_accumulates += 1
+        self._num_updates += 1
+        window = min(self.max_average_window,
+                     self._num_updates * self.average_window)
+        if (self._num_accumulates >= self.min_average_window
+                and self._num_accumulates >= window):
+            # restart the window: keep the current value as the seed
+            for p in self._parameter_list:
+                self._sum[id(p)] = p._data
+            self._num_accumulates = 1
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager too)."""
+        self._saved = {id(p): p._data for p in self._parameter_list}
+        denom = max(self._num_accumulates, 1)
+        for p in self._parameter_list:
+            p._data = (self._sum[id(p)] / denom).astype(p._data.dtype)
+        self._need_restore = need_restore
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+        return False
+
+    @no_grad()
+    def restore(self, executor=None):
+        if self._saved is None:
+            return
+        for p in self._parameter_list:
+            p._data = self._saved[id(p)]
+        self._saved = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class LookAhead(Optimizer):
+    """Lookahead wrapper: k fast steps, then slow <- slow + alpha *
+    (fast - slow) (ref: incubate/optimizer/lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._parameter_list = inner_optimizer._parameter_list
+        self._slow = {id(p): p._data for p in self._parameter_list}
+        self._step_num = 0
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()

@@ -28,7 +28,7 @@ __all__ = [
     "mean_all", "numel", "shape_op", "fill", "fill_diagonal_tensor",
     "view_dtype", "accuracy_op", "auc_op", "rnnt_loss_op",
     "assign_value", "check_numerics", "full_batch_size_like",
-    "index_select_strided", "trans_layout",
+    "index_select_strided", "trans_layout", "squared_l2_norm", "frexp",
 ]
 
 
@@ -119,7 +119,10 @@ def _take_eager_check(x, index, mode="raise"):
     if mode != "raise":
         return
     n = int(np.prod(x.shape))
-    if not getattr(index, "size", 1):
+    size = getattr(index, "size", None)
+    if size is None:            # python list/tuple index
+        size = np.asarray(index).size
+    if not size:
         return
     # reduce on-device, sync only two scalars (no full D2H copy)
     lo, hi = int(jnp.min(index)), int(jnp.max(index))
@@ -620,3 +623,30 @@ def index_select_strided(x, index, axis=0):
 def trans_layout(x, perm):
     """ref: trans_layout op (layout-change transpose)."""
     return jnp.transpose(x, list(perm))
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(x):
+    """ref: phi squared_l2_norm kernel (used by clip_by_global_norm /
+    gradient clipping): sum(x^2) as a [1] tensor."""
+    return jnp.sum(jnp.square(x.astype(jnp.float32))).reshape(1) \
+        .astype(x.dtype)
+
+
+@register_op("frexp")
+def frexp(x):
+    """ref: math.py frexp — mantissa/exponent decomposition with
+    mantissa in [0.5, 1)."""
+    xf = x.astype(jnp.float32)
+    e = jnp.where(xf == 0, 0,
+                  jnp.floor(jnp.log2(jnp.abs(
+                      jnp.where(xf == 0, 1.0, xf)))) + 1)
+    m = jnp.where(xf == 0, 0.0, xf / jnp.exp2(e))
+    # guard the boundary (|m| must be < 1, >= 0.5)
+    fix = jnp.abs(m) >= 1.0
+    m = jnp.where(fix, m / 2, m)
+    e = jnp.where(fix, e + 1, e)
+    fix2 = (jnp.abs(m) < 0.5) & (m != 0)
+    m = jnp.where(fix2, m * 2, m)
+    e = jnp.where(fix2, e - 1, e)
+    return m.astype(x.dtype), e.astype(jnp.int32)

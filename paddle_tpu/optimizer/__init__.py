@@ -3,4 +3,5 @@ from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp, Lamb, Adadelta,
 )
+from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401

@@ -1,0 +1,203 @@
+"""L-BFGS optimizer (ref: python/paddle/optimizer/lbfgs.py:309 class
+LBFGS — closure-based step, two-loop recursion, optional strong-Wolfe
+line search).
+
+TPU-native notes: L-BFGS is a HOST-driven algorithm — the line search
+re-evaluates the model an unpredictable number of times, so it cannot be
+one fixed XLA program. The design keeps the model evaluations on device
+(the closure runs whatever the user built — eager ops or a jitted loss)
+and the O(m·n) two-loop recursion on flattened f32 vectors via jnp, so
+the history dot products are single fused reductions on device.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ..autograd import enable_grad, no_grad
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+def _gather_flat(tensors):
+    return jnp.concatenate([jnp.ravel(t.astype(jnp.float32))
+                            for t in tensors])
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate,
+                         parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        self.max_iter = max_iter
+        self.max_eval = max_eval
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                "line_search_fn must be None or 'strong_wolfe'")
+        self.line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._rho: list = []
+        self._prev_flat_grad = None
+        self._H_diag = 1.0
+        self._n_evals = 0
+
+    # -- flat param plumbing --
+    def _params(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _flat_params(self):
+        return _gather_flat([p._data for p in self._params()])
+
+    def _flat_grad(self):
+        grads = []
+        for p in self._params():
+            g = p._grad if p._grad is not None else \
+                jnp.zeros_like(p._data)
+            g = g._data if isinstance(g, Tensor) else g
+            if self.weight_decay:
+                g = g + float(self.weight_decay) * p._data
+            grads.append(g)
+        return _gather_flat(grads)
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._params():
+            n = p._data.size
+            p._data = flat[off:off + n].reshape(p._data.shape).astype(
+                p._data.dtype)
+            off += n
+
+    def _direction(self, flat_grad):
+        """Two-loop recursion over the (s, y) history."""
+        q = -flat_grad
+        m = len(self._s_hist)
+        alphas = [None] * m
+        for i in range(m - 1, -1, -1):
+            alphas[i] = self._rho[i] * jnp.dot(self._s_hist[i], q)
+            q = q - alphas[i] * self._y_hist[i]
+        d = q * self._H_diag
+        for i in range(m):
+            beta = self._rho[i] * jnp.dot(self._y_hist[i], d)
+            d = d + self._s_hist[i] * (alphas[i] - beta)
+        return d
+
+    def _eval(self, closure, flat_x):
+        self._set_flat_params(flat_x)
+        with enable_grad():   # closure needs grads on
+            loss = closure()
+        self._n_evals += 1
+        return float(loss), self._flat_grad()
+
+    def _strong_wolfe(self, closure, x, d, f0, g0, t, c1=1e-4, c2=0.9,
+                      max_ls=25):
+        """Bracketing strong-Wolfe line search (ref: lbfgs.py
+        _strong_wolfe); returns (f_new, g_new, t)."""
+        gtd0 = float(jnp.dot(g0, d))
+        f_prev, t_prev = f0, 0.0
+        g_new = g0
+        f_new = f0
+        for ls in range(max_ls):
+            f_new, g_new = self._eval(closure, x + t * d)
+            gtd = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or (ls > 0 and f_new >= f_prev):
+                return self._zoom(closure, x, d, f0, gtd0, t_prev,
+                                  f_prev, t, f_new, c1, c2)
+            if abs(gtd) <= -c2 * gtd0:
+                return f_new, g_new, t
+            if gtd >= 0:
+                return self._zoom(closure, x, d, f0, gtd0, t, f_new,
+                                  t_prev, f_prev, c1, c2)
+            f_prev, t_prev = f_new, t
+            t = t * 2.0
+        return f_new, g_new, t
+
+    def _zoom(self, closure, x, d, f0, gtd0, t_lo, f_lo, t_hi, f_hi,
+              c1, c2, max_zoom=25):
+        f_new, g_new, t = f_lo, None, t_lo
+        for _ in range(max_zoom):
+            t = 0.5 * (t_lo + t_hi)
+            f_new, g_new = self._eval(closure, x + t * d)
+            gtd = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+                t_hi, f_hi = t, f_new
+            else:
+                if abs(gtd) <= -c2 * gtd0:
+                    break
+                if gtd * (t_hi - t_lo) >= 0:
+                    t_hi, f_hi = t_lo, f_lo
+                t_lo, f_lo = t, f_new
+            if abs(t_hi - t_lo) < 1e-12:
+                break
+        if g_new is None:
+            f_new, g_new = self._eval(closure, x + t * d)
+        return f_new, g_new, t
+
+    @no_grad()
+    def step(self, closure: Optional[Callable] = None):
+        if closure is None:
+            raise ValueError(
+                "LBFGS.step requires a closure that re-evaluates the "
+                "model and returns the loss")
+        lr = self.get_lr()
+        self._n_evals = 0
+        with enable_grad():
+            loss = closure()
+        self._n_evals += 1
+        f = float(loss)
+        flat_grad = self._flat_grad()
+        if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+            return loss
+
+        for _ in range(self.max_iter):
+            # history update
+            if self._prev_flat_grad is not None:
+                y = flat_grad - self._prev_flat_grad
+                s = self._last_step
+                ys = float(jnp.dot(y, s))
+                if ys > 1e-10:
+                    if len(self._s_hist) >= self.history_size:
+                        self._s_hist.pop(0)
+                        self._y_hist.pop(0)
+                        self._rho.pop(0)
+                    self._s_hist.append(s)
+                    self._y_hist.append(y)
+                    self._rho.append(1.0 / ys)
+                    self._H_diag = ys / float(jnp.dot(y, y))
+            d = self._direction(flat_grad)
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -self.tolerance_change:
+                break
+            t = lr if self._s_hist else \
+                min(1.0, 1.0 / float(jnp.sum(jnp.abs(flat_grad)))) * lr
+            x = self._flat_params()
+            self._prev_flat_grad = flat_grad
+            if self.line_search_fn == "strong_wolfe":
+                f_new, g_new, t = self._strong_wolfe(
+                    closure, x, d, f, flat_grad, t)
+                self._set_flat_params(x + t * d)
+            else:
+                f_new, g_new = self._eval(closure, x + t * d)
+            self._last_step = t * d
+            if self._n_evals >= self.max_eval:
+                f, flat_grad = f_new, g_new
+                break
+            if abs(f_new - f) < self.tolerance_change or float(
+                    jnp.max(jnp.abs(t * d))) < self.tolerance_change:
+                f, flat_grad = f_new, g_new
+                break
+            f, flat_grad = f_new, g_new
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tolerance_grad:
+                break
+        self._step_count += 1
+        return Tensor._wrap(jnp.asarray(f))

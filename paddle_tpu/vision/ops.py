@@ -121,7 +121,221 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     raise NotImplementedError(code_type)
 
 
-def deform_conv2d(*args, **kwargs):
-    raise NotImplementedError(
-        "deform_conv2d needs a dedicated gather kernel; tracked for the "
-        "Pallas kernel milestone")
+@register_op("deformable_conv")
+def deform_conv2d(x, offset, weight, mask=None, bias=None, stride=1,
+                  padding=0, dilation=1, deformable_groups=1, groups=1):
+    """Deformable convolution v1/v2 (ref: python/paddle/vision/ops.py
+    deform_conv2d; phi/kernels/impl/deformable_conv_kernel_impl.h).
+    TPU rendering: the sampled im2col is a dense gather + bilinear
+    interpolation (all static shapes), and the conv becomes ONE MXU
+    matmul over the sampled patches — no per-position scatter loops.
+
+    x: [N, Cin, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo] (y/x pairs);
+    mask: [N, dg*kh*kw, Ho, Wo] (v2 modulation, None = v1);
+    weight: [Cout, Cin//groups, kh, kw].
+    """
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else \
+        tuple(dilation)
+    N, Cin, H, W = x.shape
+    Cout, _, kh, kw = weight.shape
+    Ho = (H + 2 * p[0] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+    Wo = (W + 2 * p[1] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+    dg = deformable_groups
+    K = kh * kw
+
+    off = offset.reshape(N, dg, K, 2, Ho, Wo).astype(jnp.float32)
+    # base sampling grid (kernel tap positions per output pixel)
+    gy = jnp.arange(Ho) * s[0] - p[0]
+    gx = jnp.arange(Wo) * s[1] - p[1]
+    ky = jnp.arange(kh) * d[0]
+    kx = jnp.arange(kw) * d[1]
+    base_y = gy[None, :, None] + ky.reshape(kh, 1, 1)   # [kh, Ho, 1]
+    base_x = gx[None, None, :] + kx.reshape(kw, 1, 1)   # [kw, 1, Wo]
+    base_y = jnp.broadcast_to(base_y[:, None], (kh, kw, Ho, Wo))
+    base_x = jnp.broadcast_to(base_x[None, :].reshape(1, kw, 1, Wo),
+                              (kh, kw, Ho, Wo))
+    base = jnp.stack([base_y, base_x]).reshape(2, K, Ho, Wo)
+    sy = base[0][None, None] + off[:, :, :, 0]          # [N, dg, K, Ho, Wo]
+    sx = base[1][None, None] + off[:, :, :, 1]
+
+    # bilinear sample; out-of-image taps contribute 0 (kernel contract)
+    y0 = jnp.floor(sy); x0 = jnp.floor(sx)
+    wy1 = (sy - y0); wx1 = (sx - x0)
+    vals = 0.0
+    xs = x.reshape(N, dg, Cin // dg, H, W).astype(jnp.float32)
+    for dy_, wy_ in ((y0, 1.0 - wy1), (y0 + 1, wy1)):
+        for dx_, wx_ in ((x0, 1.0 - wx1), (x0 + 1, wx1)):
+            ok = ((dy_ >= 0) & (dy_ < H) & (dx_ >= 0) & (dx_ < W))
+            iy = jnp.clip(dy_, 0, H - 1).astype(jnp.int32)
+            ix = jnp.clip(dx_, 0, W - 1).astype(jnp.int32)
+            # gather per (n, dg): [N, dg, C', K, Ho, Wo]
+            g = jnp.take_along_axis(
+                xs.reshape(N, dg, Cin // dg, H * W)[:, :, :, None],
+                (iy * W + ix).reshape(N, dg, 1, K * Ho * Wo)[:, :, :,
+                                                             None, :]
+                .astype(jnp.int32).reshape(N, dg, 1, 1, K * Ho * Wo),
+                axis=-1).reshape(N, dg, Cin // dg, K, Ho, Wo)
+            vals = vals + g * (wy_ * wx_ * ok)[:, :, None]
+    if mask is not None:
+        vals = vals * mask.reshape(N, dg, 1, K, Ho, Wo)
+
+    # cols: [N, Cin*K, Ho*Wo] -> grouped matmul with weight
+    cols = vals.reshape(N, Cin, K, Ho * Wo)
+    wf = weight.astype(jnp.float32).reshape(
+        groups, Cout // groups, (Cin // groups) * K)
+    cols = cols.reshape(N, groups, (Cin // groups) * K, Ho * Wo)
+    out = jnp.einsum("gok,ngkp->ngop", wf, cols,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(N, Cout, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, Cout, 1, 1)
+    return out.astype(x.dtype)
+
+
+def read_file(filename, name=None):
+    """Read a file's bytes as a uint8 1-D Tensor (ref:
+    python/paddle/vision/ops.py:1337 read_file). Host IO -> eager-only."""
+    import numpy as np
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor._wrap(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte Tensor to [C, H, W] uint8 (ref:
+    python/paddle/vision/ops.py decode_jpeg, phi decode_jpeg nvjpeg
+    kernel). Host-side decode (PIL) — image IO is input-pipeline work
+    that belongs on the host, the TPU sees the decoded tensor."""
+    import io as _io
+    import numpy as np
+    from PIL import Image
+    raw = bytes(np.asarray(x._data if isinstance(x, Tensor) else x)
+                .astype(np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]                      # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)         # [C, H, W]
+    return Tensor._wrap(jnp.asarray(arr))
+
+
+@register_op("yolo_loss")
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0):
+    """YOLOv3 loss (ref: python/paddle/vision/ops.py:52 yolo_loss;
+    phi/kernels/cpu/yolo_loss_kernel.cc semantics). x: [N, S*(5+C), H, W];
+    gt_box: [N, B, 4] normalized cx/cy/w/h; gt_label: [N, B] int.
+    Static-shape rendering: GT->anchor assignment is a fixed-size
+    scatter (invalid GTs scatter out of bounds and are dropped), the
+    three loss parts are masked elementwise sums. Returns [N] loss."""
+    N, _, H, W = x.shape
+    S = len(anchor_mask)
+    C = class_num
+    B = gt_box.shape[1]
+    x = x.reshape(N, S, 5 + C, H, W).astype(jnp.float32)
+    tx, ty = x[:, :, 0], x[:, :, 1]
+    tw, th = x[:, :, 2], x[:, :, 3]
+    tobj = x[:, :, 4]
+    tcls = x[:, :, 5:]
+
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)  # [A, 2]
+    mask_idx = jnp.asarray(anchor_mask, jnp.int32)             # [S]
+    in_w = W * downsample_ratio
+    in_h = H * downsample_ratio
+
+    gtb = gt_box.astype(jnp.float32)
+    gw, gh = gtb[..., 2], gtb[..., 3]                          # [N, B]
+    valid = (gw > 0) & (gh > 0)
+    gscore = (jnp.ones((N, B), jnp.float32) if gt_score is None
+              else gt_score.astype(jnp.float32))
+
+    # ---- best-anchor match per GT (shape IoU over ALL anchors) ----
+    aw = an_all[:, 0] / in_w
+    ah = an_all[:, 1] / in_h
+    inter = (jnp.minimum(gw[..., None], aw) *
+             jnp.minimum(gh[..., None], ah))
+    union = gw[..., None] * gh[..., None] + aw * ah - inter
+    an_iou = inter / jnp.maximum(union, 1e-10)                 # [N,B,A]
+    best = jnp.argmax(an_iou, axis=-1).astype(jnp.int32)       # [N, B]
+    # position of best anchor inside anchor_mask (or -1)
+    in_mask = best[..., None] == mask_idx                      # [N,B,S]
+    mpos = jnp.where(in_mask.any(-1),
+                     jnp.argmax(in_mask, axis=-1), -1).astype(jnp.int32)
+
+    gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    live = valid & (mpos >= 0)
+    # scatter GT targets into [N, S, H, W] maps; dead GTs scatter OOB
+    sm = jnp.where(live, mpos, S)
+    nidx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+    def smap(vals, init=0.0):
+        m = jnp.full((N, S, H, W), init, jnp.float32)
+        return m.at[nidx, sm, gj, gi].set(vals.astype(jnp.float32))
+    pw = an_all[jnp.clip(best, 0, an_all.shape[0] - 1), 0]
+    ph = an_all[jnp.clip(best, 0, an_all.shape[0] - 1), 1]
+    obj_map = smap(jnp.where(live, gscore, 0.0))
+    tx_t = smap(gtb[..., 0] * W - gi)
+    ty_t = smap(gtb[..., 1] * H - gj)
+    tw_t = smap(jnp.log(jnp.maximum(gw * in_w / jnp.maximum(pw, 1e-9),
+                                    1e-9)))
+    th_t = smap(jnp.log(jnp.maximum(gh * in_h / jnp.maximum(ph, 1e-9),
+                                    1e-9)))
+    scale_t = smap(2.0 - gw * gh)           # box loss weight
+    lbl = jnp.clip(gt_label.astype(jnp.int32), 0, C - 1)
+    cls_t = jnp.zeros((N, S, H, W, C), jnp.float32).at[
+        nidx, sm, gj, gi, lbl].set(1.0)
+    pos = obj_map > 0
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    # ---- box + class losses on responsible cells ----
+    loss_xy = (bce(tx, tx_t) + bce(ty, ty_t)) * scale_t * pos
+    loss_wh = (jnp.abs(tw - tw_t) + jnp.abs(th - th_t)) * scale_t * pos
+    smooth = 1.0 / max(C, 1) if (use_label_smooth and C > 1) else 0.0
+    cls_target = cls_t * (1 - smooth) + smooth / max(C, 1) \
+        if smooth else cls_t
+    loss_cls = (bce(tcls.transpose(0, 1, 3, 4, 2), cls_target)
+                * pos[..., None]).sum(-1)
+
+    # ---- objectness: ignore preds whose IoU with any GT > thresh ----
+    grid_x = (jnp.arange(W)[None, None, None] + jax.nn.sigmoid(tx)) / W
+    grid_y = (jnp.arange(H)[None, None, :, None] + jax.nn.sigmoid(ty)) \
+        / H
+    pw_map = an_all[mask_idx, 0][None, :, None, None]
+    ph_map = an_all[mask_idx, 1][None, :, None, None]
+    pred_w = jnp.exp(tw) * pw_map / in_w
+    pred_h = jnp.exp(th) * ph_map / in_h
+
+    def box_iou(cx1, cy1, w1, h1, cx2, cy2, w2, h2):
+        l1, r1 = cx1 - w1 / 2, cx1 + w1 / 2
+        t1, b1 = cy1 - h1 / 2, cy1 + h1 / 2
+        l2, r2 = cx2 - w2 / 2, cx2 + w2 / 2
+        t2, b2 = cy2 - h2 / 2, cy2 + h2 / 2
+        iw = jnp.maximum(jnp.minimum(r1, r2) - jnp.maximum(l1, l2), 0)
+        ih = jnp.maximum(jnp.minimum(b1, b2) - jnp.maximum(t1, t2), 0)
+        inter = iw * ih
+        return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+    ious = box_iou(
+        grid_x[..., None], grid_y[..., None], pred_w[..., None],
+        pred_h[..., None],
+        gtb[:, None, None, None, :, 0], gtb[:, None, None, None, :, 1],
+        gtb[:, None, None, None, :, 2], gtb[:, None, None, None, :, 3])
+    ious = jnp.where(valid[:, None, None, None], ious, 0.0)
+    ignore = (ious.max(-1) > ignore_thresh) & ~pos
+    loss_obj = bce(tobj, obj_map) * jnp.where(ignore, 0.0, 1.0)
+    loss_obj = jnp.where(pos, loss_obj * obj_map,
+                         loss_obj)  # positives weighted by gt_score
+
+    total = (loss_xy + loss_wh + loss_cls + loss_obj)
+    return total.sum(axis=(1, 2, 3))

@@ -438,6 +438,11 @@ class TestRegistryCoverage:
         "max_pool3d_with_index", "unpool3d", "assign_value",
         "check_numerics", "full_batch_size_like", "index_select_strided",
         "trans_layout",
+        # covered by tests/test_parity_gaps_r4.py (round-4 gap closures)
+        "squared_l2_norm", "frexp", "yolo_loss",
+        # covered by tests/test_rnn_scan_conformance.py (torch oracle)
+        "lstm_scan", "gru_scan", "simple_rnn_scan",
+        "fused_bias_act",  # covered by tests/test_parity_gaps_r4.py
     }
 
     def test_coverage_accounting(self):
@@ -460,11 +465,15 @@ class TestRegistryCoverage:
                      if not n.startswith(("fft_", "signal_", "fake_",
                                           "dist_", "moe_", "pp_xfer",
                                           "ring_", "to_static_"))]
+        # identity placeholder ops carry the "internal" tag (they keep a
+        # YAML name importable while the real API lives elsewhere) — not
+        # computational surface
+        uncovered = [n for n in uncovered
+                     if "internal" not in getattr(r.OPS[n], "tags", ())]
         # Gate: breadth may grow, but the uncovered tail must not.
-        # (r1: 120, r2: 70, r3: 5 — the remainder is runtime-internal scan
-        # bodies (gru/lstm/rnn, exercised via the RNN layer tests) and two
-        # explicit stubs)
-        assert len(uncovered) <= 5, (
+        # (r1: 120, r2: 70, r3: 5, r4: 0 — the rnn/gru/lstm scan bodies
+        # now have direct torch-oracle tests)
+        assert len(uncovered) == 0, (
             f"{len(uncovered)} registered ops lack conformance coverage; "
             f"add them to a family table or a dedicated module: "
             f"{uncovered}")
