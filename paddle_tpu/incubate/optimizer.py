@@ -50,10 +50,12 @@ class ModelAverage(Optimizer):
     def apply(self, executor=None, need_restore=True):
         """Swap averaged weights in (context-manager too)."""
         self._saved = {id(p): p._data for p in self._parameter_list}
-        denom = max(self._num_accumulates, 1)
+        self._need_restore = need_restore
+        if self._num_accumulates == 0:
+            return self      # nothing accumulated yet: keep live weights
+        denom = self._num_accumulates
         for p in self._parameter_list:
             p._data = (self._sum[id(p)] / denom).astype(p._data.dtype)
-        self._need_restore = need_restore
         return self
 
     def __enter__(self):

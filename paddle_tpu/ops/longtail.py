@@ -641,7 +641,13 @@ def frexp(x):
     e = jnp.where(xf == 0, 0,
                   jnp.floor(jnp.log2(jnp.abs(
                       jnp.where(xf == 0, 1.0, xf)))) + 1)
-    m = jnp.where(xf == 0, 0.0, xf / jnp.exp2(e))
+    # scale by exp2 in two halves: exp2(±128) would overflow f32, and
+    # TPU flushes subnormals so ldexp/div tricks break at the extremes.
+    # (Subnormal INPUTS are flushed to 0 by the hardware itself; frexp
+    # of a flushed value is (0, 0), consistent with what the chip sees.)
+    e1 = jnp.trunc(e / 2)
+    e2 = e - e1
+    m = jnp.where(xf == 0, 0.0, xf * jnp.exp2(-e1) * jnp.exp2(-e2))
     # guard the boundary (|m| must be < 1, >= 0.5)
     fix = jnp.abs(m) >= 1.0
     m = jnp.where(fix, m / 2, m)

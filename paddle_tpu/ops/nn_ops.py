@@ -234,12 +234,15 @@ def one_hot(x, num_classes):
 
 # ======================= conv =======================
 def _conv_dn(ndim, channel_last):
+    # the kernel layout is ALWAYS paddle's [out, in/groups, spatial...]
+    # regardless of data_format — only the activation layout changes
     if ndim == 3:
-        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+        return ("NWC", "OIW", "NWC") if channel_last else \
+            ("NCW", "OIW", "NCW")
     if ndim == 4:
-        return (("NHWC", "HWIO", "NHWC") if channel_last
+        return (("NHWC", "OIHW", "NHWC") if channel_last
                 else ("NCHW", "OIHW", "NCHW"))
-    return (("NDHWC", "DHWIO", "NDHWC") if channel_last
+    return (("NDHWC", "OIDHW", "NDHWC") if channel_last
             else ("NCDHW", "OIDHW", "NCDHW"))
 
 
@@ -319,7 +322,9 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     pad = _conv_padding(padding, n)
     outpad = _norm_tuple(output_padding, n)
     # weight layout for paddle transpose conv: [in, out/groups, kh, kw]
-    kernel = jnp.swapaxes(weight, 0, 1) if not channel_last else weight
+    # paddle transpose-conv weights are [in, out/groups, ...] in EVERY
+    # data_format; _conv_dn declares O-I-spatial, so always swap
+    kernel = jnp.swapaxes(weight, 0, 1)
     kh, kw = kernel.shape[-2:]
     if isinstance(pad, str):
         lax_pad = pad
@@ -524,8 +529,18 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     if training:
         x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=axes)
-        var = jnp.var(x32, axis=axes)
+        # E[x^2] - E[x]^2 instead of jnp.var: both sums reduce the SAME
+        # input, so XLA's multi-output fusion computes them in ONE pass
+        # over the activation (jnp.var re-reads x after the mean pass —
+        # measured as extra HBM passes in the bandwidth-bound ResNet
+        # step; see BENCH_EXTRA.md resnet analysis)
+        n = 1.0
+        for a in axes:
+            n *= x.shape[a]
+        s1 = jnp.sum(x32, axis=axes)
+        s2 = jnp.sum(x32 * x32, axis=axes)
+        mean = s1 / n
+        var = jnp.maximum(s2 / n - mean * mean, 0.0)
         new_rm = momentum * running_mean + (1 - momentum) * mean
         new_rv = momentum * running_var + (1 - momentum) * var
     else:

@@ -42,7 +42,9 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     dilation = _norm_tuple(dilation, n)
     pad = _conv_padding(padding, n)
     outpad = _norm_tuple(output_padding, n)
-    kernel = jnp.swapaxes(weight, 0, 1) if not channel_last else weight
+    # paddle transpose-conv weights are [in, out/groups, ...] in EVERY
+    # data_format; _conv_dn declares O-I-spatial, so always swap
+    kernel = jnp.swapaxes(weight, 0, 1)
     if isinstance(pad, str):
         lax_pad = pad
     else:
