@@ -175,6 +175,10 @@ def bench_resnet50(on_tpu):
 
     dev = jax.devices()[0]
     batch, hw, steps = (256, 224, 10) if on_tpu else (4, 32, 2)
+    # one-pass BN statistics (documented precision caveat on the flag;
+    # ImageNet-normalized activations are far inside its exact range)
+    import paddle_tpu as _pt
+    _pt.set_flags({"FLAGS_fast_bn_stats": True})
     # NHWC end-to-end: channels stay in the lane (minor) dimension, the
     # layout the TPU vector/matrix units want (VERDICT r3 next-3)
     model = resnet50(data_format="NHWC")

@@ -23,6 +23,8 @@ from .core.device import (  # noqa: F401
 )
 from .core.generator import seed, Generator, default_generator  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
+from .core.dtype import iinfo, finfo  # noqa: F401
+from . import hub  # noqa: F401
 
 # ---- ops (also patches Tensor methods) ----
 from .ops import *  # noqa: F401,F403

@@ -99,3 +99,44 @@ def from_np(np_dtype) -> DType:
 def to_jnp(x):
     """Coerce any dtype-like to the underlying jnp dtype."""
     return to_dtype(x).np_dtype
+
+
+class iinfo:
+    """ref: python/paddle/framework/dtype.py iinfo — integer dtype
+    numeric limits."""
+
+    def __init__(self, dtype):
+        import numpy as _np
+        d = to_dtype(dtype)
+        info = _np.iinfo(_np.dtype(d.name))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = d.name
+
+    def __repr__(self):
+        return (f"iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class finfo:
+    """ref: framework/dtype.py finfo — floating dtype numeric limits
+    (bfloat16 handled via ml_dtypes through jnp)."""
+
+    def __init__(self, dtype):
+        import jax.numpy as _jnp
+        import numpy as _np
+        d = to_dtype(dtype)
+        info = _jnp.finfo(_jnp.dtype(d.name))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+        self.dtype = d.name
+
+    def __repr__(self):
+        return (f"finfo(min={self.min}, max={self.max}, eps={self.eps}, "
+                f"bits={self.bits}, dtype={self.dtype})")

@@ -75,6 +75,33 @@ def flag_value(name: str):
 define_flag("FLAGS_check_nan_inf", False,
             "post-op NaN/Inf sanitizer (ref: phi/core/flags.cc:74)")
 define_flag("FLAGS_benchmark", False, "benchmark mode: sync after each op")
+define_flag("FLAGS_fast_bn_stats", False,
+            "one-pass batch-norm statistics (running-mean pivot): one "
+            "HBM read instead of 2-3 per BN during training (+11% on "
+            "ResNet-50, see BENCH_EXTRA.md). Bit-exact for normalized "
+            "activations; loses f32 precision only if a channel's "
+            "|mean| exceeds ~1e3 x its std while the running mean is "
+            "still far from the data (cold start). Default off = "
+            "exact two-pass stats (reference cuDNN parity).",
+            on_change=lambda v: _bump_trace_epoch())
+
+# epoch folded into every trace-cache key (registry exec cache,
+# to_static program cache, graph-break region signatures): bumping it
+# makes executables that baked a stale flag value unreachable
+trace_epoch = [0]
+
+
+def _bump_trace_epoch():
+    """Flag-dependent op bodies bake the flag value at trace time;
+    flipping such a flag must invalidate every cached trace — the
+    registry's per-op executables AND whole-program caches (to_static
+    / TrainStep / staged regions) whose traces inlined the op body."""
+    trace_epoch[0] += 1
+    import sys
+    reg = sys.modules.get("paddle_tpu.ops.registry")
+    if reg is not None:
+        for opdef in reg.OPS.values():
+            opdef.exec_cache.clear()
 define_flag("FLAGS_eager_op_jit", True,
             "cache per-op jitted executables for eager dispatch")
 define_flag("FLAGS_seed", 0, "global RNG seed")

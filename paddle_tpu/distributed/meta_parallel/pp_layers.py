@@ -26,7 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...core.tensor import Tensor
 from ...nn.layer import Layer
 from ...nn.layers.container import LayerList
-from ...ops.registry import OpDef, dispatch
+from ...ops.registry import OpDef
+from ...ops import registry as _op_registry
 from ..topology import get_hybrid_communicate_group
 
 _STAGE_AXES = ("dp", "sharding", "sep", "mp")
@@ -240,7 +241,7 @@ class PipelineLayer(Layer):
         if op is None:
             op = _make_xfer_op(dst, src_sh, f"pp_xfer_{dst_stage}")
             self._xfer_cache[key] = op
-        return dispatch(op, (x,), {})
+        return _op_registry.dispatch(op, (x,), {})
 
     def transfer_to_part(self, x: Tensor, part: int) -> Tensor:
         """Differentiable move of an activation onto `part`'s stage

@@ -17,7 +17,8 @@ import jax
 from ...core.tensor import Tensor
 from ...core.generator import rng_scope, next_key
 from ...nn.layer import Layer
-from ...ops.registry import OpDef, dispatch
+from ...ops.registry import OpDef
+from ...ops import registry as _op_registry
 from ...autograd import tape
 
 
@@ -54,7 +55,7 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
 
     opdef = OpDef(f"recompute_{getattr(fn, '__name__', 'fn')}", raw)
     seed = next_key() if preserve_rng_state else jax.random.PRNGKey(0)
-    out = dispatch(opdef, (seed, list(ptensors), list(args), dict(kwargs)),
+    out = _op_registry.dispatch(opdef, (seed, list(ptensors), list(args), dict(kwargs)),
                    {})
     flat, _ = jax.tree_util.tree_flatten(
         out, is_leaf=lambda x: isinstance(x, Tensor))

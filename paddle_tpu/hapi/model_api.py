@@ -291,7 +291,8 @@ class Model:
 
 
 def summary(net, input_size=None, dtypes=None, input=None):
-    """(ref: python/paddle/hapi/model_summary.py)"""
+    """(ref: python/paddle/hapi/model_summary.py; total FLOPs row via
+    utils.flops when input_size is given, the dynamic_flops wiring)"""
     lines = []
     total_params = 0
     trainable = 0
@@ -303,11 +304,24 @@ def summary(net, input_size=None, dtypes=None, input=None):
         lines.append(f"{name:<60} {str(tuple(p.shape)):<20} {n:>12,}")
     header = f"{'Layer (param)':<60} {'Shape':<20} {'Param #':>12}"
     sep = "-" * 94
-    out = "\n".join([sep, header, sep] + lines + [
+    tail = [
         sep,
         f"Total params: {total_params:,}",
         f"Trainable params: {trainable:,}",
         f"Non-trainable params: {total_params - trainable:,}",
-        sep])
+    ]
+    total_flops = None
+    if input_size is not None:
+        from ..utils import flops as _flops
+        try:
+            total_flops = _flops(net, input_size)
+            tail.append(f"Total FLOPs (fwd): {total_flops:,}")
+        except Exception:
+            pass
+    tail.append(sep)
+    out = "\n".join([sep, header, sep] + lines + tail)
     print(out)
-    return {"total_params": total_params, "trainable_params": trainable}
+    res = {"total_params": total_params, "trainable_params": trainable}
+    if total_flops is not None:
+        res["total_flops"] = total_flops
+    return res

@@ -1,0 +1,104 @@
+"""paddle.hub (ref: python/paddle/hub.py) — hubconf.py-protocol model
+loading from a local directory or a GitHub repo.
+
+The github/gitee sources download an archive into a local cache and then
+delegate to the local loader; in an air-gapped deployment the download
+raises with a pointer to the `source='local'` path (the protocol —
+hubconf.py exposing entrypoint callables — is identical either way)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import zipfile
+
+__all__ = ["list", "help", "load"]
+
+_HUB_DIR = os.path.expanduser(
+    os.environ.get("PADDLE_HUB_DIR", "~/.cache/paddle_tpu/hub"))
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no {MODULE_HUBCONF} in {repo_dir!r} — a hub repo must "
+            "define its entrypoints there")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _github_dir(repo, source, force_reload=False):
+    """Download owner/repo[:branch] into the hub cache; returns the
+    extracted directory. force_reload discards the cached checkout."""
+    if ":" in repo:
+        name, branch = repo.split(":", 1)
+    else:
+        name, branch = repo, "main"
+    owner, proj = name.split("/")
+    host = "github.com" if source == "github" else "gitee.com"
+    url = f"https://{host}/{owner}/{proj}/archive/{branch}.zip"
+    os.makedirs(_HUB_DIR, exist_ok=True)
+    out = os.path.join(_HUB_DIR, f"{owner}_{proj}_{branch}")
+    if os.path.isdir(out):
+        if not force_reload:
+            return out
+        import shutil
+        shutil.rmtree(out)
+    zip_path = out + ".zip"
+    try:
+        import urllib.request
+        urllib.request.urlretrieve(url, zip_path)
+    except Exception as e:
+        raise RuntimeError(
+            f"hub: could not download {url} ({e}). In an offline "
+            "deployment clone the repo and use "
+            "hub.load(local_dir, ..., source='local').") from e
+    with zipfile.ZipFile(zip_path) as z:
+        z.extractall(_HUB_DIR)
+        root = z.namelist()[0].split("/")[0]
+    os.rename(os.path.join(_HUB_DIR, root), out)
+    os.remove(zip_path)
+    return out
+
+
+def _resolve(repo_dir, source, force_reload=False):
+    if source == "local":
+        return repo_dir
+    if source in ("github", "gitee"):
+        return _github_dir(repo_dir, source, force_reload)
+    raise ValueError(f"unknown hub source {source!r} "
+                     "(expected 'github', 'gitee' or 'local')")
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
+    return sorted(n for n, v in vars(mod).items()
+                  if callable(v) and not n.startswith("_"))
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """The entrypoint's docstring."""
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"hub entrypoint {model!r} not found")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call the entrypoint and return the constructed model."""
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"hub entrypoint {model!r} not found")
+    return fn(**kwargs)

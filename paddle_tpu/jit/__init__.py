@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..core.generator import rng_scope, next_key
 from ..nn.layer import Layer
-from ..ops.registry import OpDef, dispatch
+from ..ops.registry import OpDef
+from ..ops import registry as _op_registry
 from ..autograd import tape
 
 
@@ -154,7 +155,9 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         training = self._layer.training if self._layer is not None else False
-        key = (len(args), tuple(sorted(kwargs)), training)
+        from ..core.flags import trace_epoch
+        key = (len(args), tuple(sorted(kwargs)), training,
+               trace_epoch[0])
         entry = self._op_cache.get(key)
         if entry is None:
             entry = self._make_op(len(args), tuple(sorted(kwargs)), training)
@@ -163,7 +166,7 @@ class StaticFunction:
         seed = next_key()
         self._probe_stageable(key, opdef, seed, ptensors, btensors,
                               args, kwargs)
-        out = dispatch(opdef, (seed, list(ptensors), list(btensors),
+        out = _op_registry.dispatch(opdef, (seed, list(ptensors), list(btensors),
                                list(args), dict(kwargs)), {})
         # rewrap to the original structure
         tree = traced._out_tree

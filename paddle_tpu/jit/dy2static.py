@@ -523,7 +523,8 @@ class StagedRegion:
         self.eager_calls = 0
 
     def _signature(self, vals):
-        sig = []
+        from ..core.flags import trace_epoch
+        sig = [("epoch", trace_epoch[0])]
         for v in vals:
             from ..core.tensor import Tensor
             if isinstance(v, Tensor):

@@ -529,18 +529,32 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     if training:
         x32 = x.astype(jnp.float32)
-        # E[x^2] - E[x]^2 instead of jnp.var: both sums reduce the SAME
-        # input, so XLA's multi-output fusion computes them in ONE pass
-        # over the activation (jnp.var re-reads x after the mean pass —
-        # measured as extra HBM passes in the bandwidth-bound ResNet
-        # step; see BENCH_EXTRA.md resnet analysis)
-        n = 1.0
-        for a in axes:
-            n *= x.shape[a]
-        s1 = jnp.sum(x32, axis=axes)
-        s2 = jnp.sum(x32 * x32, axis=axes)
-        mean = s1 / n
-        var = jnp.maximum(s2 / n - mean * mean, 0.0)
+        from ..core.flags import flag_value
+        if flag_value("FLAGS_fast_bn_stats"):
+            # one-pass statistics: E[(x-p)^2] - (E[x]-p)^2 with the
+            # running mean as pivot p. Both sums reduce the SAME
+            # centered input, so XLA multi-output fusion computes them
+            # in ONE read of the activation (jnp.mean+jnp.var re-read
+            # it: measured 27.5 -> 20.6 GB/step on ResNet-50,
+            # BENCH_EXTRA.md; a Welford lax.reduce is stable but
+            # defeats the fusion). Precision caveat on the flag help.
+            n = 1.0
+            for a in axes:
+                n *= x.shape[a]
+            shape = [1] * x.ndim
+            shape[ch_axis] = x.shape[ch_axis]
+            pivot = jax.lax.stop_gradient(
+                running_mean.astype(jnp.float32)).reshape(shape)
+            xc = x32 - pivot
+            s1 = jnp.sum(xc, axis=axes)
+            s2 = jnp.sum(xc * xc, axis=axes)
+            d = s1 / n
+            mean = d + pivot.reshape(-1)
+            var = jnp.maximum(s2 / n - d * d, 0.0)
+        else:
+            # default: exact two-pass moments (reference cuDNN parity)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
         new_rm = momentum * running_mean + (1 - momentum) * mean
         new_rv = momentum * running_var + (1 - momentum) * var
     else:

@@ -168,7 +168,8 @@ def _cache_key(opdef, treedef, leaves, tensor_pos, diff_pos):
     WHICH cache dict the key lives in)."""
     if not getattr(opdef, "cacheable", True):
         return None
-    parts = [treedef, tuple(diff_pos)]
+    from ..core.flags import trace_epoch
+    parts = [treedef, tuple(diff_pos), trace_epoch[0]]
     for i, leaf in enumerate(leaves):
         if i in tensor_pos:
             d = leaf._data if _is_tensor(leaf) else leaf
@@ -199,6 +200,8 @@ def _get_exec_entry(opdef, treedef, leaves, tensor_pos, diff_pos,
         # LRU: move the hit to the end so eviction order tracks recency
         # (python dicts preserve insertion order)
         cache[key] = cache.pop(key)
+        if _PROFILING:          # TLS write only while recording
+            _prof_tls.cache_hit = True
         return entry, key
     fn = opdef.fn
     arr_pos = list(tensor_pos)
@@ -240,7 +243,40 @@ def _get_exec_entry(opdef, treedef, leaves, tensor_pos, diff_pos,
     return entry, key
 
 
-def dispatch(opdef: OpDef, args, kwargs):
+import threading as _threading  # noqa: E402
+
+_prof_tls = _threading.local()  # per-thread cache-hit flag: DataLoader
+_prof_tls.cache_hit = False     # workers dispatch concurrently
+
+
+def _dispatch_profiled(opdef: OpDef, args, kwargs):
+    """Profiling variant of dispatch: reports a per-op span (name, host
+    time, executable-cache hit) — the reference opens a RecordEvent in
+    every generated ad_func (eager_gen.py:251). The profiler swaps the
+    module-global `dispatch` between this and the bare `_dispatch` at
+    start()/stop() (all callers resolve `dispatch` late), so the
+    NON-profiled path pays zero overhead."""
+    import time as _time
+    from ..profiler import _record_op
+    _prof_tls.cache_hit = False
+    t0 = _time.perf_counter_ns()
+    try:
+        return _dispatch(opdef, args, kwargs)
+    finally:
+        _record_op(opdef.name, t0,
+                   getattr(_prof_tls, "cache_hit", False))
+
+
+_PROFILING = False
+
+
+def _set_op_profiling(on: bool) -> None:
+    global dispatch, _PROFILING
+    _PROFILING = on
+    dispatch = _dispatch_profiled if on else _dispatch
+
+
+def _dispatch(opdef: OpDef, args, kwargs):
     """The eager per-op path (ad_func analog)."""
     bound = opdef.sig.bind(*args, **kwargs)
     arguments = dict(bound.arguments)
@@ -397,6 +433,11 @@ def dispatch(opdef: OpDef, args, kwargs):
 
     out = jax.tree_util.tree_unflatten(out_tree, list(flat_out))
     return _wrap_outputs(opdef, out, node=node)
+
+
+# the live dispatch pointer: _set_op_profiling swaps it to the
+# profiling variant while a Profiler is recording
+dispatch = _dispatch
 
 
 def _wrap_outputs(opdef, out, node: Optional[GradNode]):

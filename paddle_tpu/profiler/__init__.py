@@ -37,6 +37,29 @@ _events: List[dict] = []
 _events_lock = threading.Lock()
 _enabled = False
 
+# --- per-op dispatch spans (ref: eager_gen.py:251 "Dygraph Record
+# Event" slot — the reference opens a platform::RecordEvent in every
+# generated ad_func; here ops.registry._dispatch_profiled reports into
+# this aggregator; the profiler swaps the live dispatch pointer so the
+# non-recording path pays nothing). chrome-trace events are NOT emitted
+# per op (that would distort the timeline the XLA trace covers).
+_op_stats: dict = {}
+_op_stats_lock = threading.Lock()
+
+
+def _record_op(name: str, t0_ns: int, cached: bool) -> None:
+    dur = (time.perf_counter_ns() - t0_ns) / 1e6
+    with _op_stats_lock:
+        st = _op_stats.get(name)
+        if st is None:
+            st = _op_stats[name] = [0, 0.0, 0.0, 0]  # calls,total,max,hits
+        st[0] += 1
+        st[1] += dur
+        if dur > st[2]:
+            st[2] = dur
+        if cached:
+            st[3] += 1
+
 
 class RecordEvent:
     """(ref: paddle.profiler.RecordEvent / C++ platform/profiler/
@@ -115,6 +138,9 @@ class Profiler:
     def start(self):
         global _enabled, _events
         _enabled = True
+        from ..ops import registry as _registry
+        _registry._set_op_profiling(True)
+        _op_stats.clear()
         with _events_lock:
             _events = []
         if not self.timer_only:
@@ -129,6 +155,8 @@ class Profiler:
     def stop(self):
         global _enabled
         _enabled = False
+        from ..ops import registry as _registry
+        _registry._set_op_profiling(False)
         if self._jax_trace_dir is not None:
             try:
                 jax.profiler.stop_trace()
@@ -147,16 +175,41 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
+        lines = []
+        with _op_stats_lock:
+            op_rows = sorted(_op_stats.items(), key=lambda kv: -kv[1][1])
+        if op_detail and op_rows:
+            # per-op dispatch table (ref: profiler_statistic.py
+            # "Operator Summary" — calls / total / avg / max host time
+            # + executable-cache hit ratio, this backend's analog of
+            # the reference's kernel-launch breakdown)
+            lines.append("-------------------  Operator Summary  "
+                         "-------------------")
+            lines.append(f"{'op':<36} {'calls':>7} {'total_ms':>10} "
+                         f"{'avg_ms':>8} {'max_ms':>8} {'cache%':>7}")
+            for name, (n, tot, mx, hits) in op_rows:
+                lines.append(
+                    f"{name:<36} {n:>7} {tot:>10.3f} {tot / n:>8.3f} "
+                    f"{mx:>8.3f} {100.0 * hits / n:>6.1f}%")
         evs = self.events()
         agg = {}
         for e in evs:
             a = agg.setdefault(e["name"], [0.0, 0])
             a[0] += e["dur"] / 1000.0
             a[1] += 1
-        lines = [f"{'name':<50} {'calls':>8} {'total_ms':>12}"]
-        for name, (tot, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-            lines.append(f"{name:<50} {n:>8} {tot:>12.3f}")
+        if agg:
+            lines.append("-------------------  UserDefined Summary  "
+                         "-----------------")
+            lines.append(f"{'name':<50} {'calls':>8} {'total_ms':>12}")
+            for name, (tot, n) in sorted(agg.items(),
+                                         key=lambda kv: -kv[1][0]):
+                lines.append(f"{name:<50} {n:>8} {tot:>12.3f}")
         return "\n".join(lines)
+
+    def op_stats(self):
+        """Raw per-op rows: {name: (calls, total_ms, max_ms, cache_hits)}."""
+        with _op_stats_lock:
+            return {k: tuple(v) for k, v in _op_stats.items()}
 
     def __enter__(self):
         return self.start()

@@ -107,3 +107,7 @@ def load_inference_model(path_prefix, executor=None, **kw):
 def name_scope(prefix=None):
     import contextlib
     return contextlib.nullcontext()
+
+
+# control-flow sugar (ref: python/paddle/static/nn/control_flow.py)
+from . import nn  # noqa: E402,F401

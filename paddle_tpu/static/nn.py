@@ -1,0 +1,131 @@
+"""paddle.static.nn control-flow sugar (ref:
+python/paddle/static/nn/control_flow.py).
+
+TPU-native rendering: `cond`/`while_loop` ARE `jax.lax.cond` /
+`jax.lax.while_loop` over Tensor pytrees — the same primitives
+@to_static lowers Python `if`/`while` onto (jit/dy2static.py). Under
+eager execution with a concrete predicate, only the taken branch runs
+(the reference's semantics for materialized conditions)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor._wrap(a) if isinstance(a, jax.Array) else a,
+        tree)
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """Run true_fn or false_fn depending on pred (0-D bool Tensor).
+    Concrete pred -> only the taken branch executes; traced pred ->
+    lax.cond with both branches traced (outputs must match in
+    structure/shape, the reference's select_input contract)."""
+    p = _arr(pred)
+    if not isinstance(p, jax.core.Tracer):
+        taken = true_fn if bool(p) else false_fn
+        return taken() if taken is not None else None
+    if true_fn is None or false_fn is None:
+        raise ValueError(
+            "cond under tracing requires both true_fn and false_fn")
+    return _wrap_tree(jax.lax.cond(
+        p, lambda _: _unwrap_tree(true_fn()),
+        lambda _: _unwrap_tree(false_fn()), 0))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """ref: static/nn/control_flow.py while_loop — loop_vars is a
+    list/tuple of Tensors threaded through body."""
+    vals = _unwrap_tree(tuple(loop_vars))
+    concrete = not any(
+        isinstance(v, jax.core.Tracer)
+        for v in jax.tree_util.tree_leaves(vals))
+    if concrete:
+        wrapped = _wrap_tree(vals)
+        while bool(_arr(cond_fn(*wrapped))):
+            wrapped = tuple(body_fn(*wrapped))
+        return wrapped
+
+    def c(carry):
+        return _arr(cond_fn(*_wrap_tree(carry)))
+
+    def b(carry):
+        return _unwrap_tree(tuple(body_fn(*_wrap_tree(carry))))
+
+    return _wrap_tree(jax.lax.while_loop(c, b, vals))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First matching (pred, fn) wins (ref: control_flow.py case)."""
+    for i, (pred, fn) in enumerate(pred_fn_pairs):
+        p = _arr(pred)
+        if isinstance(p, jax.core.Tracer):
+            # nest conds over the remaining pairs
+            rest = pred_fn_pairs[i + 1:]
+            return cond(pred, fn,
+                        (lambda: case(rest, default)) if (rest or default)
+                        else None)
+        if bool(p):
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("case: no predicate matched and no default given")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref: control_flow.py switch_case — integer-indexed branches."""
+    idx = _arr(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    keys = sorted(fns)
+    if not isinstance(idx, jax.core.Tracer):
+        fn = fns.get(int(idx), default)
+        if fn is None:
+            raise ValueError(
+                f"switch_case: no branch for index {int(idx)} and no "
+                "default")
+        return fn()
+    if default is None:
+        default = fns[keys[-1]]
+    span = max(keys) - min(keys) + 1
+    if span <= 4 * len(keys) and span <= 256:
+        # dense-enough keys: one lax.switch table
+        table = [fns.get(k, default) for k in range(min(keys),
+                                                    max(keys) + 1)]
+        off = min(keys)
+        clamped = jnp.clip(idx - off, 0, len(table) - 1)
+        in_range = (idx >= off) & (idx <= max(keys))
+        out = jax.lax.cond(
+            in_range,
+            lambda: jax.lax.switch(
+                clamped,
+                [lambda _=None, f=f: _unwrap_tree(f()) for f in table]),
+            lambda: _unwrap_tree(default()))
+        return _wrap_tree(out)
+    # sparse keys: compact switch over the branch LIST indexed via a
+    # device-side key lookup (no dense table blowup)
+    karr = jnp.asarray(keys)
+    pos = jnp.searchsorted(karr, idx)
+    pos_c = jnp.clip(pos, 0, len(keys) - 1)
+    matched = karr[pos_c] == idx
+    branch = jnp.where(matched, pos_c, len(keys))
+    fn_list = [lambda _=None, f=fns[k]: _unwrap_tree(f()) for k in keys]
+    fn_list.append(lambda _=None: _unwrap_tree(default()))
+    return _wrap_tree(jax.lax.switch(branch, fn_list))

@@ -52,30 +52,76 @@ def run_check():
     return True
 
 
-def flops(net, input_size, custom_ops=None, print_detail=False):
-    """Rough FLOPs counter (ref: python/paddle/utils/flops.py)."""
-    from ..nn.layer import Layer
+def _layer_flops(layer, inputs, output):
+    """Per-layer FLOP formulas (ref: python/paddle/utils/flops.py:27 /
+    hapi/dynamic_flops.py count_* registry). Returns None for layers
+    with no registered counter."""
+    import numpy as _np
     from .. import nn as _nn
+
+    def prod(s):
+        return int(_np.prod(s))
+
+    if isinstance(layer, _nn.Linear):
+        rows = prod(inputs[0].shape) // inputs[0].shape[-1]
+        return 2 * rows * layer.weight.shape[0] * layer.weight.shape[1]
+    if isinstance(layer, (_nn.Conv1D, _nn.Conv2D, _nn.Conv3D)):
+        k = prod(layer.kernel_size) if hasattr(layer, "kernel_size") \
+            else 1
+        return (2 * prod(output.shape)
+                * layer.in_channels // layer.groups * k)
+    if isinstance(layer, (_nn.BatchNorm1D, _nn.BatchNorm2D,
+                          _nn.BatchNorm3D, _nn.BatchNorm,
+                          _nn.LayerNorm, _nn.GroupNorm,
+                          _nn.InstanceNorm2D)):
+        return 2 * prod(output.shape)
+    if isinstance(layer, (_nn.ReLU, _nn.GELU, _nn.Sigmoid, _nn.Tanh,
+                          _nn.Softmax)):
+        return prod(output.shape)
+    if isinstance(layer, (_nn.AvgPool2D, _nn.MaxPool2D,
+                          _nn.AdaptiveAvgPool2D)):
+        return prod(output.shape)
+    return None
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """FLOPs counter over a forward pass (ref:
+    python/paddle/utils/flops.py:27 via hapi/dynamic_flops.py paddle.flops).
+    custom_ops: {LayerType: fn(layer, inputs, output) -> flops}."""
     total = [0]
+    rows = []
+    custom_ops = custom_ops or {}
 
     def hook(layer, inputs, output):
-        import numpy as _np
-        if isinstance(layer, _nn.Linear):
-            total[0] += 2 * int(_np.prod(inputs[0].shape)) // inputs[0].shape[-1] \
-                * layer.weight.shape[0] * layer.weight.shape[1]
-        elif isinstance(layer, _nn.Conv2D):
-            oshape = output.shape
-            kh, kw = layer.kernel_size
-            total[0] += (2 * oshape[0] * oshape[1] * oshape[2] * oshape[3]
-                         * layer.in_channels // layer.groups * kh * kw)
+        fn = None
+        for cls, f in custom_ops.items():
+            if isinstance(layer, cls):
+                fn = f
+                break
+        n = fn(layer, inputs, output) if fn else \
+            _layer_flops(layer, inputs, output)
+        if n:
+            total[0] += int(n)
+            rows.append((type(layer).__name__,
+                         tuple(getattr(output, "shape", ())), int(n)))
 
     handles = [l.register_forward_post_hook(hook)
                for l in net.sublayers(include_self=True)]
     from ..ops import zeros
-    x = zeros(input_size)
-    net(x)
-    for h in handles:
-        h.remove()
+    was_training = net.training
+    net.eval()
+    try:
+        net(zeros(input_size))
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            h.remove()
+    if print_detail:
+        print(f"{'layer':<24} {'output shape':<24} {'FLOPs':>16}")
+        for name, shape, n in rows:
+            print(f"{name:<24} {str(shape):<24} {n:>16,}")
+        print(f"Total FLOPs: {total[0]:,}")
     return total[0]
 
 

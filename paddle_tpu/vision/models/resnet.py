@@ -6,6 +6,20 @@ from ... import nn
 from ... import ops
 
 
+
+
+def _norm(norm_layer, ch, df):
+    """Pass data_format only to norm layers that accept it (custom
+    norm_layer callables may not). The no-kwarg fallback is only legal
+    in the default NCHW layout — an NHWC model MUST layout-configure
+    its norms, so there the TypeError propagates."""
+    if df == "NCHW":
+        try:
+            return norm_layer(ch, data_format=df)
+        except TypeError:
+            return norm_layer(ch)
+    return norm_layer(ch, data_format=df)
+
 class BasicBlock(nn.Layer):
     expansion = 1
 
@@ -17,11 +31,11 @@ class BasicBlock(nn.Layer):
         df = data_format
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
                                bias_attr=False, data_format=df)
-        self.bn1 = norm_layer(planes, data_format=df)
+        self.bn1 = _norm(norm_layer, planes, df)
         self.relu = nn.ReLU()
         self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
                                data_format=df)
-        self.bn2 = norm_layer(planes, data_format=df)
+        self.bn2 = _norm(norm_layer, planes, df)
         self.downsample = downsample
         self.stride = stride
 
@@ -46,14 +60,14 @@ class BottleneckBlock(nn.Layer):
         width = int(planes * (base_width / 64.0)) * groups
         self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
                                data_format=df)
-        self.bn1 = norm_layer(width, data_format=df)
+        self.bn1 = _norm(norm_layer, width, df)
         self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation,
                                groups=groups, dilation=dilation,
                                bias_attr=False, data_format=df)
-        self.bn2 = norm_layer(width, data_format=df)
+        self.bn2 = _norm(norm_layer, width, df)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
                                bias_attr=False, data_format=df)
-        self.bn3 = norm_layer(planes * self.expansion, data_format=df)
+        self.bn3 = _norm(norm_layer, planes * self.expansion, df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 

@@ -1,0 +1,67 @@
+"""Per-op profiler spans (VERDICT r3 missing #3 / next-5): dispatch
+must report per-op rows while a Profiler records, with zero overhead
+when not recording (the live dispatch pointer is swapped, not checked
+per call)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import profiler
+import paddle_tpu.ops.registry as registry
+
+
+def _train_steps(n=50):
+    lin1, lin2 = pt.nn.Linear(32, 32), pt.nn.Linear(32, 32)
+    opt = pt.optimizer.SGD(learning_rate=1e-3,
+                           parameters=lin1.parameters()
+                           + lin2.parameters())
+    x = pt.to_tensor(np.random.default_rng(0).standard_normal(
+        (4, 32)).astype(np.float32))
+    for _ in range(n):
+        h = pt.ops.tanh(lin1(x))
+        loss = (lin2(h) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+def test_summary_lists_per_op_rows():
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    _train_steps(50)
+    p.stop()
+    rows = p.op_stats()
+    for op in ("linear", "tanh", "mean", "pow"):
+        assert op in rows, f"{op} missing from op stats"
+        calls, total_ms, max_ms, hits = rows[op]
+        assert calls >= 50 and total_ms > 0
+    # linear runs twice per fwd + its use in sgd? at least 100 calls
+    assert rows["linear"][0] >= 100
+    # warm caches -> hit ratio must be high
+    assert rows["linear"][3] / rows["linear"][0] > 0.9
+    text = p.summary()
+    assert "Operator Summary" in text
+    assert "linear" in text and "tanh" in text
+
+
+def test_dispatch_pointer_swaps():
+    assert registry.dispatch is registry._dispatch
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    try:
+        assert registry.dispatch is registry._dispatch_profiled
+    finally:
+        p.stop()
+    assert registry.dispatch is registry._dispatch
+
+
+def test_stats_reset_between_sessions():
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    _train_steps(2)
+    p.stop()
+    first = p.op_stats()["linear"][0]
+    p2 = profiler.Profiler(timer_only=True)
+    p2.start()
+    _train_steps(1)
+    p2.stop()
+    assert p2.op_stats()["linear"][0] < first

@@ -1,0 +1,136 @@
+"""Round-4 small-fry API batch (VERDICT r3 missing #4 / next-7):
+paddle.hub, utils.flops + summary wiring, iinfo/finfo, static.nn
+control flow."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_iinfo_finfo():
+    ii = pt.iinfo("int8")
+    assert (ii.min, ii.max, ii.bits) == (-128, 127, 8)
+    fi = pt.finfo("float32")
+    assert fi.bits == 32 and fi.eps == np.finfo(np.float32).eps
+    bf = pt.finfo("bfloat16")
+    assert bf.bits == 16 and bf.eps == 0.0078125
+    with pytest.raises(Exception):
+        pt.iinfo("not_a_dtype")
+
+
+@pytest.fixture
+def hub_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "import paddle_tpu as pt\n"
+        "def tiny_mlp(width=8):\n"
+        "    'A tiny MLP.'\n"
+        "    return pt.nn.Linear(4, width)\n"
+        "def _private():\n"
+        "    pass\n")
+    return str(tmp_path)
+
+
+def test_hub_local(hub_repo):
+    assert pt.hub.list(hub_repo, source="local") == ["tiny_mlp"]
+    assert "tiny MLP" in pt.hub.help(hub_repo, "tiny_mlp",
+                                     source="local")
+    m = pt.hub.load(hub_repo, "tiny_mlp", source="local", width=16)
+    assert list(m.weight.shape) == [4, 16]
+    with pytest.raises(RuntimeError):
+        pt.hub.load(hub_repo, "nope", source="local")
+
+
+def test_hub_missing_hubconf(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        pt.hub.list(str(tmp_path), source="local")
+
+
+def test_flops_counts_linear_and_conv():
+    lin = pt.nn.Linear(8, 4)
+    n = pt.utils.flops(lin, (2, 8))
+    assert n == 2 * 2 * 8 * 4
+    conv = pt.nn.Conv2D(3, 6, 3, padding=1)
+    n = pt.utils.flops(conv, (1, 3, 8, 8))
+    assert n == 2 * (1 * 6 * 8 * 8) * 3 * 9
+
+
+def test_flops_custom_ops_and_detail(capsys):
+    lin = pt.nn.Linear(8, 4)
+    n = pt.utils.flops(lin, (1, 8),
+                       custom_ops={pt.nn.Linear: lambda l, i, o: 123},
+                       print_detail=True)
+    assert n == 123
+    assert "Total FLOPs" in capsys.readouterr().out
+
+
+def test_summary_reports_flops(capsys):
+    from paddle_tpu.hapi import summary
+    lin = pt.nn.Linear(8, 4)
+    res = summary(lin, input_size=(1, 8))
+    out = capsys.readouterr().out
+    assert "Total FLOPs" in out
+    assert res["total_flops"] == 2 * 8 * 4
+
+
+class TestStaticNNControlFlow:
+    def test_cond_eager_runs_only_taken_branch(self):
+        import paddle_tpu.static as st
+        hits = []
+
+        def t():
+            hits.append("t")
+            return pt.to_tensor(1.0)
+
+        def f():
+            hits.append("f")
+            return pt.to_tensor(2.0)
+
+        out = st.nn.cond(pt.to_tensor(False), t, f)
+        assert float(out.numpy()) == 2.0 and hits == ["f"]
+
+    def test_cond_traced(self):
+        import jax
+        import paddle_tpu.static as st
+
+        def fn(p):
+            return st.nn.cond(
+                pt.Tensor._wrap(p),
+                lambda: pt.to_tensor(np.ones(3, np.float32)) * 2,
+                lambda: pt.to_tensor(np.ones(3, np.float32)) * 5)._data
+
+        jf = jax.jit(fn)
+        np.testing.assert_allclose(np.asarray(jf(np.asarray(True))),
+                                   2.0)
+        np.testing.assert_allclose(np.asarray(jf(np.asarray(False))),
+                                   5.0)
+
+    def test_while_loop_eager_and_traced(self):
+        import jax
+        import paddle_tpu.static as st
+        i, acc = st.nn.while_loop(
+            lambda i, a: i < 4, lambda i, a: [i + 1, a + i],
+            [pt.to_tensor(0), pt.to_tensor(0)])
+        assert int(i.numpy()) == 4 and int(acc.numpy()) == 6
+
+        def fn(x0):
+            i, a = st.nn.while_loop(
+                lambda i, a: i._data < 4, lambda i, a: [i + 1, a + i],
+                [pt.Tensor._wrap(x0), pt.to_tensor(0)])
+            return a._data
+
+        assert int(jax.jit(fn)(np.asarray(0))) == 6
+
+    def test_case_and_switch_case(self):
+        import paddle_tpu.static as st
+        out = st.nn.case([(pt.to_tensor(False), lambda: pt.to_tensor(1)),
+                          (pt.to_tensor(True), lambda: pt.to_tensor(2))],
+                         default=lambda: pt.to_tensor(3))
+        assert int(out.numpy()) == 2
+        out = st.nn.switch_case(pt.to_tensor(7), {
+            1: lambda: pt.to_tensor(10), 7: lambda: pt.to_tensor(70)},
+            default=lambda: pt.to_tensor(-1))
+        assert int(out.numpy()) == 70
+        out = st.nn.switch_case(pt.to_tensor(9), {
+            1: lambda: pt.to_tensor(10)},
+            default=lambda: pt.to_tensor(-1))
+        assert int(out.numpy()) == -1
