@@ -9,9 +9,16 @@ stage. The single controller owns every stage, so the schedule becomes:
 for each micro-batch, run all stages forward (stage s+1's input arrives
 via the differentiable transfer op) and backward immediately — per-rank
 this IS 1F1B's steady state (one forward then one backward in flight per
-stage pair), and XLA's async dispatch overlaps stage s's compute of
-micro-batch m+1 with stage s+1's of m. Gradients accumulate across
-micro-batches on the tape; the optimizer steps once per train_batch.
+stage pair). Whether stage s's compute of micro-batch m+1 actually
+overlaps stage s+1's of m depends on the runtime: on a real pod each
+host/chip has its own executor and XLA's async dispatch provides it; on
+the single-core CI box both the virtual devices AND dispatch share one
+worker, so overlap is measured INDIRECTLY (tests/test_pipeline_overlap
+.py): the emitted unit order replayed on independent executors against
+its data dependencies achieves the analytic 1F1B bubble (p-1)/(m+p-1),
+and the measured device timeline shows the queue never starving on
+Python. Gradients accumulate across micro-batches on the tape; the
+optimizer steps once per train_batch.
 """
 from __future__ import annotations
 

@@ -191,8 +191,63 @@ def _seg_operands(segment_ids, b, sq, sk):
     return q_seg, kv_seg
 
 
+def _autotuned_blocks(kind, q, k, H, Hk, causal, has_seg, defaults,
+                      run_shape, normalize):
+    """Per-(shape-class, device-generation) {block_q, block_k} search
+    (ref: phi/kernels/autotune/switch_autotune.cc). First call measures
+    a candidate set (hand-tuned defaults included, so tuned >= default
+    up to noise) on synthetic data and persists the winner; later calls
+    and later PROCESSES pay one dict lookup. Tracer-safe: measurement
+    uses fresh concrete arrays, never the traced operands."""
+    from . import autotune
+    import jax as _jax
+    if not autotune.enabled():
+        # the kill-switch restores hand-tuned defaults even when a
+        # (possibly noise-picked) winner is already cached
+        return defaults
+    b, sq, HD = q.shape
+    sk = k.shape[1]
+    HkD = k.shape[2]
+    key = (kind, b, sq, sk, H, Hk, HD // H, str(q.dtype), int(causal),
+           int(has_seg))
+    hit = autotune.lookup(key)
+    if hit is not None:
+        return hit
+    if _jax.process_count() > 1:
+        # multi-host SPMD needs IDENTICAL programs on every host; noisy
+        # per-host searches could pick different winners and diverge at
+        # the first collective. Use defaults unless the operator
+        # distributed one pre-seeded cache file to all hosts.
+        return defaults
+    cands = [defaults] + [c for c in
+                          [(256, 512), (128, 1024), (512, 1024)]
+                          if c != defaults]
+    # normalize through the same fit/pick THE USE SITE applies (fwd and
+    # bwd differ: bwd grows block_k for long sk and buffers more), so
+    # candidates that collapse to one real config are deduped
+    seen, norm = set(), []
+    for c0 in cands:
+        c = normalize(*c0)
+        if c not in seen:
+            seen.add(c)
+            norm.append(c)
+    if len(norm) == 1:
+        return norm[0]
+
+    # run_shape(bq, bk) returns a ZERO-ARG jitted runner: one compile
+    # per candidate across ALL timing rounds (a fresh pallas_call
+    # closure per invocation would recompile every sample — measured
+    # 500 s of tuning vs ~90 s with cached runners)
+    runners: dict = {}
+    return autotune.tune(
+        key, norm,
+        lambda c: autotune._time_call(
+            runners.setdefault(c, run_shape(*c))))
+
+
 def _flash_fwd_fused(q, k, v, H, causal, block_q=256, block_k=1024,
-                     interpret=False, Hk=None, segment_ids=None):
+                     interpret=False, Hk=None, segment_ids=None,
+                     autotune_ok=True):
     """q: [b, s, H*D]; k,v: [b, sk, Hk*D] (q pre-scaled by sm_scale).
     Hk < H = grouped-query attention (q-head h reads kv-head h // (H//Hk)).
     segment_ids: optional (q_seg [b, sq], kv_seg [b, sk]) int32 — scores
@@ -203,12 +258,42 @@ def _flash_fwd_fused(q, k, v, H, causal, block_q=256, block_k=1024,
     D = HD // H
     Hk = H if Hk is None else Hk
     HkD = Hk * D
+    has_seg = segment_ids is not None
+    if autotune_ok and not interpret and (block_q, block_k) == (256, 1024):
+
+        def run_shape(bq, bk):
+            rng = np.random.default_rng(0)
+            qs = jnp.asarray(rng.standard_normal((b, sq, HD)) * 0.1,
+                             q.dtype)
+            ks = jnp.asarray(rng.standard_normal((sk, HkD)) * 0.1,
+                             q.dtype)[None].repeat(b, 0)
+            seg = None
+            if has_seg:
+                seg = (jnp.zeros((b, sq), jnp.int32),
+                       jnp.zeros((b, sk), jnp.int32))
+
+            @jax.jit
+            def f(qs, ks):
+                out, _ = _flash_fwd_fused(
+                    qs, ks, ks, H, causal, block_q=bq, block_k=bk,
+                    Hk=Hk, segment_ids=seg, autotune_ok=False)
+                return out
+
+            return lambda: f(qs, ks)
+
+        def _norm_fwd(bq, bk):
+            bq2, bk2 = _fit_blocks(bq, bk, HD, n_bufs_q=2, n_bufs_k=2,
+                                   HDk=HkD)
+            return (_pick_block(sq, bq2), _pick_block(sk, bk2))
+
+        block_q, block_k = _autotuned_blocks(
+            "fwd", q, k, H, Hk, causal, has_seg, (block_q, block_k),
+            run_shape, _norm_fwd)
     block_q, block_k = _fit_blocks(block_q, block_k, HD,
                                    n_bufs_q=2, n_bufs_k=2, HDk=HkD)
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     grid = (b, sq // block_q, sk // block_k)
-    has_seg = segment_ids is not None
     kernel = functools.partial(
         _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
         H=H, Hk=Hk, D=D, offset=sk - sq, has_seg=has_seg)
@@ -352,7 +437,7 @@ def _bwd_kernel(*refs, causal, block_q, block_k, H, Hk, D, offset, has_seg):
 
 def _flash_bwd_fused(q, k, v, o, lse, do, H, causal,
                      block_q=256, block_k=512, interpret=False,
-                     Hk=None, segment_ids=None):
+                     Hk=None, segment_ids=None, autotune_ok=True):
     """Blockwise dq/dk/dv on the fused-head layout.
 
     q,o,do: [b, sq, H*D] (q pre-scaled); k,v: [b, sk, Hk*D];
@@ -364,6 +449,39 @@ def _flash_bwd_fused(q, k, v, o, lse, do, H, causal,
     D = HD // H
     Hk = H if Hk is None else Hk
     HkD = Hk * D
+    if autotune_ok and not interpret and (block_q, block_k) == (256, 512):
+
+        def run_shape(bq, bk):
+            rng = np.random.default_rng(0)
+            qs = jnp.asarray(rng.standard_normal((b, sq, HD)) * 0.1,
+                             q.dtype)
+            ks = jnp.asarray(rng.standard_normal((sk, HkD)) * 0.1,
+                             q.dtype)[None].repeat(b, 0)
+            lses = jnp.full((b, H * _SUBL, sq), 3.0, jnp.float32)
+            seg = None
+            if segment_ids is not None:
+                seg = (jnp.zeros((b, sq), jnp.int32),
+                       jnp.zeros((b, sk), jnp.int32))
+
+            @jax.jit
+            def f(qs, ks, lses):
+                dq, _, _ = _flash_bwd_fused(
+                    qs, ks, ks, qs, lses, qs, H, causal, block_q=bq,
+                    block_k=bk, Hk=Hk, segment_ids=seg,
+                    autotune_ok=False)
+                return dq
+
+            return lambda: f(qs, ks, lses)
+
+        def _norm_bwd(bq, bk):
+            bk = max(bk, sk // 8)       # the use-site's long-seq grow
+            bq2, bk2 = _fit_blocks(bq, bk, HD, n_bufs_q=3, n_bufs_k=4,
+                                   HDk=HkD)
+            return (_pick_block(sq, bq2), _pick_block(sk, bk2))
+
+        block_q, block_k = _autotuned_blocks(
+            "bwd", q, k, H, Hk, causal, segment_ids is not None,
+            (block_q, block_k), run_shape, _norm_bwd)
     # long sequences: grow K blocks so the dq partial-sum buffer
     # (b * nk * sq * HD) stays bounded at nk <= 8 — _fit_blocks may shrink
     # them back if HD is too wide for VMEM, which keeps correctness and

@@ -1,0 +1,98 @@
+"""Pallas block-size autotune cache (VERDICT r3 missing #5 / next-6):
+pick/persist/reload logic, kill-switch, and reentrancy — the machinery
+is exercised with mocked timings (the real kernel measurement needs the
+TPU; its wiring is validated by the bench, see BENCH_EXTRA.md)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels.pallas import autotune
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CACHE_DIR", str(tmp_path))
+    autotune.clear()
+    yield
+    autotune.clear()
+
+
+def test_picks_fastest_and_persists(tmp_path):
+    times = {(128, 512): 0.03, (256, 1024): 0.01, (512, 512): 0.02}
+    calls = []
+
+    def run(c):
+        calls.append(c)
+        return times[c]
+
+    key = ("fwd", 4, 256, 256, 8, 8, 64, 1, 0)
+    win = autotune.tune(key, list(times), run)
+    assert win == (256, 1024)
+    # every candidate measured at least once
+    assert set(calls) == set(times)
+    # memoized: no more measurement
+    calls.clear()
+    assert autotune.tune(key, list(times), run) == (256, 1024)
+    assert calls == []
+    # survives a fresh in-process state (disk reload)
+    autotune.clear()
+    assert autotune.lookup(key) == (256, 1024)
+    assert autotune.tune(key, list(times), run) == (256, 1024)
+    assert calls == []
+
+
+def test_failed_candidates_are_skipped():
+    def run(c):
+        if c == (512, 512):
+            raise RuntimeError("vmem oom")
+        return {(128, 512): 0.02, (256, 1024): 0.05}[c]
+
+    win = autotune.tune(("bwd", 1, 128, 128, 2, 2, 64, 0, 0),
+                        [(512, 512), (128, 512), (256, 1024)], run)
+    assert win == (128, 512)
+
+
+def test_all_failed_falls_back_to_first():
+    def run(c):
+        raise RuntimeError("nope")
+
+    key = ("fwd", 1, 128, 128, 2, 2, 64, 0, 1)
+    win = autotune.tune(key, [(256, 1024), (128, 512)], run)
+    assert win == (256, 1024)
+    # a transient all-fail must NOT freeze into the cache
+    assert autotune.lookup(key) is None
+
+
+def test_kill_switch(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_AUTOTUNE", "0")
+    assert not autotune.enabled()
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_AUTOTUNE", "1")
+    assert autotune.enabled()
+
+
+def test_reentrancy_guard():
+    """A measurement that re-enters tune() (the kernel under test calls
+    the autotuned entrypoint) must not recurse into another search."""
+    inner_calls = []
+
+    def run_outer(c):
+        w = autotune.tune(("fwd", 9, 9, 9, 9, 9, 9, 9, 9),
+                          [(1, 1), (2, 2)],
+                          lambda c2: inner_calls.append(c2) or 0.01)
+        assert w == (1, 1)          # first candidate, no search
+        return {(128, 512): 0.02, (256, 1024): 0.01}[c]
+
+    win = autotune.tune(("fwd", 2, 256, 256, 4, 4, 64, 1, 0),
+                        [(128, 512), (256, 1024)], run_outer)
+    assert win == (256, 1024)
+    assert inner_calls == []        # inner search never measured
+
+
+def test_distinct_keys_distinct_entries():
+    k1 = ("fwd", 4, 256, 256, 8, 8, 64, 1, 0)
+    k2 = ("fwd", 4, 512, 512, 8, 8, 64, 1, 0)
+    autotune.tune(k1, [(1, 1), (2, 2)], lambda c: {(1, 1): 0.1,
+                                                   (2, 2): 0.2}[c])
+    autotune.tune(k2, [(1, 1), (2, 2)], lambda c: {(1, 1): 0.2,
+                                                   (2, 2): 0.1}[c])
+    assert autotune.lookup(k1) == (1, 1)
+    assert autotune.lookup(k2) == (2, 2)
