@@ -171,19 +171,28 @@ def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
                                pre_ln_scale=None, pre_ln_bias=None,
                                ln_scale=None, ln_bias=None,
                                attn_mask=None, dropout_rate=0.0,
-                               attn_dropout_rate=0.0, training=True):
-    """Composite fused MHA (ref: incubate fused_attention_op)."""
+                               attn_dropout_rate=0.0, training=True,
+                               epsilon=1e-5):
+    """Composite fused MHA (ref: incubate fused_attention_op).
+    attn_dropout_rate > 0 under training routes through the masked SDPA
+    (the Pallas flash kernel is inference/deterministic-only)."""
     from .... import ops
     residual = x
     if pre_layer_norm:
-        x = fused_layer_norm(x, pre_ln_scale, pre_ln_bias)
+        x = fused_layer_norm(x, pre_ln_scale, pre_ln_bias,
+                             epsilon=epsilon)
     b, s, d = x.shape
     qkv = ops.matmul(x, qkv_weight)
     if qkv_bias is not None:
         qkv = qkv + qkv_bias
     qkv = ops.reshape(qkv, (b, s, 3, num_heads, d // num_heads))
     q, k, v = ops.unbind(qkv, axis=2)
-    out = fused_flash_attention(q, k, v, attn_mask=attn_mask)
+    if attn_dropout_rate > 0.0 and training:
+        out = ops.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=attn_dropout_rate, training=True)
+    else:
+        out = fused_flash_attention(q, k, v, attn_mask=attn_mask)
     out = ops.reshape(out, (b, s, d))
     out = ops.matmul(out, linear_weight)
     if linear_bias is not None:
@@ -191,7 +200,7 @@ def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
     out = ops.dropout(out, dropout_rate, training=training)
     out = out + residual
     if not pre_layer_norm:
-        out = fused_layer_norm(out, ln_scale, ln_bias)
+        out = fused_layer_norm(out, ln_scale, ln_bias, epsilon=epsilon)
     return out
 
 

@@ -1,0 +1,277 @@
+"""incubate.nn fused Layer classes (ref: python/paddle/incubate/nn/
+layer/fused_transformer.py: FusedMultiHeadAttention:196,
+FusedFeedForward:502, FusedTransformerEncoderLayer:728,
+FusedMultiTransformer:1025).
+
+Thin parameter-owning wrappers over the fused functionals in
+incubate.nn.functional — ONE implementation serves the functional and
+layer surfaces (the reference generates both from the same fused CUDA
+ops; here the functionals are the XLA/Pallas-fused bodies)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer import Layer
+from ...nn.initializer import Constant
+from . import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """ref: fused_transformer.py:196 — pre/post-LN fused self-MHA."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False,
+                 name=None):
+        super().__init__()
+        if ring_id != -1:
+            raise NotImplementedError(
+                "tensor-parallel fused attention: build under "
+                "fleet.meta_parallel mp layers instead")
+        if kdim not in (None, embed_dim) or vdim not in (None, embed_dim):
+            raise NotImplementedError(
+                "fused attention is self-attention (kdim/vdim must "
+                "equal embed_dim) — the reference op has the same "
+                "contract")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.transpose_qkv_wb = transpose_qkv_wb
+        if transpose_qkv_wb:
+            # reference alternative layout: one [dm, 3*dm] weight
+            self.qkv_weight = self.create_parameter(
+                (embed_dim, 3 * embed_dim), attr=qkv_weight_attr)
+            self.qkv_bias = self.create_parameter(
+                (3 * embed_dim,), attr=qkv_bias_attr, is_bias=True)
+        else:
+            self.qkv_weight = self.create_parameter(
+                (3, num_heads, self.head_dim, embed_dim),
+                attr=qkv_weight_attr)
+            self.qkv_bias = self.create_parameter(
+                (3, num_heads, self.head_dim), attr=qkv_bias_attr,
+                is_bias=True)
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            (embed_dim,), attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        from ... import ops
+        if key is not None and key is not query or \
+                value is not None and value is not query:
+            raise NotImplementedError(
+                "fused attention is self-attention only (key/value must "
+                "be the query) — the reference op has the same contract")
+        if cache is not None:
+            raise NotImplementedError(
+                "incremental decode: use incubate.nn.functional."
+                "masked_multihead_attention / FusedMultiTransformer "
+                "with cache_kvs")
+        if self.transpose_qkv_wb:
+            w, b = self.qkv_weight, self.qkv_bias
+        else:
+            # params keep the reference layout ([3, H, D, dm] /
+            # [3, H, D], 1:1 state_dict mapping); the functional wants
+            # flat [dm, 3HD]
+            hd3 = 3 * self.num_heads * self.head_dim
+            w = ops.transpose(ops.reshape(self.qkv_weight,
+                                          (hd3, self.embed_dim)), (1, 0))
+            b = ops.reshape(self.qkv_bias, (hd3,))
+        return F.fused_multi_head_attention(
+            query, w, b, self.linear_weight,
+            self.linear_bias, self.num_heads,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            epsilon=self.epsilon,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """ref: fused_transformer.py:502 — pre/post-LN fused FFN."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if ring_id != -1:
+            raise NotImplementedError(
+                "tensor-parallel fused FFN: build under "
+                "fleet.meta_parallel mp layers instead")
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            (d_model,), attr=linear2_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (d_model,), default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter((d_model,), is_bias=True)
+
+    def forward(self, src, cache=None):
+        ln_kw = ({"ln1_scale": self.ln_scale, "ln1_bias": self.ln_bias}
+                 if self.normalize_before else
+                 {"ln2_scale": self.ln_scale, "ln2_bias": self.ln_bias})
+        return F.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias,
+            linear2_bias=self.linear2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation,
+            ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before,
+            training=self.training, **ln_kw)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """ref: fused_transformer.py:728 — fused MHA + fused FFN block."""
+
+    def __init__(self, d_model, nhead, dim_feedforward,
+                 dropout_rate=0.1, activation="relu",
+                 attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = dropout_rate if attn_dropout_rate is None \
+            else attn_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        if isinstance(out, tuple):
+            out = out[0]
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """ref: fused_transformer.py:1025 — the whole-stack serving
+    transformer Layer over functional.fused_multi_transformer (prefill
+    writes cache_kvs, decode runs the masked-MHA core at time_step)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        if num_layers == -1:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        if ring_id != -1:
+            raise NotImplementedError(
+                "tensor-parallel serving: shard under fleet mp layers")
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.epsilon = epsilon
+        self.dropout_rate = dropout_rate
+        self.trans_qkvw = trans_qkvw
+        H, D, dm, ffn = num_heads, self.head_dim, embed_dim, \
+            dim_feedforward
+
+        def plist(name, shape, attrs=None, ones=False, bias=False):
+            out = []
+            for i in range(num_layers):
+                attr = attrs[i] if isinstance(attrs, (list, tuple)) \
+                    else attrs
+                p = self.create_parameter(
+                    shape, attr=attr,
+                    default_initializer=Constant(1.0) if ones else None,
+                    is_bias=bias)
+                self.add_parameter(f"{name}_{i}", p)
+                out.append(p)
+            return out
+
+        qkv_shape = (3, H, D, dm) if trans_qkvw else (dm, 3, H, D)
+        self.ln_scales = plist("ln_scale", (dm,), ln_scale_attrs,
+                               ones=True)
+        self.ln_biases = plist("ln_bias", (dm,), ln_bias_attrs,
+                               bias=True)
+        self.qkv_weights = plist("qkv_weight", qkv_shape,
+                                 qkv_weight_attrs)
+        self.qkv_biases = plist("qkv_bias", (3, H, D), qkv_bias_attrs,
+                                bias=True)
+        self.linear_weights = plist("linear_weight", (H * D, dm),
+                                    linear_weight_attrs)
+        self.linear_biases = plist("linear_bias", (dm,),
+                                   linear_bias_attrs, bias=True)
+        self.ffn_ln_scales = plist("ffn_ln_scale", (dm,),
+                                   ffn_ln_scale_attrs, ones=True)
+        self.ffn_ln_biases = plist("ffn_ln_bias", (dm,),
+                                   ffn_ln_bias_attrs, bias=True)
+        self.ffn1_weights = plist("ffn1_weight", (dm, ffn),
+                                  ffn1_weight_attrs)
+        self.ffn1_biases = plist("ffn1_bias", (ffn,), ffn1_bias_attrs,
+                                 bias=True)
+        self.ffn2_weights = plist("ffn2_weight", (ffn, dm),
+                                  ffn2_weight_attrs)
+        self.ffn2_biases = plist("ffn2_bias", (dm,), ffn2_bias_attrs,
+                                 bias=True)
+
+    def forward(self, src, attn_mask=None, caches=None,
+                pre_caches=None, rotary_embs=None, rotary_emb_dims=0,
+                seq_lens=None, time_step=None):
+        return F.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, pre_caches=pre_caches,
+            seq_lens=seq_lens, rotary_embs=rotary_embs,
+            rotary_emb_dims=rotary_emb_dims, time_step=time_step,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            activation=self.activation, training=self.training,
+            trans_qkvw=self.trans_qkvw)

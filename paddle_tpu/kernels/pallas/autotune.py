@@ -67,17 +67,23 @@ def _load_disk(dev: str) -> None:
 def _save_disk(dev: str) -> None:
     path = _cache_path(dev)
     try:
-        on_disk = {}
-        try:
-            with open(path) as f:
-                on_disk = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            pass
-        on_disk.update({k: list(v) for k, v in _MEM.items()})
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(on_disk, f, indent=0, sort_keys=True)
-        os.replace(tmp, path)
+        import fcntl
+        # cross-PROCESS exclusive section around the read-merge-write:
+        # without it two concurrently-tuning jobs interleave and the
+        # last writer silently drops the other's fresh entries
+        with open(path + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            on_disk = {}
+            try:
+                with open(path) as f:
+                    on_disk = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                pass
+            on_disk.update({k: list(v) for k, v in _MEM.items()})
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(on_disk, f, indent=0, sort_keys=True)
+            os.replace(tmp, path)
     except OSError:
         pass
 

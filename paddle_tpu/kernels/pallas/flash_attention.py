@@ -208,7 +208,10 @@ def _autotuned_blocks(kind, q, k, H, Hk, causal, has_seg, defaults,
     b, sq, HD = q.shape
     sk = k.shape[1]
     HkD = k.shape[2]
-    key = (kind, b, sq, sk, H, Hk, HD // H, str(q.dtype), int(causal),
+    # batch size is deliberately NOT in the key: blocks are per-tile
+    # choices and b only multiplies the grid — keying on it would stall
+    # a variable-batch serving workload with a fresh search per b
+    key = (kind, sq, sk, H, Hk, HD // H, str(q.dtype), int(causal),
            int(has_seg))
     hit = autotune.lookup(key)
     if hit is not None:

@@ -366,3 +366,54 @@ class TestFusedMultiTransformer:
         out = F.fused_multi_transformer(
             x, pre_layer_norm=False, activation="relu", **w)
         assert np.isfinite(np.asarray(out._data)).all()
+
+
+class TestFusedLayerClasses:
+    """incubate.nn Layer wrappers (ref: incubate/nn/layer/
+    fused_transformer.py) route through the same fused functionals."""
+
+    def test_fused_mha_and_encoder_layer(self):
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.nn import (FusedMultiHeadAttention,
+                                            FusedTransformerEncoderLayer)
+        pt.seed(0)
+        mha = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0)
+        mha.eval()
+        x = pt.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 5, 32)).astype(np.float32))
+        out = mha(x)
+        out = out[0] if isinstance(out, tuple) else out
+        assert out.numpy().shape == (2, 5, 32)
+        enc = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        enc.eval()
+        out = enc(x)
+        out = out[0] if isinstance(out, tuple) else out
+        assert out.numpy().shape == (2, 5, 32)
+        assert np.isfinite(out.numpy()).all()
+
+    def test_fused_multi_transformer_layer_decode(self):
+        import paddle_tpu as pt
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        pt.seed(1)
+        B, S, L, dm, H = 2, 4, 10, 32, 4
+        m = FusedMultiTransformer(dm, H, 64, num_layers=2)
+        m.eval()
+        rng = np.random.default_rng(2)
+        seq = pt.to_tensor(rng.standard_normal((B, S + 1, dm))
+                           .astype(np.float32))
+        full = m(pt.to_tensor(np.asarray(seq._data)))
+        full = full[0] if isinstance(full, tuple) else full
+        caches = [pt.to_tensor(np.zeros((2, B, H, L, dm // H),
+                                        np.float32)) for _ in range(2)]
+        out, caches = m(pt.to_tensor(np.asarray(seq._data)[:, :S]),
+                        caches=caches)
+        np.testing.assert_allclose(out.numpy(),
+                                   full.numpy()[:, :S], rtol=1e-4,
+                                   atol=1e-4)
+        step, caches = m(pt.to_tensor(np.asarray(seq._data)[:, S:S + 1]),
+                         caches=caches,
+                         time_step=pt.to_tensor(np.asarray(S, np.int32)))
+        np.testing.assert_allclose(step.numpy()[:, 0],
+                                   full.numpy()[:, S], rtol=1e-4,
+                                   atol=1e-4)
