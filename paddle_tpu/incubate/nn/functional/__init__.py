@@ -281,6 +281,136 @@ def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
     raise ValueError(f"unsupported act_method {act_method!r}")
 
 
+@register_op("fused_matmul_bias", amp_policy="white")
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False):
+    """matmul + bias epilogue in one op (ref: incubate/nn/functional/
+    fused_matmul_bias.py — cublasLt epilogue fusion; XLA fuses the add
+    into the matmul's epilogue on TPU)."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(x, y, preferred_element_type=acc)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("fused_dot_product_attention", amp_policy="white")
+def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
+                                dropout_prob=0.0, is_training=True,
+                                is_causal_masking=False,
+                                return_softmax=False):
+    """cuDNN-fused SDPA analog (ref: incubate/nn/functional/
+    fused_dot_product_attention.py:20). [b, s, h, d] layout; int/bool
+    mask keeps positions where mask != 0."""
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax: the fused path never materializes the "
+            "probability matrix (flash-style)")
+    d = q.shape[-1]
+    scale = scaling_factor if scaling_factor is not None \
+        else 1.0 / np.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, -1e30)
+    if is_causal_masking:
+        sq, sk = q.shape[1], k.shape[1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(cm[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_prob > 0.0 and is_training:
+        from ....core.generator import next_key
+        keep = jax.random.bernoulli(next_key(), 1.0 - dropout_prob,
+                                    p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_prob), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@register_op("fused_ec_moe", amp_policy="white")
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                 bmm1_bias, act_type="gelu"):
+    """Soft (expert-choice) MoE FFN: every token mixes ALL experts'
+    FFN outputs by its softmaxed gate (ref: incubate/nn/functional/
+    fused_ec_moe.py:18 — the cutlass grouped-GEMM kernel; here ONE
+    einsum pair over the expert axis keeps the MXU batched).
+    x: [b, s, dm]; gate: [b, s, e]; bmm0: [e, dm, ff]; bmm1 weight:
+    [e, ff, dm] (the example's [e, dm, ff] layout is accepted too and
+    contracted accordingly)."""
+    if act_type not in ("gelu", "relu"):
+        raise ValueError("fused_ec_moe supports act_type gelu|relu")
+    e, dm, ff = bmm0_weight.shape
+    h = jnp.einsum("bsd,edf->besf", x.astype(jnp.float32),
+                   bmm0_weight.astype(jnp.float32))
+    h = h + bmm0_bias.astype(jnp.float32).reshape(1, e, 1, -1)
+    h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+    w1 = bmm1_weight.astype(jnp.float32)
+    if w1.shape[1] == ff:            # [e, ff, dm]
+        out = jnp.einsum("besf,efd->besd", h, w1)
+    else:                            # [e, dm, ff]: contract over ff
+        out = jnp.einsum("besf,edf->besd", h, w1)
+    out = out + bmm1_bias.astype(jnp.float32).reshape(1, e, 1, -1)
+    probs = jax.nn.softmax(gate.astype(jnp.float32), axis=-1)
+    mixed = jnp.einsum("bse,besd->bsd", probs, out)
+    return mixed.astype(x.dtype)
+
+
+@register_op("fused_gate_attention", amp_policy="white")
+def fused_gate_attention(query, key=None, query_weight=None,
+                         key_weight=None, value_weight=None,
+                         qkv_weight=None, gate_linear_weight=None,
+                         gate_linear_bias=None, out_linear_weight=None,
+                         out_linear_bias=None, nonbatched_bias=None,
+                         attn_mask=None, has_gating=True,
+                         merge_qkv=True, use_flash_attn=False):
+    """AlphaFold-style gated attention (ref: incubate/nn/functional/
+    fused_gate_attention.py:19 pseudo-code, einsum-for-einsum).
+    query: [n, b, q, qdim]; merged qkv_weight: [3, heads, head_dim,
+    qdim]; separate weights: [qdim, heads, head_dim]."""
+    qd = query
+    kd = query if key is None else key
+    if merge_qkv:
+        if qkv_weight is None:
+            raise ValueError("merge_qkv=True requires qkv_weight")
+        c = qkv_weight.shape[2] ** -0.5
+        qkv = jnp.einsum("nbqa,thca->tnbqhc",
+                         qd.astype(jnp.float32),
+                         qkv_weight.astype(jnp.float32))
+        q, k, v = qkv[0] * c, qkv[1], qkv[2]
+    else:
+        c = query_weight.shape[-1] ** -0.5
+        q = jnp.einsum("nbqa,ahc->nbqhc", qd.astype(jnp.float32),
+                       query_weight.astype(jnp.float32)) * c
+        k = jnp.einsum("nbka,ahc->nbkhc", kd.astype(jnp.float32),
+                       key_weight.astype(jnp.float32))
+        v = jnp.einsum("nbka,ahc->nbkhc", kd.astype(jnp.float32),
+                       value_weight.astype(jnp.float32))
+    logits = jnp.einsum("nbqhc,nbkhc->nbhqk", q, k)
+    if attn_mask is not None:
+        logits = logits + attn_mask.astype(jnp.float32)
+    if nonbatched_bias is not None:
+        logits = logits + jnp.expand_dims(
+            nonbatched_bias.astype(jnp.float32), 1)
+    weights = jax.nn.softmax(logits, axis=-1)
+    avg = jnp.einsum("nbhqk,nbkhc->nbqhc", weights, v)
+    if has_gating:
+        gate = jnp.einsum("nbqa,ahc->nbqhc", qd.astype(jnp.float32),
+                          gate_linear_weight.astype(jnp.float32))
+        gate = jax.nn.sigmoid(gate + gate_linear_bias.astype(
+            jnp.float32))
+        avg = avg * gate
+    out = jnp.einsum("nbqhc,hco->nbqo", avg,
+                     out_linear_weight.astype(jnp.float32))
+    out = out + out_linear_bias.astype(jnp.float32)
+    return out.astype(query.dtype)
+
+
 # --- LLM serving / decode family (ref: incubate/nn/functional/
 # masked_multihead_attention.py, block_multihead_attention.py,
 # fused_transformer.py:976, variable_length_memory_efficient_attention.py)

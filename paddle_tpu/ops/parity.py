@@ -194,8 +194,6 @@ ALIASES = {
         "paddle_tpu.incubate.nn.functional.block_multihead_attention",
     "fused_attention":
         "paddle_tpu.incubate.nn.functional.fused_multi_head_attention",
-    "fused_dot_product_attention":
-        "paddle_tpu.nn.functional.scaled_dot_product_attention",
     "fused_bias_residual_layernorm":
         "paddle_tpu.incubate.nn.functional."
         "fused_bias_dropout_residual_layer_norm",

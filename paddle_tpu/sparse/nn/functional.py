@@ -85,10 +85,15 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
     dl = _norm3(dilation)[:nd]
 
     # the dense compute runs through the op registry (recorded on the
-    # tape) so weight/bias — and chained sparse layers — are trainable
+    # tape) so weight/bias — and chained sparse layers — are trainable.
+    # Sparse weights use the reference's [k..., in/groups, out] layout;
+    # the dense conv op takes paddle's [out, in/groups, k...] in EVERY
+    # data_format — permute (stays on the tape: ops.transpose)
+    perm = (4, 3, 0, 1, 2) if nd == 3 else (3, 2, 0, 1)
+    w_dense = ops.transpose(w, perm)
     conv_op = ops.conv3d if nd == 3 else ops.conv2d
-    out_t = conv_op(dense_t, w, bias, stride=list(st), padding=pd_list,
-                    dilation=list(dl), groups=groups,
+    out_t = conv_op(dense_t, w_dense, bias, stride=list(st),
+                    padding=pd_list, dilation=list(dl), groups=groups,
                     data_format=spec[0])
     if subm:
         # submanifold: the output site pattern IS the input site pattern

@@ -443,6 +443,9 @@ class TestRegistryCoverage:
         # covered by tests/test_rnn_scan_conformance.py (torch oracle)
         "lstm_scan", "gru_scan", "simple_rnn_scan",
         "fused_bias_act",  # covered by tests/test_parity_gaps_r4.py
+        # covered by tests/test_serving.py TestIncubateFunctionalBatch
+        "fused_matmul_bias", "fused_dot_product_attention",
+        "fused_ec_moe", "fused_gate_attention",
     }
 
     def test_coverage_accounting(self):

@@ -417,3 +417,89 @@ class TestFusedLayerClasses:
         np.testing.assert_allclose(step.numpy()[:, 0],
                                    full.numpy()[:, S], rtol=1e-4,
                                    atol=1e-4)
+
+
+class TestIncubateFunctionalBatch:
+    """Round-4 tail of incubate.nn.functional (ref: fused_matmul_bias,
+    fused_dot_product_attention, fused_ec_moe, fused_gate_attention)."""
+
+    def test_fused_matmul_bias(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((5, 4)).astype(np.float32)
+        b = rng.standard_normal((5,)).astype(np.float32)
+        out = F.fused_matmul_bias(paddle.to_tensor(x),
+                                  paddle.to_tensor(y),
+                                  paddle.to_tensor(b), transpose_y=True)
+        np.testing.assert_allclose(np.asarray(out._data), x @ y.T + b,
+                                   rtol=1e-5)
+
+    def test_fused_dot_product_attention(self):
+        rng = np.random.default_rng(1)
+        B, S, H, D = 2, 6, 2, 8
+        q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+        k = rng.standard_normal((B, S, H, D)).astype(np.float32)
+        v = rng.standard_normal((B, S, H, D)).astype(np.float32)
+        out = F.fused_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), is_training=False,
+            is_causal_masking=True)
+        qh = np.transpose(q, (0, 2, 1, 3))
+        kh = np.transpose(k, (0, 2, 1, 3))
+        vh = np.transpose(v, (0, 2, 1, 3))
+        want = _sdpa(qh, kh, vh, causal_offset=[0, 0])
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.transpose(want, (0, 2, 1, 3)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_ec_moe_mixes_experts(self):
+        rng = np.random.default_rng(2)
+        B, S, dm, ff, E = 2, 3, 8, 16, 4
+        x = rng.standard_normal((B, S, dm)).astype(np.float32)
+        w0 = rng.standard_normal((E, dm, ff)).astype(np.float32) * 0.1
+        b0 = rng.standard_normal((E, 1, ff)).astype(np.float32) * 0.1
+        w1 = rng.standard_normal((E, ff, dm)).astype(np.float32) * 0.1
+        b1 = rng.standard_normal((E, 1, dm)).astype(np.float32) * 0.1
+        # one-hot gate on expert j == plain FFN_j
+        for j in (0, 3):
+            gate = np.full((B, S, E), -1e9, np.float32)
+            gate[..., j] = 0.0
+            out = F.fused_ec_moe(
+                paddle.to_tensor(x), paddle.to_tensor(gate),
+                paddle.to_tensor(w0), paddle.to_tensor(b0),
+                paddle.to_tensor(w1), paddle.to_tensor(b1), "relu")
+            h = np.maximum(x @ w0[j] + b0[j], 0.0)
+            want = h @ w1[j] + b1[j]
+            np.testing.assert_allclose(np.asarray(out._data), want,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_fused_gate_attention(self):
+        rng = np.random.default_rng(3)
+        N, B, Q, A, H, C = 1, 2, 4, 8, 2, 4
+        qd = rng.standard_normal((N, B, Q, A)).astype(np.float32)
+        qkvw = rng.standard_normal((3, H, C, A)).astype(np.float32) * 0.3
+        gw = rng.standard_normal((A, H, C)).astype(np.float32) * 0.3
+        gb = np.zeros((H, C), np.float32)
+        ow = rng.standard_normal((H, C, A)).astype(np.float32) * 0.3
+        ob = np.zeros((A,), np.float32)
+        out = F.fused_gate_attention(
+            paddle.to_tensor(qd), qkv_weight=paddle.to_tensor(qkvw),
+            gate_linear_weight=paddle.to_tensor(gw),
+            gate_linear_bias=paddle.to_tensor(gb),
+            out_linear_weight=paddle.to_tensor(ow),
+            out_linear_bias=paddle.to_tensor(ob))
+        assert out.numpy().shape == (N, B, Q, A)
+        # numpy oracle of the documented pseudo-code
+        c = C ** -0.5
+        q = np.einsum("nbqa,hca->nbqhc", qd, qkvw[0]) * c
+        k = np.einsum("nbka,hca->nbkhc", qd, qkvw[1])
+        v = np.einsum("nbka,hca->nbkhc", qd, qkvw[2])
+        logits = np.einsum("nbqhc,nbkhc->nbhqk", q, k)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        w = e / e.sum(-1, keepdims=True)
+        avg = np.einsum("nbhqk,nbkhc->nbqhc", w, v)
+        gate = 1.0 / (1.0 + np.exp(-(np.einsum("nbqa,ahc->nbqhc", qd,
+                                               gw) + gb)))
+        want = np.einsum("nbqhc,hco->nbqo", avg * gate, ow) + ob
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
