@@ -134,3 +134,8 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """ref: paddle_infer.create_predictor"""
     return Predictor(config)
+
+
+# paged KV-cache serving runtime (native block allocator + manager;
+# pairs with incubate.nn.functional.block_multihead_attention)
+from .paged_cache import BlockAllocator, PagedKVCache  # noqa: E402,F401

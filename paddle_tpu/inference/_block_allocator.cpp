@@ -1,0 +1,78 @@
+// Paged-KV-cache block allocator (serving runtime core).
+//
+// The native piece of the vLLM-style paged attention stack: physical
+// cache blocks are a fixed pool; sequences lease blocks as they grow
+// and return them on completion. The reference keeps this bookkeeping
+// in its C++ inference runtime next to block_multihead_attention
+// (paddle/fluid/inference + phi block_multihead_attention kernels);
+// here it is a free-list with O(1) alloc/free and a mutex, exposed
+// through a C ABI consumed via ctypes (paddle_tpu/inference/
+// paged_cache.py). Device-side cache arrays stay in JAX; only the
+// block accounting lives here.
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Allocator {
+  std::vector<int32_t> free_list;  // stack of free block ids
+  std::vector<uint8_t> in_use;     // per-block lease flag
+  std::mutex mu;
+  explicit Allocator(int32_t num_blocks)
+      : free_list(), in_use(static_cast<size_t>(num_blocks), 0) {
+    free_list.reserve(static_cast<size_t>(num_blocks));
+    // hand out low ids first (pop from the back)
+    for (int32_t i = num_blocks - 1; i >= 0; --i) free_list.push_back(i);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pba_create(int32_t num_blocks) {
+  if (num_blocks <= 0) return nullptr;
+  return new Allocator(num_blocks);
+}
+
+void pba_destroy(void* h) { delete static_cast<Allocator*>(h); }
+
+// lease n blocks into out[0..n); all-or-nothing. 0 = ok, -1 = OOM.
+int32_t pba_alloc(void* h, int32_t n, int32_t* out) {
+  auto* a = static_cast<Allocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (n < 0 || static_cast<size_t>(n) > a->free_list.size()) return -1;
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t blk = a->free_list.back();
+    a->free_list.pop_back();
+    a->in_use[static_cast<size_t>(blk)] = 1;
+    out[i] = blk;
+  }
+  return 0;
+}
+
+// return blocks; double-free and out-of-range ids are rejected.
+// returns the number of blocks actually freed.
+int32_t pba_free(void* h, const int32_t* blocks, int32_t n) {
+  auto* a = static_cast<Allocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  int32_t freed = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t blk = blocks[i];
+    if (blk < 0 || static_cast<size_t>(blk) >= a->in_use.size()) continue;
+    if (!a->in_use[static_cast<size_t>(blk)]) continue;
+    a->in_use[static_cast<size_t>(blk)] = 0;
+    a->free_list.push_back(blk);
+    ++freed;
+  }
+  return freed;
+}
+
+int32_t pba_num_free(void* h) {
+  auto* a = static_cast<Allocator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return static_cast<int32_t>(a->free_list.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,156 @@
+"""Paged KV-cache manager (the serving runtime around
+incubate.nn.functional.block_multihead_attention).
+
+vLLM-style design matching the reference's serving stack: the device
+holds ONE fixed pool of physical cache blocks per layer
+([max_blocks, kv_heads, block_size, head_dim] jax arrays); sequences
+lease logical pages from a native C++ free-list allocator
+(_block_allocator.cpp, O(1) alloc/free, mutex-guarded, consumed via
+ctypes) and the manager renders the int32 block tables
+block_multihead_attention consumes. Device arrays never move — only
+the page accounting changes as sequences grow, finish, and new ones
+reuse their blocks."""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+_LIB = None
+
+
+def _load_lib():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    from ..utils.cpp_extension import _compile
+    here = os.path.dirname(os.path.abspath(__file__))
+    lib_path = _compile("paged_block_allocator",
+                        [os.path.join(here, "_block_allocator.cpp")],
+                        ["-O2"], None, False, ldflags=[])
+    lib = ctypes.CDLL(lib_path)
+    lib.pba_create.restype = ctypes.c_void_p
+    lib.pba_create.argtypes = [ctypes.c_int32]
+    lib.pba_destroy.argtypes = [ctypes.c_void_p]
+    lib.pba_alloc.restype = ctypes.c_int32
+    lib.pba_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                              ctypes.POINTER(ctypes.c_int32)]
+    lib.pba_free.restype = ctypes.c_int32
+    lib.pba_free.argtypes = [ctypes.c_void_p,
+                             ctypes.POINTER(ctypes.c_int32),
+                             ctypes.c_int32]
+    lib.pba_num_free.restype = ctypes.c_int32
+    lib.pba_num_free.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+class BlockAllocator:
+    """ctypes facade over the native free-list allocator."""
+
+    def __init__(self, num_blocks: int):
+        self._lib = _load_lib()
+        self._h = self._lib.pba_create(num_blocks)
+        if not self._h:
+            raise ValueError(f"invalid pool size {num_blocks}")
+        self.num_blocks = num_blocks
+
+    def alloc(self, n: int) -> List[int]:
+        out = (ctypes.c_int32 * max(n, 1))()
+        rc = self._lib.pba_alloc(self._h, n, out)
+        if rc != 0:
+            raise MemoryError(
+                f"paged KV cache out of blocks (wanted {n}, free "
+                f"{self.num_free})")
+        return list(out[:n])
+
+    def free(self, blocks: List[int]) -> int:
+        if not blocks:
+            return 0
+        arr = (ctypes.c_int32 * len(blocks))(*blocks)
+        return self._lib.pba_free(self._h, arr, len(blocks))
+
+    @property
+    def num_free(self) -> int:
+        return self._lib.pba_num_free(self._h)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.pba_destroy(h)
+            self._h = None
+
+
+class PagedKVCache:
+    """Per-layer paged K/V pools + per-sequence page tables.
+
+    Pairs with incubate.nn.functional.block_multihead_attention: the
+    `key_cache(i)` / `value_cache(i)` arrays and `block_table(...)`
+    rows are exactly its operands. ref: the reference's serving
+    runtime around block_multihead_attention.py:19 (paddle inference
+    BlockCacheKV bookkeeping)."""
+
+    def __init__(self, num_layers: int, num_blocks: int, kv_heads: int,
+                 block_size: int, head_dim: int, dtype=jnp.bfloat16):
+        self.num_layers = num_layers
+        self.block_size = block_size
+        self.allocator = BlockAllocator(num_blocks)
+        shape = (num_blocks, kv_heads, block_size, head_dim)
+        self.key_caches = [jnp.zeros(shape, dtype)
+                           for _ in range(num_layers)]
+        self.value_caches = [jnp.zeros(shape, dtype)
+                             for _ in range(num_layers)]
+        self._pages: Dict[object, List[int]] = {}
+        self._lengths: Dict[object, int] = {}
+
+    # -- sequence lifecycle --
+    def add_sequence(self, seq_id, num_tokens: int = 0) -> None:
+        if seq_id in self._pages:
+            raise ValueError(f"sequence {seq_id!r} already exists")
+        self._pages[seq_id] = []
+        self._lengths[seq_id] = 0
+        if num_tokens:
+            self.extend(seq_id, num_tokens)
+
+    def extend(self, seq_id, num_tokens: int) -> None:
+        """Lease enough pages for `num_tokens` more tokens."""
+        pages = self._pages[seq_id]
+        new_len = self._lengths[seq_id] + num_tokens
+        need = -(-new_len // self.block_size) - len(pages)
+        if need > 0:
+            pages.extend(self.allocator.alloc(need))
+        self._lengths[seq_id] = new_len
+
+    def free_sequence(self, seq_id) -> None:
+        self.allocator.free(self._pages.pop(seq_id))
+        del self._lengths[seq_id]
+
+    def length(self, seq_id) -> int:
+        return self._lengths[seq_id]
+
+    # -- block_multihead_attention operands --
+    def block_table(self, seq_ids, max_pages: Optional[int] = None):
+        """[len(seq_ids), max_pages] int32, -1-padded — the op's
+        block_tables operand."""
+        rows = [self._pages[s] for s in seq_ids]
+        width = max_pages or max((len(r) for r in rows), default=1)
+        tbl = np.full((len(rows), max(width, 1)), -1, np.int32)
+        for i, r in enumerate(rows):
+            tbl[i, :len(r)] = r
+        return jnp.asarray(tbl)
+
+    def key_cache(self, layer: int):
+        return self.key_caches[layer]
+
+    def value_cache(self, layer: int):
+        return self.value_caches[layer]
+
+    def update(self, layer: int, key_cache, value_cache) -> None:
+        """Store the (functionally updated) cache arrays an attention
+        call returned — donation at a jit boundary makes this aliasing,
+        not copying."""
+        self.key_caches[layer] = key_cache
+        self.value_caches[layer] = value_cache
