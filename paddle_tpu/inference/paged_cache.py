@@ -113,7 +113,14 @@ class PagedKVCache:
         self._pages[seq_id] = []
         self._lengths[seq_id] = 0
         if num_tokens:
-            self.extend(seq_id, num_tokens)
+            try:
+                self.extend(seq_id, num_tokens)
+            except MemoryError:
+                # roll back the registration so the scheduler can retry
+                # the same seq_id once blocks free up
+                del self._pages[seq_id]
+                del self._lengths[seq_id]
+                raise
 
     def extend(self, seq_id, num_tokens: int) -> None:
         """Lease enough pages for `num_tokens` more tokens."""
@@ -137,7 +144,14 @@ class PagedKVCache:
         block_tables operand."""
         rows = [self._pages[s] for s in seq_ids]
         width = max_pages or max((len(r) for r in rows), default=1)
-        tbl = np.full((len(rows), max(width, 1)), -1, np.int32)
+        width = max(width, 1)
+        for s, r in zip(seq_ids, rows):
+            if len(r) > width:
+                raise ValueError(
+                    f"sequence {s!r} holds {len(r)} pages but "
+                    f"max_pages={width}: it outgrew the block-table "
+                    "width this executable was compiled for")
+        tbl = np.full((len(rows), width), -1, np.int32)
         for i, r in enumerate(rows):
             tbl[i, :len(r)] = r
         return jnp.asarray(tbl)

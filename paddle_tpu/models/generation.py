@@ -1,4 +1,5 @@
-"""Autoregressive decoding (generate) for the causal-LM families.
+"""Autoregressive decoding (generate) for the GPT and LLaMA causal-LM
+families (see _family for the dispatch).
 
 Capability match for the reference's decoding stack (beam-search /
 sampling ops: gather_tree, top_p_sampling in ops.yaml; fluid inference's
@@ -20,8 +21,11 @@ from ..core.tensor import Tensor
 
 
 def _static_cache(model, batch, max_len, dtype):
+    """One [b, max_len, kv_heads, head_dim] k/v pair per layer;
+    kv_heads < num_heads stores the GQA cache un-repeated."""
     cfg = model.config
-    shape = (batch, max_len, cfg.num_heads, cfg.head_dim)
+    kv_heads = getattr(cfg, "num_kv_heads", None) or cfg.num_heads
+    shape = (batch, max_len, kv_heads, cfg.head_dim)
     return [
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(cfg.num_layers)
@@ -73,6 +77,81 @@ def _forward_with_cache(model, input_ids, caches, pos):
     return last_logits, new_caches
 
 
+def _llama_decode_attention(attn, x, cache, pos, rope_full):
+    """LLaMA chunk attention against the static cache: rotary at the
+    chunk's ABSOLUTE positions (tables pre-built to max_len, sliced at
+    `pos`), GQA kv-heads stored un-repeated in the cache."""
+    from ..incubate.nn.functional import fused_rotary_position_embedding
+    b, s, _ = x.shape
+    q = ops.reshape(attn.q_proj(x), (b, s, attn.num_heads,
+                                     attn.head_dim))
+    k = ops.reshape(attn.k_proj(x), (b, s, attn.num_kv_heads,
+                                     attn.head_dim))
+    v = ops.reshape(attn.v_proj(x), (b, s, attn.num_kv_heads,
+                                     attn.head_dim))
+    cos_full, sin_full = rope_full
+    cos = jax.lax.dynamic_slice(cos_full, (pos, 0),
+                                (s, cos_full.shape[1]))
+    sin = jax.lax.dynamic_slice(sin_full, (pos, 0),
+                                (s, sin_full.shape[1]))
+    q, k = fused_rotary_position_embedding(
+        q, k, sin=Tensor._wrap(sin), cos=Tensor._wrap(cos))
+    kc = jax.lax.dynamic_update_slice(
+        cache["k"], k._data.astype(cache["k"].dtype), (0, pos, 0, 0))
+    vc = jax.lax.dynamic_update_slice(
+        cache["v"], v._data.astype(cache["v"].dtype), (0, pos, 0, 0))
+    max_len = kc.shape[1]
+    kr, vr = kc, vc
+    if attn.num_kv_heads != attn.num_heads:
+        rep = attn.num_heads // attn.num_kv_heads
+        kr = jnp.repeat(kc, rep, axis=2)
+        vr = jnp.repeat(vc, rep, axis=2)
+    kpos = jnp.arange(max_len)[None, :]
+    qpos = pos + jnp.arange(s)[:, None]
+    mask = (kpos <= qpos)[None, None]
+    out = ops.scaled_dot_product_attention(
+        q, Tensor._wrap(kr), Tensor._wrap(vr),
+        attn_mask=Tensor._wrap(mask), dropout_p=0.0, training=False)
+    out = ops.reshape(out, (b, s, attn.hidden_size))
+    return attn.o_proj(out), {"k": kc, "v": vc}
+
+
+def _llama_forward_with_cache(model, input_ids, caches, pos):
+    """LLaMA trunk forward writing into the static caches at `pos`."""
+    from .llama import _rope_cos_sin
+    trunk = model.llama
+    cfg = model.config
+    x = trunk.embed_tokens(input_ids)
+    max_len = caches[0]["k"].shape[1]
+    rope_full = _rope_cos_sin(max_len, cfg.head_dim, cfg.rope_theta,
+                              x._data.dtype)
+    new_caches = []
+    for layer, cache in zip(trunk.layers, caches):
+        h, cache = _llama_decode_attention(
+            layer.self_attn, layer.input_layernorm(x), cache, pos,
+            rope_full)
+        x = x + h
+        x = x + layer.mlp(layer.post_attention_layernorm(x))
+        new_caches.append(cache)
+    x = trunk.norm(x)
+    last_logits = model.lm_head(x[:, -1:])
+    return last_logits, new_caches
+
+
+def _family(model):
+    """(cache_builder, cached_forward, embedding_dtype) per CausalLM
+    family the decode stack supports."""
+    if hasattr(model, "gpt"):
+        return (_static_cache, _forward_with_cache,
+                model.gpt.embeddings.word_embeddings.weight._data.dtype)
+    if hasattr(model, "llama"):
+        return (_static_cache, _llama_forward_with_cache,
+                model.llama.embed_tokens.weight._data.dtype)
+    raise NotImplementedError(
+        "generate() supports the GPT and LLaMA families; give other "
+        "models a cached decode path in models/generation.py")
+
+
 def _pick_token(lf, key, do_sample, temperature, top_p):
     """Greedy / temperature+top-p token selection — the ONE sampling
     implementation shared by the eager path and the fused scan body (so
@@ -94,8 +173,8 @@ def _pick_token(lf, key, do_sample, temperature, top_p):
         axis=-1).astype(jnp.int32), key
 
 
-def _build_fused_loop(model, do_sample, temperature, top_p, eos_id,
-                      n_steps):
+def _build_fused_loop(model, fwd_fn, do_sample, temperature, top_p,
+                      eos_id, n_steps):
     """The ENTIRE decode loop as ONE jitted executable: a `lax.scan`
     whose body is the whole per-token step (embed -> all blocks -> head
     -> sample -> cache/out writeback), with the KV caches and the output
@@ -116,7 +195,7 @@ def _build_fused_loop(model, do_sample, temperature, top_p, eos_id,
             def body(carry, i):
                 caches, nxt, key, finished, out = carry
                 pos = pos0 + i
-                logits, caches2 = _forward_with_cache(
+                logits, caches2 = fwd_fn(
                     model, Tensor._wrap(nxt[:, None]), caches, pos)
                 lf = logits._data[:, -1].astype(jnp.float32)
                 nxt_new, key2 = _pick_token(lf, key, do_sample,
@@ -135,7 +214,7 @@ def _build_fused_loop(model, do_sample, temperature, top_p, eos_id,
     return jax.jit(loop, donate_argnums=(1, 6)), tensors
 
 
-def _build_fused_prefill(model):
+def _build_fused_prefill(model, fwd_fn):
     """Prefill (prompt -> cache + last-position logits) as ONE jitted
     executable with donated caches — without this the per-op eager pass
     over the prompt dominates end-to-end latency (measured 1.5-2.7 s
@@ -147,8 +226,7 @@ def _build_fused_prefill(model):
 
     def prefill(params, ids, caches):
         with _tape.no_grad(), _functional_params(tensors, params):
-            logits, caches = _forward_with_cache(
-                model, Tensor._wrap(ids), caches, 0)
+            logits, caches = fwd_fn(model, Tensor._wrap(ids), caches, 0)
             return logits._data, caches
 
     return jax.jit(prefill, donate_argnums=(2,)), tensors
@@ -165,11 +243,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     jitted executable (see _build_fused_loop); False keeps the per-op
     eager path (used by the conformance test).
     """
-    if not hasattr(model, "gpt"):
-        raise NotImplementedError(
-            "generate() currently supports the GPT family (a model with "
-            "a .gpt trunk and learned position embeddings); for other "
-            "families decode through their own cache path")
+    cache_builder, fwd_fn, emb_dtype = _family(model)
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
@@ -188,8 +262,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                   cfg.max_position_embeddings)
     was_training = model.training
     model.eval()
-    dtype = model.gpt.embeddings.word_embeddings.weight._data.dtype
-    caches = _static_cache(model, b, max_len, dtype)
+    caches = cache_builder(model, b, max_len, emb_dtype)
 
     if not do_sample:
         key = None          # greedy must not touch the global RNG state
@@ -204,14 +277,13 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         if use_fused_step:
             pf = model.__dict__.get("_fused_prefill")
             if pf is None:
-                pf = _build_fused_prefill(model)
+                pf = _build_fused_prefill(model, fwd_fn)
                 model.__dict__["_fused_prefill"] = pf
             pf_fn, pf_tensors = pf
             logits_arr, caches = pf_fn(
                 [t._data for t in pf_tensors], ids, caches)
         else:
-            logits, caches = _forward_with_cache(
-                model, Tensor._wrap(ids), caches, 0)
+            logits, caches = fwd_fn(model, Tensor._wrap(ids), caches, 0)
             logits_arr = logits._data
         nxt, key = _pick_token(logits_arr[:, -1].astype(jnp.float32),
                                key, do_sample, temperature, top_p)
@@ -238,7 +310,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             if ck not in steps:
                 if len(steps) >= 8:      # LRU-bound the loop cache
                     steps.pop(next(iter(steps)))
-                steps[ck] = _build_fused_loop(model, do_sample,
+                steps[ck] = _build_fused_loop(model, fwd_fn, do_sample,
                                               temperature, top_p,
                                               eos_token_id, n_bucket)
             else:
@@ -256,7 +328,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                 pos = prompt_len + step - 1
                 if eos_token_id is not None:
                     finished = finished | (nxt == eos_token_id)
-                logits, caches = _forward_with_cache(
+                logits, caches = fwd_fn(
                     model, Tensor._wrap(nxt[:, None]), caches, pos)
                 nxt, key = _pick_token(
                     logits._data[:, -1].astype(jnp.float32), key,
