@@ -32,3 +32,4 @@ from .auto_parallel import (  # noqa: F401
 )
 
 get_world_size_by_group = get_world_size
+from . import ps  # noqa: E402,F401  (sharded-embedding PS capability)

@@ -1,0 +1,86 @@
+"""Parameter-server capability, TPU-native rendering (partial — see
+scope note).
+
+What the reference's PS subsystem fundamentally provides for recsys
+training (ref: python/paddle/distributed/ps/, fleet.init(role); the
+C++ table service under paddle/fluid/distributed/ps/) is ONE core
+capability: embedding tables too large for a single device, looked up
+and updated by all workers. On TPU that capability does not need an
+external service process: the table lives SHARDED across the mesh
+(rows split over devices via GSPMD), lookups are sharded gathers (XLA
+inserts the collectives), and updates flow through the normal tape —
+the optimizer update runs sharded too, so per-device memory holds
+1/world of the table and its optimizer state.
+
+Scope note (README "Unsupported surface"): the asynchronous push/pull
+training mode, heterogeneous CPU parameter hosts, and the brpc table
+service are NOT reproduced — they are artifacts of GPU clusters with
+small device memory and slow interconnects. `ShardedEmbedding` +
+`fleet.distributed_optimizer` is the TPU path to the same model scale.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layers.common import Embedding
+
+__all__ = ["ShardedEmbedding"]
+
+
+def _default_mesh(axis):
+    from .auto_parallel.api import ProcessMesh
+    import numpy as np
+    devs = jax.devices()
+    return ProcessMesh(np.arange(len(devs)), dim_names=[axis])
+
+
+class ShardedEmbedding(Embedding):
+    """Row-sharded embedding table over a device mesh.
+
+    weight: [num_embeddings, embedding_dim] with rows split over
+    `axis` (NamedSharding P(axis, None)) — each device stores
+    rows/world and 1/world of the optimizer state. forward(ids) is a
+    sharded gather: XLA partitions it so each device serves the ids
+    that hit its shard and the results combine over ICI. Gradients are
+    dense per-step activations of the gather; the weight grad stays
+    sharded, so the update never materializes the full table anywhere.
+
+    ref capability: distributed/ps distributed_lookup_table /
+    fleet SparseEmbedding (python/paddle/distributed/ps/the_one_ps.py);
+    design: GSPMD substitution, not a table service.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, mesh=None,
+                 axis=None, weight_attr=None, padding_idx=None,
+                 name=None):
+        super().__init__(num_embeddings, embedding_dim,
+                         padding_idx=padding_idx,
+                         weight_attr=weight_attr)
+        if mesh is None:
+            mesh = _default_mesh(axis or "dp")
+        if axis is None:
+            axis = mesh.dim_names[0]
+        jmesh = mesh._jax_mesh if hasattr(mesh, "_jax_mesh") else mesh
+        self._sharding = NamedSharding(jmesh, P(axis, None))
+        n_dev = 1
+        for ax in (axis if isinstance(axis, (list, tuple)) else [axis]):
+            n_dev *= jmesh.shape[ax]
+        if num_embeddings % n_dev:
+            raise ValueError(
+                f"num_embeddings ({num_embeddings}) must be divisible "
+                f"by the {axis!r} mesh axis size ({n_dev}) for row "
+                "sharding")
+        self._shard_devices = n_dev
+        # commit the storage: from here on every update stays sharded
+        self.weight._data = jax.device_put(self.weight._data,
+                                           self._sharding)
+
+    def shard_info(self):
+        """(rows_per_device, bytes_per_device) — the PS 'table shard'
+        accounting surface. Counts only the SHARDED axis: on a 2-D
+        mesh the table is replicated over the other axes."""
+        rows = self.num_embeddings // self._shard_devices
+        itemsize = jnp.dtype(self.weight._data.dtype).itemsize
+        return rows, rows * self.embedding_dim * itemsize

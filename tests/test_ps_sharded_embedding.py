@@ -1,0 +1,83 @@
+"""PS-capability rendering (SURVEY C23 partial): ShardedEmbedding —
+row-sharded tables over the mesh with sharded lookups and sharded
+optimizer state (ref: python/paddle/distributed/ps/ table service;
+here a GSPMD substitution, scope note in distributed/ps.py)."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import ProcessMesh
+from paddle_tpu.distributed.ps import ShardedEmbedding
+
+
+@pytest.fixture
+def mesh():
+    return ProcessMesh(np.arange(8), dim_names=["dp"])
+
+
+def test_storage_is_row_sharded(mesh):
+    emb = ShardedEmbedding(64, 16, mesh=mesh)
+    # each device holds 8 of the 64 rows
+    shard_shapes = {s.data.shape for s in
+                    emb.weight._data.addressable_shards}
+    assert shard_shapes == {(8, 16)}
+    rows, nbytes = emb.shard_info()
+    assert rows == 8 and nbytes == 8 * 16 * 4
+
+
+def test_lookup_matches_replicated(mesh):
+    pt.seed(0)
+    emb = ShardedEmbedding(64, 16, mesh=mesh)
+    ids = np.random.default_rng(0).integers(0, 64, (4, 7))
+    out = emb(pt.to_tensor(ids.astype(np.int32)))
+    want = np.asarray(emb.weight._data)[ids]
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+
+def test_trains_with_sharded_update(mesh):
+    """backward + optimizer step work on the sharded table, the update
+    stays sharded (no full-table materialization), and training moves
+    the looked-up rows only."""
+    pt.seed(1)
+    emb = ShardedEmbedding(64, 16, mesh=mesh)
+    opt = pt.optimizer.SGD(learning_rate=0.5,
+                           parameters=emb.parameters())
+    w0 = np.asarray(emb.weight._data).copy()
+    ids = np.asarray([[1, 9, 33]], np.int32)
+    loss = (emb(pt.to_tensor(ids)) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    # still sharded after the update
+    shard_shapes = {s.data.shape for s in
+                    emb.weight._data.addressable_shards}
+    assert shard_shapes == {(8, 16)}
+    w1 = np.asarray(emb.weight._data)
+    touched = sorted({1, 9, 33})
+    untouched = [i for i in range(64) if i not in touched]
+    assert not np.allclose(w1[touched], w0[touched])
+    np.testing.assert_allclose(w1[untouched], w0[untouched])
+
+
+def test_row_divisibility_enforced(mesh):
+    with pytest.raises(ValueError):
+        ShardedEmbedding(63, 16, mesh=mesh)
+
+
+def test_padding_idx(mesh):
+    emb = ShardedEmbedding(16, 8, mesh=mesh, padding_idx=0)
+    out = emb(pt.to_tensor(np.asarray([[0, 3]], np.int32))).numpy()
+    np.testing.assert_allclose(out[0, 0], 0.0)
+    assert np.abs(out[0, 1]).sum() > 0
+
+
+def test_shard_info_on_2d_mesh():
+    m = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    emb = ShardedEmbedding(64, 16, mesh=m, axis="dp")
+    rows, nbytes = emb.shard_info()
+    # sharded only over dp(2): 32 rows/device, replicated over mp
+    assert rows == 32 and nbytes == 32 * 16 * 4
+    shard_shapes = {s.data.shape for s in
+                    emb.weight._data.addressable_shards}
+    assert shard_shapes == {(32, 16)}

@@ -62,3 +62,30 @@ def test_mobilenet_v3_scale():
     m = models.mobilenet_v3_small(scale=0.5, num_classes=10)
     m.eval()
     assert list(m(_x(64)).shape) == [2, 10]
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format='NHWC' (the TPU-preferred layout, round-4) must be
+    numerically identical to NCHW given the same weights."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.vision.models import resnet18
+    pt.seed(0)
+    m_nhwc = resnet18(data_format="NHWC", num_classes=10)
+    m_nhwc.eval()
+    m_nchw = resnet18(num_classes=10)
+    m_nchw.eval()
+    m_nchw.set_state_dict(m_nhwc.state_dict())
+    x = np.random.default_rng(0).standard_normal(
+        (2, 32, 32, 3)).astype(np.float32)
+    a = m_nhwc(pt.to_tensor(x)).numpy()
+    b = m_nchw(pt.to_tensor(x.transpose(0, 3, 1, 2).copy())).numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    # training-mode BN statistics agree across layouts (looser: the
+    # layouts reduce in different orders and 18 stacked normalizations
+    # amplify f32 reduction-order noise to ~0.5% on the logits)
+    m_nhwc.train()
+    m_nchw.train()
+    a = m_nhwc(pt.to_tensor(x)).numpy()
+    b = m_nchw(pt.to_tensor(x.transpose(0, 3, 1, 2).copy())).numpy()
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-3)
