@@ -1,3 +1,4 @@
 """paddle_tpu.incubate (ref: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .nn.loss import identity_loss  # noqa: F401

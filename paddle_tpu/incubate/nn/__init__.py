@@ -1,4 +1,6 @@
 from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
-    FusedFeedForward, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedDropoutAdd, FusedEcMoe, FusedFeedForward, FusedLinear,
+    FusedMultiHeadAttention, FusedMultiTransformer,
     FusedTransformerEncoderLayer)
+from .loss import identity_loss  # noqa: F401

@@ -157,12 +157,19 @@ def swiglu(x, y=None):
 @register_op("fused_dropout_add")
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
                       key=None):
+    """dropout(x) + y. mode follows paddle dropout semantics:
+    upscale_in_train scales kept values by 1/(1-p) at train time;
+    downscale_in_infer keeps train values unscaled and multiplies by
+    (1-p) at inference."""
     if training and p > 0.0:
         if key is None:
             from ....core.generator import next_key
             key = next_key()
         keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
-        x = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+        kept = x / (1.0 - p) if mode == "upscale_in_train" else x
+        x = jnp.where(keep, kept, 0.0).astype(x.dtype)
+    elif not training and mode == "downscale_in_infer":
+        x = (x * (1.0 - p)).astype(x.dtype)
     return x + y
 
 

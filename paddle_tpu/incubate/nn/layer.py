@@ -16,7 +16,8 @@ from ...nn.initializer import Constant
 from . import functional as F
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedLinear", "FusedDropoutAdd", "FusedEcMoe"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -275,3 +276,68 @@ class FusedMultiTransformer(Layer):
             attn_mask=attn_mask, dropout_rate=self.dropout_rate,
             activation=self.activation, training=self.training,
             trans_qkvw=self.trans_qkvw)
+
+
+class FusedLinear(Layer):
+    """ref: incubate/nn/layer/fused_linear.py — Linear through the
+    fused matmul+bias epilogue."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = (out_features, in_features) if transpose_weight else \
+            (in_features, out_features)
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_features,), attr=bias_attr,
+                                  is_bias=True)
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """ref: incubate/nn/layer/fused_dropout_add.py — dropout(x) + y in
+    one fused op."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p,
+                                   training=self.training,
+                                   mode=self.mode)
+
+
+class FusedEcMoe(Layer):
+    """ref: incubate/nn/layer/fused_ec_moe.py — soft expert-choice MoE
+    FFN over functional.fused_ec_moe."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError("act_type must be gelu or relu")
+        if bias_attr is False:
+            raise NotImplementedError(
+                "fused_ec_moe always applies expert biases (the "
+                "reference kernel has no bias-free variant); pass "
+                "bias_attr=None for zero-initialized trainable biases")
+        self.act_type = act_type
+        self.bmm0_weight = self.create_parameter(
+            (num_experts, hidden_size, inter_size), attr=weight_attr)
+        self.bmm0_bias = self.create_parameter(
+            (num_experts, 1, inter_size), attr=bias_attr, is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            (num_experts, inter_size, hidden_size), attr=weight_attr)
+        self.bmm1_bias = self.create_parameter(
+            (num_experts, 1, hidden_size), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        return F.fused_ec_moe(x, gate, self.bmm0_weight, self.bmm0_bias,
+                              self.bmm1_weight, self.bmm1_bias,
+                              self.act_type)

@@ -1,0 +1,15 @@
+"""incubate.nn loss utilities (ref: python/paddle/incubate/nn/loss.py)."""
+from __future__ import annotations
+
+
+def identity_loss(x, reduction="none"):
+    """ref: incubate/nn/loss.py:21 — marks x as a loss; reduction in
+    {none, mean, sum} (the reference's int codes 0/1/2 accepted too)."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return x.mean()
+    if red == "sum":
+        return x.sum()
+    if red == "none":
+        return x
+    raise ValueError(f"unknown reduction {reduction!r}")

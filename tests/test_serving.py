@@ -503,3 +503,33 @@ class TestIncubateFunctionalBatch:
         want = np.einsum("nbqhc,hco->nbqo", avg * gate, ow) + ob
         np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
                                    atol=1e-5)
+
+
+def test_incubate_layer_wrappers():
+    """FusedLinear / FusedDropoutAdd / FusedEcMoe layer classes +
+    identity_loss (ref: incubate/nn/layer/*, loss.py:21)."""
+    import paddle_tpu as pt
+    import paddle_tpu.incubate.nn as N
+    rng = np.random.default_rng(0)
+    x = pt.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+    lin = N.FusedLinear(4, 8)
+    out = lin(x)
+    np.testing.assert_allclose(
+        out.numpy(),
+        np.asarray(x._data) @ np.asarray(lin.weight._data)
+        + np.asarray(lin.bias._data), rtol=1e-5)
+    # transpose_weight layout
+    lt = N.FusedLinear(4, 8, transpose_weight=True)
+    assert list(lt.weight.shape) == [8, 4]
+    assert lt(x).numpy().shape == (2, 8)
+    da = N.FusedDropoutAdd(p=0.0)
+    np.testing.assert_allclose(da(x, x).numpy(),
+                               2 * np.asarray(x._data), rtol=1e-6)
+    moe = N.FusedEcMoe(4, 16, 3, act_type="relu")
+    x3 = pt.to_tensor(rng.standard_normal((2, 5, 4)).astype(np.float32))
+    g = pt.to_tensor(rng.standard_normal((2, 5, 3)).astype(np.float32))
+    assert moe(x3, g).numpy().shape == (2, 5, 4)
+    np.testing.assert_allclose(
+        float(N.identity_loss(x, "sum").numpy()),
+        np.asarray(x._data).sum(), rtol=1e-5)
+    assert N.identity_loss(x, "none") is x
