@@ -4,3 +4,6 @@ from .layer import (  # noqa: F401
     FusedMultiHeadAttention, FusedMultiTransformer,
     FusedTransformerEncoderLayer)
 from .loss import identity_loss  # noqa: F401
+from . import attn_bias  # noqa: F401
+from .memory_efficient_attention import (  # noqa: F401
+    memory_efficient_attention)

@@ -1,0 +1,67 @@
+"""memory_efficient_attention (ref: python/paddle/incubate/nn/
+memory_efficient_attention.py:70 — the cutlass xformers kernel).
+
+TPU rendering: AttentionBias classes lower onto the flash path where
+the pattern allows (pure-causal -> Pallas causal flash; block-diagonal
+-> segment-id masking, still flash) and materialize as an additive
+mask through the XLA composite otherwise. Same O(S) memory story as
+the reference kernel, via the existing fused attention stack."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from . import functional as F
+from .attn_bias import (AttentionBias, BlockDiagonalCausalMask,
+                        BlockDiagonalMask, LowerTriangularMask,
+                        LowerTriangularMaskWithTensorBias, segment_ids)
+
+__all__ = ["memory_efficient_attention"]
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None,
+                               p=0.0, scale=None, training=True):
+    """query/key/value: [b, s, h, d]; attn_bias: None or an
+    attn_bias.AttentionBias instance (or a raw additive mask Tensor)."""
+    if p > 0.0 and training:
+        raise NotImplementedError(
+            "attention dropout is not implemented on the TPU flash "
+            "path; set p=0.0")
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+
+    if attn_bias is None:
+        return F.fused_flash_attention(query, key, value, causal=False,
+                                       softmax_scale=scale)
+    if type(attn_bias) is LowerTriangularMask:
+        return F.fused_flash_attention(query, key, value, causal=True,
+                                       softmax_scale=scale)
+    is_block = type(attn_bias) is BlockDiagonalMask
+    is_block_causal = type(attn_bias) is BlockDiagonalCausalMask
+    same_packing = (attn_bias.q_seqinfo.seqstart
+                    == attn_bias.k_seqinfo.seqstart) \
+        if (is_block or is_block_causal) else False
+    if is_block or (is_block_causal and same_packing):
+        # flash path: segment-id masking; for the causal variant the
+        # global diagonal equals per-block causal ONLY when q and kv
+        # packings coincide (else fall through to materialize below)
+        q_seg = jnp.broadcast_to(
+            segment_ids(attn_bias.q_seqinfo.seqstart, sq)[None],
+            (b, sq))
+        kv_seg = jnp.broadcast_to(
+            segment_ids(attn_bias.k_seqinfo.seqstart, sk)[None],
+            (b, sk))
+        return F.fused_flash_attention(
+            query, key, value, causal=is_block_causal,
+            segment_ids=(q_seg, kv_seg), softmax_scale=scale)
+    if isinstance(attn_bias, AttentionBias):
+        mask = attn_bias.materialize((b, h, sq, sk))
+        if mask.ndim == 2:
+            mask = mask[None, None]
+        return F.fused_flash_attention(query, key, value,
+                                       attn_mask=Tensor._wrap(mask),
+                                       softmax_scale=scale)
+    # raw additive mask
+    return F.fused_flash_attention(query, key, value,
+                                   attn_mask=attn_bias,
+                                   softmax_scale=scale)

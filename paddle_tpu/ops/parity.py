@@ -184,7 +184,7 @@ ALIASES = {
     "flash_attn_unpadded":
         "paddle_tpu.nn.functional.flash_attn_unpadded",
     "memory_efficient_attention":
-        "paddle_tpu.nn.functional.scaled_dot_product_attention",
+        "paddle_tpu.incubate.nn.memory_efficient_attention",
     "variable_length_memory_efficient_attention":
         "paddle_tpu.incubate.nn.functional."
         "variable_length_memory_efficient_attention",

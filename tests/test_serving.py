@@ -533,3 +533,126 @@ def test_incubate_layer_wrappers():
         float(N.identity_loss(x, "sum").numpy()),
         np.asarray(x._data).sum(), rtol=1e-5)
     assert N.identity_loss(x, "none") is x
+
+
+class TestMemoryEfficientAttention:
+    """incubate.nn.memory_efficient_attention + attn_bias classes
+    (ref: memory_efficient_attention.py:70, attn_bias.py)."""
+
+    def _qkv(self, b=2, s=8, h=2, d=4, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda: rng.standard_normal((b, s, h, d)).astype(np.float32)
+        return mk(), mk(), mk()
+
+    def _oracle(self, q, k, v, keep):
+        qh = np.transpose(q, (0, 2, 1, 3))
+        kh = np.transpose(k, (0, 2, 1, 3))
+        vh = np.transpose(v, (0, 2, 1, 3))
+        s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(q.shape[-1])
+        s = np.where(keep[None, None], s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = np.nan_to_num(p / p.sum(-1, keepdims=True))
+        return np.transpose(np.einsum("bhqk,bhkd->bhqd", p, vh),
+                            (0, 2, 1, 3))
+
+    def test_causal_mask_class(self):
+        from paddle_tpu.incubate.nn import memory_efficient_attention
+        from paddle_tpu.incubate.nn.attn_bias import LowerTriangularMask
+        q, k, v = self._qkv()
+        out = memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), attn_bias=LowerTriangularMask())
+        keep = np.tril(np.ones((8, 8), bool))
+        np.testing.assert_allclose(out.numpy(),
+                                   self._oracle(q, k, v, keep),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_block_diagonal_masks(self):
+        from paddle_tpu.incubate.nn import memory_efficient_attention
+        from paddle_tpu.incubate.nn.attn_bias import BlockDiagonalMask
+        q, k, v = self._qkv(b=1)
+        bias = BlockDiagonalMask.from_seqlens([3, 5])
+        out = memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), attn_bias=bias)
+        seg = np.asarray([0] * 3 + [1] * 5)
+        keep = seg[:, None] == seg[None, :]
+        np.testing.assert_allclose(out.numpy(),
+                                   self._oracle(q, k, v, keep),
+                                   rtol=1e-4, atol=1e-5)
+        causal = bias.make_causal()
+        out = memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), attn_bias=causal)
+        keep = keep & np.tril(np.ones((8, 8), bool))
+        np.testing.assert_allclose(out.numpy(),
+                                   self._oracle(q, k, v, keep),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_tensor_bias(self):
+        from paddle_tpu.incubate.nn import memory_efficient_attention
+        from paddle_tpu.incubate.nn.attn_bias import (
+            LowerTriangularMaskWithTensorBias)
+        q, k, v = self._qkv(seed=2)
+        rng = np.random.default_rng(3)
+        bias = rng.standard_normal((2, 2, 8, 8)).astype(np.float32)
+        out = memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v),
+            attn_bias=LowerTriangularMaskWithTensorBias(
+                paddle.to_tensor(bias)))
+        qh = np.transpose(q, (0, 2, 1, 3))
+        kh = np.transpose(k, (0, 2, 1, 3))
+        vh = np.transpose(v, (0, 2, 1, 3))
+        s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(4) + bias
+        s = np.where(np.tril(np.ones((8, 8), bool))[None, None], s,
+                     -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = np.nan_to_num(p / p.sum(-1, keepdims=True))
+        want = np.transpose(np.einsum("bhqk,bhkd->bhqd", p, vh),
+                            (0, 2, 1, 3))
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_block_causal_heterogeneous_packing():
+    """Per-block causal with DIFFERENT q/kv packings (the case a global
+    diagonal gets wrong): q blocks [2,6], kv blocks [6,2]."""
+    import math as _m
+    from paddle_tpu.incubate.nn import memory_efficient_attention
+    from paddle_tpu.incubate.nn.attn_bias import BlockDiagonalMask
+    rng = np.random.default_rng(5)
+    q = rng.standard_normal((1, 8, 2, 4)).astype(np.float32)
+    k = rng.standard_normal((1, 8, 2, 4)).astype(np.float32)
+    v = rng.standard_normal((1, 8, 2, 4)).astype(np.float32)
+    bias = BlockDiagonalMask.from_seqlens([2, 6], [6, 2]).make_causal()
+    out = memory_efficient_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        attn_bias=bias)
+    # oracle: per-block local causal
+    qseg = np.asarray([0] * 2 + [1] * 6)
+    kseg = np.asarray([0] * 6 + [1] * 2)
+    qloc = np.arange(8) - np.asarray([0, 2])[qseg]
+    kloc = np.arange(8) - np.asarray([0, 6])[kseg]
+    keep = (qseg[:, None] == kseg[None, :]) & \
+        (kloc[None, :] <= qloc[:, None])
+    qh = np.transpose(q, (0, 2, 1, 3))
+    kh = np.transpose(k, (0, 2, 1, 3))
+    vh = np.transpose(v, (0, 2, 1, 3))
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / _m.sqrt(4)
+    s = np.where(keep[None, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = np.nan_to_num(p / p.sum(-1, keepdims=True))
+    want = np.transpose(np.einsum("bhqk,bhkd->bhqd", p, vh),
+                        (0, 2, 1, 3))
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+    # q row 2 (block 1 local 0) must attend ONLY kv col 6 (its block's
+    # first key) — the global-diagonal bug made this row fully masked
+    assert keep[2].sum() == 1 and keep[2, 6]
+
+
+def test_block_mask_rejects_short_packing():
+    from paddle_tpu.incubate.nn.attn_bias import BlockDiagonalMask
+    bias = BlockDiagonalMask.from_seqlens([3, 4])
+    with pytest.raises(ValueError):
+        bias.materialize((1, 1, 8, 8))
