@@ -2,3 +2,5 @@
 from . import nn  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .nn.loss import identity_loss  # noqa: F401
+from . import asp  # noqa: F401
+from . import autotune  # noqa: F401

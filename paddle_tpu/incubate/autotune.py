@@ -1,0 +1,37 @@
+"""paddle.incubate.autotune (ref: python/paddle/incubate/autotune.py
+set_config) — runtime tuning switches.
+
+The "kernel" section maps onto the Pallas block-size autotune cache
+(kernels/pallas/autotune.py: per-shape-class search, on-disk winners);
+"layout" and "dataloader" tuning are XLA/input-pipeline territory here
+and are accepted as no-ops for compatibility (XLA picks layouts; the
+DataLoader sizes its workers explicitly)."""
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+__all__ = ["set_config"]
+
+
+def set_config(config=None):
+    """config: dict (or path to a JSON file) with optional sections
+    kernel / layout / dataloader, e.g.
+    {"kernel": {"enable": True}}."""
+    if config is None:
+        os.environ["PADDLE_TPU_PALLAS_AUTOTUNE"] = "1"
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    kernel = config.get("kernel", {})
+    if "enable" in kernel:
+        os.environ["PADDLE_TPU_PALLAS_AUTOTUNE"] = \
+            "1" if kernel["enable"] else "0"
+    for section in ("layout", "dataloader"):
+        if config.get(section, {}).get("enable"):
+            warnings.warn(
+                f"incubate.autotune: the {section!r} section is a "
+                "no-op on TPU (XLA chooses layouts; DataLoader workers "
+                "are explicit)", stacklevel=2)

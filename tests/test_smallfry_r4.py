@@ -134,3 +134,27 @@ class TestStaticNNControlFlow:
             1: lambda: pt.to_tensor(10)},
             default=lambda: pt.to_tensor(-1))
         assert int(out.numpy()) == -1
+
+
+def test_incubate_autotune_set_config(tmp_path, monkeypatch):
+    """paddle.incubate.autotune.set_config (ref: incubate/autotune.py)
+    maps the kernel section onto the Pallas autotune switch."""
+    import os
+    import warnings
+    import paddle_tpu as pt
+    from paddle_tpu.kernels.pallas import autotune as pa
+    pt.incubate.autotune.set_config({"kernel": {"enable": False}})
+    assert not pa.enabled()
+    pt.incubate.autotune.set_config({"kernel": {"enable": True}})
+    assert pa.enabled()
+    # JSON-file form
+    p = tmp_path / "tune.json"
+    p.write_text('{"kernel": {"enable": false}}')
+    pt.incubate.autotune.set_config(str(p))
+    assert not pa.enabled()
+    pt.incubate.autotune.set_config()
+    assert pa.enabled()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pt.incubate.autotune.set_config({"dataloader": {"enable": True}})
+    assert w and "no-op" in str(w[0].message)
