@@ -63,9 +63,33 @@ def _github_dir(repo, source, force_reload=False):
             "deployment clone the repo and use "
             "hub.load(local_dir, ..., source='local').") from e
     with zipfile.ZipFile(zip_path) as z:
+        names = z.namelist()
+        if not names:
+            os.remove(zip_path)
+            raise RuntimeError(f"hub: {url} produced an empty archive")
+        # derive the archive root robustly: the first PATH COMPONENT of
+        # the common prefix (the first entry may be a file, and a
+        # single-file archive's commonpath is the file path itself)
+        try:
+            common = os.path.commonpath(names)
+        except ValueError:          # mixed absolute/relative entries
+            common = ""
+        root = common.replace("\\", "/").split("/")[0] if common else ""
+        if not root or root in (".", "..") or os.path.isabs(common):
+            os.remove(zip_path)
+            raise RuntimeError(
+                f"hub: archive from {url} has no single root directory; "
+                "download it manually and use source='local'")
+        src = os.path.join(_HUB_DIR, root)
+        if os.path.exists(src):     # stale partial extraction target
+            import shutil
+            shutil.rmtree(src) if os.path.isdir(src) else os.remove(src)
         z.extractall(_HUB_DIR)
-        root = z.namelist()[0].split("/")[0]
-    os.rename(os.path.join(_HUB_DIR, root), out)
+    if not os.path.isdir(src):
+        os.remove(zip_path)
+        raise RuntimeError(
+            f"hub: archive from {url} did not extract to a directory")
+    os.rename(src, out)
     os.remove(zip_path)
     return out
 

@@ -342,7 +342,7 @@ def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
 
 @register_op("fused_ec_moe", amp_policy="white")
 def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
-                 bmm1_bias, act_type="gelu"):
+                 bmm1_bias, act_type="gelu", _bmm1_layout=None):
     """Soft (expert-choice) MoE FFN: every token mixes ALL experts'
     FFN outputs by its softmaxed gate (ref: incubate/nn/functional/
     fused_ec_moe.py:18 — the cutlass grouped-GEMM kernel; here ONE
@@ -358,7 +358,20 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
     h = h + bmm0_bias.astype(jnp.float32).reshape(1, e, 1, -1)
     h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
     w1 = bmm1_weight.astype(jnp.float32)
-    if w1.shape[1] == ff:            # [e, ff, dm]
+    # _bmm1_layout: callers that KNOW their layout (e.g. FusedEcMoe,
+    # which always builds [e, ff, dm] == "efd") pass it to bypass the
+    # shape-based inference and its ambiguity warning
+    if _bmm1_layout not in (None, "efd", "edf"):
+        raise ValueError("_bmm1_layout must be 'efd' or 'edf'")
+    layout = _bmm1_layout or ("efd" if w1.shape[1] == ff else "edf")
+    if _bmm1_layout is None and w1.shape[1] == ff and ff == dm:
+        import warnings
+        warnings.warn(
+            "fused_ec_moe: inter_size == d_model makes the "
+            "bmm1_weight layout ambiguous; assuming the canonical "
+            "[num_experts, d_ff, d_model] layout. Pass a weight in "
+            "that layout to silence this warning.", stacklevel=2)
+    if layout == "efd":              # [e, ff, dm]
         out = jnp.einsum("besf,efd->besd", h, w1)
     else:                            # [e, dm, ff]: contract over ff
         out = jnp.einsum("besf,edf->besd", h, w1)

@@ -166,6 +166,19 @@ def masked_multihead_attention(
         k = _apply_rotary(k, cos[:, None, :], sin[:, None, :],
                           use_neox_rotary_style).astype(k.dtype)
 
+    # eager check: a full cache (t == max_seq) would silently drop the
+    # k/v write (OOB scatter) while the position mask still admits every
+    # slot — attention over stale data. Fail loudly on concrete inputs.
+    if not isinstance(t, jax.core.Tracer):
+        # reduce on-device, sync ONE scalar (same pattern as take's
+        # eager_check) — not a full D2H copy of t
+        tmax = int(jnp.max(t))
+        if tmax >= L:
+            raise ValueError(
+                f"masked_multihead_attention: sequence_lengths (max "
+                f"{tmax}) must be < cache max_seq ({L}); the cache is "
+                f"full — grow it before decoding further")
+
     bidx = jnp.arange(B)
     kc = cache[0].at[bidx, :, t, :].set(k.astype(cache.dtype))
     vc = cache[1].at[bidx, :, t, :].set(v.astype(cache.dtype))
@@ -305,7 +318,12 @@ def block_multihead_attention(
 
     # --- attention: padded [B, Smax, H, D] q against gathered pages ---
     # Smax (static padded step width): concrete cu_seqlens give the
-    # exact max; under a trace fall back to max_seq_len (or T)
+    # exact max; under a trace fall back to max_seq_len (or T).
+    # TRACED-PATH CONTRACT: max_seq_len must be >= the longest per-row
+    # step (max diff of cu_seqlens_q); tokens at local >= Smax are
+    # dropped from qpad and their outputs are zeroed below so an
+    # undersized max_seq_len fails loudly in tests instead of
+    # returning a plausible clamped row.
     import numpy as _np
     if not isinstance(cu_q, jax.core.Tracer):
         Smax = max(1, int(_np.max(_np.diff(_np.asarray(cu_q)))))
@@ -338,6 +356,9 @@ def block_multihead_attention(
     p = jax.nn.softmax(s, axis=-1)
     opad = jnp.einsum("bhsc,bhcd->bshd", p, vctx.astype(jnp.float32))
     out = opad[row, jnp.minimum(local, Smax - 1)]  # [T, H, D]
+    # zero (not clamp) outputs for tokens that didn't fit in Smax —
+    # see the traced-path contract above
+    out = jnp.where(((local < Smax) & live)[:, None, None], out, 0.0)
     out = out.astype(qt.dtype).reshape(T, H * D)
     return (_wrap(out), _wrap(qkvv), _wrap(kcache), _wrap(vcache))
 

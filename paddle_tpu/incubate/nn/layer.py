@@ -338,6 +338,8 @@ class FusedEcMoe(Layer):
             (num_experts, 1, hidden_size), attr=bias_attr, is_bias=True)
 
     def forward(self, x, gate):
-        return F.fused_ec_moe(x, gate, self.bmm0_weight, self.bmm0_bias,
-                              self.bmm1_weight, self.bmm1_bias,
-                              self.act_type)
+        # this layer always constructs bmm1_weight as [e, ff, dm]
+        return F.fused_ec_moe(
+            x, gate, self.bmm0_weight, self.bmm0_bias,
+            self.bmm1_weight, self.bmm1_bias, self.act_type,
+            _bmm1_layout="efd")

@@ -30,6 +30,28 @@ class LBFGS(Optimizer):
                  tolerance_grad=1e-7, tolerance_change=1e-9,
                  history_size=100, line_search_fn=None, parameters=None,
                  weight_decay=None, grad_clip=None, name=None):
+        # coerce to a plain float at construction (the flat-gradient path
+        # applies decay itself): base-class pattern — regularizer objects
+        # carry the coefficient in ._coeff (optimizer.py _apply_decay)
+        if weight_decay is not None:
+            if hasattr(weight_decay, "_coeff"):
+                # the flat-gradient path applies COUPLED L2 (g += wd*p);
+                # extracting the coefficient from a non-L2 regularizer
+                # would silently change its semantics
+                if "L1" in type(weight_decay).__name__:
+                    raise TypeError(
+                        f"LBFGS weight_decay got "
+                        f"{type(weight_decay).__name__}; only L2-style "
+                        "decay (a float coefficient) is supported")
+                weight_decay = float(weight_decay._coeff)
+            else:
+                try:
+                    weight_decay = float(weight_decay)
+                except (TypeError, ValueError):
+                    raise TypeError(
+                        "LBFGS weight_decay must be a float or a "
+                        "regularizer with a coefficient, got "
+                        f"{type(weight_decay).__name__}") from None
         super().__init__(learning_rate=learning_rate,
                          parameters=parameters,
                          weight_decay=weight_decay, grad_clip=grad_clip,
