@@ -158,7 +158,13 @@ def bench_gpt_1p3b(on_tpu):
         kw = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
                   num_heads=16, max_position_embeddings=2048,
                   hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
-                  use_flash_attention=True, recompute=True)
+                  use_flash_attention=True, recompute=True,
+                  # measured round-5 sweep (tools/sweep_1p3b.sh): remat
+                  # every 3rd block only — spare HBM buys back 1/3 of
+                  # the recompute FLOPs (+2.7% same-session); full-remat
+                  # "dots" policies OOM at b4, and no-remat at smaller
+                  # batch loses more to XLA spill than remat costs
+                  recompute_interval=3)
         return bench_gpt("gpt_1p3b", kw, batch=4, seq=2048, steps=5,
                          on_tpu=True,
                          opt_kw=dict(moment_dtype="bfloat16"))
@@ -185,8 +191,10 @@ def bench_resnet50(on_tpu):
     import paddle_tpu as _pt
     _pt.set_flags({"FLAGS_fast_bn_stats": True})
     # NHWC end-to-end: channels stay in the lane (minor) dimension, the
-    # layout the TPU vector/matrix units want (VERDICT r3 next-3)
-    model = resnet50(data_format="NHWC")
+    # layout the TPU vector/matrix units want (VERDICT r3 next-3);
+    # space-to-depth stem turns the 3-channel 7x7/s2 conv into an
+    # identical 12-channel 4x4/s1 conv (VERDICT r4 next-4)
+    model = resnet50(data_format="NHWC", space_to_depth_stem=True)
     model.train()
     opt = Momentum(learning_rate=0.1, momentum=0.9,
                    parameters=model.parameters(), weight_decay=1e-4)
@@ -466,6 +474,120 @@ def bench_decode(on_tpu):
     }
 
 
+def bench_decode_paged(on_tpu):
+    """Continuous-batching serving throughput at EQUAL cache HBM
+    (VERDICT r4 next-2): a mixed-length workload through
+    inference.LLMEngine (paged pool + admission/preemption) vs the
+    dense static-batch generate() path given the SAME cache bytes.
+    Dense must pad every sequence to the group max and run each group
+    to its longest request; the paged pool shares pages across lengths,
+    so more sequences decode per weight-stream pass."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if on_tpu:
+        kw = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                  num_heads=16, max_position_embeddings=2048,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        n_req, max_batch, block_size, chunk = 16, 8, 64, 16
+        plo, phi, glo, ghi = 64, 192, 64, 160
+        quantum = 128
+    else:
+        kw = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                  num_heads=4, max_position_embeddings=256,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        n_req, max_batch, block_size, chunk = 6, 2, 16, 4
+        plo, phi, glo, ghi = 8, 24, 8, 24
+        quantum = 16
+    cfg = GPTConfig(**kw)
+    model = GPTForCausalLM(cfg).bfloat16()
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(n),)).astype(np.int32)
+               for n in rng.integers(plo, phi + 1, n_req)]
+    news = rng.integers(glo, ghi + 1, n_req).astype(int)
+    kvH, D, L = cfg.num_heads, cfg.head_dim, cfg.num_layers
+    itemsize = 2.0
+
+    # ---- dense baseline: static groups of max_batch, padded ----
+    order = np.argsort([len(p) + n for p, n in zip(prompts, news)])
+    groups = [order[i:i + max_batch]
+              for i in range(0, n_req, max_batch)]
+    dense_bytes = 0
+    for g in groups:
+        pmax = max(len(prompts[i]) for i in g)
+        tot = max(len(prompts[i]) + int(news[i]) for i in g)
+        bucket = min(-(-tot // 128) * 128, cfg.max_position_embeddings)
+        dense_bytes = max(dense_bytes,
+                          2 * L * len(g) * bucket * kvH * D * itemsize)
+
+    def run_dense():
+        total = 0
+        for g in groups:
+            pmax = max(len(prompts[i]) for i in g)
+            ids = np.full((len(g), pmax), 0, np.int32)
+            for r, i in enumerate(g):
+                ids[r, pmax - len(prompts[i]):] = prompts[i]  # left-pad
+            n_new = int(max(news[i] for i in g))
+            generate(model, pt.to_tensor(ids),
+                     max_new_tokens=n_new).numpy()
+            total += int(sum(news[i] for i in g))   # only requested toks
+        return total
+
+    # ---- paged engine at the same cache budget ----
+    block_bytes = kvH * block_size * D * itemsize * 2 * L
+    num_blocks = max(int(dense_bytes // block_bytes), 8) + 1
+
+    # ONE engine across warmup and timing: its compiled prefill/decode
+    # executables live on the instance, mirroring how generate() caches
+    # its fused loops on the model — both timed runs are compile-free
+    eng = LLMEngine(model, max_batch=max_batch, num_blocks=num_blocks,
+                    block_size=block_size, decode_chunk=chunk,
+                    prompt_quantum=quantum,
+                    max_model_len=cfg.max_position_embeddings)
+
+    def run_paged():
+        start_tokens = eng.stats["decode_tokens"]
+        for i, p in enumerate(prompts):
+            eng.add_request(i, p, max_new_tokens=int(news[i]))
+        done = 0
+        while eng.has_unfinished:
+            for r in eng.step():
+                done += len(r.output_ids)
+        return done, dict(eng.stats,
+                          decode_tokens=eng.stats["decode_tokens"]
+                          - start_tokens)
+
+    run_paged()            # compile prefill/decode executables
+    run_dense()            # compile dense prefill + loop executables
+    t0 = time.perf_counter()
+    paged_tokens, stats = run_paged()
+    t_paged = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    dense_tokens = run_dense()
+    t_dense = time.perf_counter() - t0
+    paged_tps = paged_tokens / t_paged
+    dense_tps = dense_tokens / t_dense
+    return {
+        "metric": "gpt_1p3b_paged_serving_tokens_per_sec",
+        "value": round(paged_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(paged_tps / dense_tps, 4),
+        "extra": {
+            "dense_tokens_per_sec": round(dense_tps, 1),
+            "requests": n_req, "max_batch": max_batch,
+            "cache_budget_gb": round(dense_bytes / 1e9, 3),
+            "num_blocks": num_blocks, "block_size": block_size,
+            "decode_chunk": chunk,
+            "engine_stats": stats,
+        },
+    }
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2_small,
     "gpt1p3b": bench_gpt_1p3b,
@@ -473,6 +595,7 @@ CONFIGS = {
     "bert": bench_bert_base,
     "dispatch": bench_dispatch,
     "decode": bench_decode,
+    "decode_paged": bench_decode_paged,
 }
 
 

@@ -51,6 +51,7 @@ from . import profiler  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401  (documented exclusion: raises w/ guidance)
 from . import utils  # noqa: F401
+from . import callbacks  # noqa: F401
 from .framework_io import save, load  # noqa: F401
 from .tensor_array import (  # noqa: F401
     create_array, array_write, array_read, array_length,

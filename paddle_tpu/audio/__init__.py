@@ -8,14 +8,16 @@ log/dB -> DCT) is pure jnp, so whole-batch feature extraction compiles to
 a single XLA program — the matmul-with-fbank form maps onto the MXU
 instead of the reference's per-bin CUDA loops.
 
-Dataset/backends (paddle.audio.datasets, .backends) are out of scope:
-they are IO wrappers around soundfile, which this image does not ship.
+Datasets (paddle.audio.datasets) parse locally staged archives with the
+stdlib wave module (PCM16) — see datasets.py; backends remain out of
+scope (soundfile is not shipped in this image).
 """
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import datasets  # noqa: F401
 from .features import (  # noqa: F401
     Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC,
 )
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+__all__ = ["functional", "features", "datasets", "Spectrogram",
+           "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,10 @@
+"""paddle.callbacks namespace (ref: python/paddle/callbacks/__init__.py
+re-exporting hapi callbacks)."""
+from .hapi.model_api import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+    LRSchedulerCallback as LRScheduler,
+)
+from .hapi.summary_writer import VisualDL, SummaryWriter  # noqa: F401
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler", "VisualDL", "SummaryWriter"]

@@ -22,11 +22,45 @@ from ...ops import registry as _op_registry
 from ...autograd import tape
 
 
+#: Named rematerialisation policies (the reference's
+#: recompute_granularity knob, fleet/meta_parallel dygraph_sharding —
+#: rendered as jax.checkpoint save-policies). "full" saves only the
+#: block inputs (max memory savings, re-runs every matmul in backward);
+#: "dots" saves matmul outputs (recompute only the cheap elementwise
+#: tail — ~1/3 less recompute FLOPs at ~9*b*s*h extra bytes per block);
+#: "dots_no_batch" is the jax checkpoint_dots_with_no_batch_dims policy
+#: (saves plain matmuls, recomputes batched ones like attention scores).
+_POLICIES = {
+    "full": None,       # jax.checkpoint default: save only block inputs
+    "dots": lambda: jax.checkpoint_policies.dots_saveable,
+    "dots_no_batch":
+        lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def _resolve_policy(policy):
+    if policy is None or callable(policy):
+        return policy
+    try:
+        entry = _POLICIES[policy]
+    except KeyError:
+        raise ValueError(
+            f"unknown recompute policy {policy!r}; expected one of "
+            f"{sorted(_POLICIES)} or a jax checkpoint policy callable")
+    return entry() if entry is not None else None
+
+
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
-              **kwargs):
+              policy=None, **kwargs):
     """ref: recompute.py recompute(function, *args). `function` may be a
     Layer (its parameters join the differentiable inputs) or a pure
-    function of its tensor arguments."""
+    function of its tensor arguments.
+
+    `policy` selects WHAT gets saved across the forward (the
+    recompute_granularity analog): None/"full" saves only block inputs;
+    "dots" / "dots_no_batch" save matmul outputs so backward re-runs
+    only the elementwise tail; or pass any jax.checkpoint_policies
+    callable directly."""
     if isinstance(function, Layer):
         layer = function
         fn = function.forward
@@ -36,6 +70,7 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
         fn = function
 
     ptensors = list(layer.parameters()) if layer is not None else []
+    jpolicy = _resolve_policy(policy)
 
     from ...jit import _functional_params
 
@@ -51,7 +86,10 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
             raw._out_tree = treedef
             return tuple(flat)
 
-        return jax.checkpoint(body)(seed, params, inputs, kw)
+        if jpolicy is None:
+            return jax.checkpoint(body)(seed, params, inputs, kw)
+        return jax.checkpoint(body, policy=jpolicy)(seed, params, inputs,
+                                                    kw)
 
     opdef = OpDef(f"recompute_{getattr(fn, '__name__', 'fn')}", raw)
     seed = next_key() if preserve_rng_state else jax.random.PRNGKey(0)

@@ -1,3 +1,4 @@
 """hapi (ref: python/paddle/hapi/)."""
 from .model_api import Model, summary, Callback, ProgBarLogger, \
     ModelCheckpoint, EarlyStopping  # noqa: F401
+from .summary_writer import SummaryWriter, VisualDL  # noqa: F401

@@ -59,9 +59,43 @@ def _check_no_quant(**kw):
     bad = [k for k, v in kw.items() if v is not None and v is not False]
     if bad:
         raise NotImplementedError(
-            f"quantised-cache serving arguments {bad} are not supported: "
-            "weight-only quantisation lives in paddle_tpu.nn.quant; int8 "
-            "KV caches are a documented exclusion (README)")
+            f"quantised-activation serving arguments {bad} are not "
+            "supported: weight-only quantisation lives in "
+            "paddle_tpu.nn.quant; int8 KV caches ARE supported via "
+            "cache_k/v_quant_scales + cache_k/v_dequant_scales")
+
+
+def _quant_scales(quant, dequant, heads, what):
+    """Normalize per-head int8 KV-cache scales (reference contract:
+    cache_k_quant_scales [num_head]; dequant defaults to 1/quant).
+    Returns (quant [H], dequant [H]) f32 arrays or (None, None)."""
+    q, dq = _arr(quant), _arr(dequant)
+    if q is None and dq is None:
+        return None, None
+    if q is None:
+        q = 1.0 / dq.astype(jnp.float32)
+    q = q.astype(jnp.float32).reshape(-1)
+    if dq is None:
+        dq = 1.0 / q
+    dq = dq.astype(jnp.float32).reshape(-1)
+    if q.shape[0] != heads or dq.shape[0] != heads:
+        raise ValueError(
+            f"{what} int8 scales must be per-head [{heads}]; got "
+            f"{q.shape} / {dq.shape}")
+    return q, dq
+
+
+def _quantize_kv(x, scale, round_type, max_bound, min_bound):
+    """x: [..., H, D] float -> int8 with per-head scale [H].
+    round_type 0 = round-half-away-from-zero (the reference's
+    quant_round_type=0), 1 = round-to-nearest-even (default)."""
+    s = scale.reshape((1,) * (x.ndim - 2) + (-1, 1))
+    y = x.astype(jnp.float32) * s
+    if round_type == 0:
+        y = jnp.sign(y) * jnp.floor(jnp.abs(y) + 0.5)
+    else:
+        y = jnp.round(y)
+    return jnp.clip(y, min_bound, max_bound).astype(jnp.int8)
 
 
 def _apply_rotary(x, cos, sin, neox):
@@ -77,15 +111,24 @@ def _apply_rotary(x, cos, sin, neox):
     return out.reshape(x.shape)
 
 
-def _decode_attn_core(q, kc, vc, t, src_mask=None):
+def _decode_attn_core(q, kc, vc, t, src_mask=None, k_dequant=None,
+                      v_dequant=None):
     """Shared decode-attention core: one query token per row against a
     padded dense cache. q: [B,H,D]; kc/vc: [B,H,L,D]; t: [B] int32 (the
     position just written, i.e. attend to k-positions <= t).
     src_mask: additive [B,1,1,Lm] (Lm <= L), reference semantics.
+    k/v_dequant: per-head [H] f32 scales for int8 caches — applied after
+    the f32 upcast, so XLA fuses the dequant into the einsum stream (the
+    cache is READ as int8: half the HBM traffic of a bf16 cache).
     f32 accumulation regardless of input dtype."""
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
-                   kc.astype(jnp.float32)) * scale
+    kf = kc.astype(jnp.float32)
+    vf = vc.astype(jnp.float32)
+    if k_dequant is not None:
+        kf = kf * k_dequant[None, :, None, None]
+    if v_dequant is not None:
+        vf = vf * v_dequant[None, :, None, None]
+    s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32), kf) * scale
     L = kc.shape[2]
     kpos = jnp.arange(L, dtype=jnp.int32)[None, :]
     valid = kpos <= t[:, None]
@@ -97,7 +140,7 @@ def _decode_attn_core(q, kc, vc, t, src_mask=None):
         s = s + m[:, None, :]
     s = jnp.where(valid[:, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhl,bhld->bhd", p, vc.astype(jnp.float32))
+    out = jnp.einsum("bhl,bhld->bhd", p, vf)
     return out.astype(q.dtype)
 
 
@@ -121,6 +164,10 @@ def masked_multihead_attention(
     quant_round_type=1,
     quant_max_bound=127.0,
     quant_min_bound=-127.0,
+    cache_k_quant_scales=None,
+    cache_v_quant_scales=None,
+    cache_k_dequant_scales=None,
+    cache_v_dequant_scales=None,
 ):
     """Decode-phase masked MHA with an in-place dense KV cache.
 
@@ -128,6 +175,11 @@ def masked_multihead_attention(
     sequence_lengths [B,1]: tokens already cached per row (the write
     position); if None the position is src_mask.shape[-1] - 1 (the
     reference's decode convention: src_mask covers the prefix + self).
+    Int8 KV cache: pass per-head cache_k/v_quant_scales (and/or
+    dequant_scales, default 1/quant) with an int8 cache_kv — k/v are
+    quantised on write and dequantised inside the attention einsum (an
+    API superset of the reference op, which keeps these operands on
+    block_multihead_attention only; same contract as there).
     Returns (out [B, H*D], cache_kv_out) — cache_kv_out aliases cache_kv
     when the caller donates it at a jit boundary.
     ref: masked_multihead_attention.py:19."""
@@ -179,10 +231,32 @@ def masked_multihead_attention(
                 f"{tmax}) must be < cache max_seq ({L}); the cache is "
                 f"full — grow it before decoding further")
 
+    kq, kdq = _quant_scales(cache_k_quant_scales, cache_k_dequant_scales,
+                            H, "cache_k")
+    vq, vdq = _quant_scales(cache_v_quant_scales, cache_v_dequant_scales,
+                            H, "cache_v")
+    if (kq is None) != (vq is None):
+        raise ValueError(
+            "int8 KV cache: cache_k and cache_v scales must be supplied "
+            f"together (k {'set' if kq is not None else 'absent'}, "
+            f"v {'set' if vq is not None else 'absent'})")
+    if (kq is not None) != (cache.dtype == jnp.int8):
+        raise ValueError(
+            "int8 KV cache: cache_kv dtype and cache_k/v_*_scales must "
+            f"be given together (cache dtype {cache.dtype}, scales "
+            f"{'set' if kq is not None else 'absent'})")
     bidx = jnp.arange(B)
-    kc = cache[0].at[bidx, :, t, :].set(k.astype(cache.dtype))
-    vc = cache[1].at[bidx, :, t, :].set(v.astype(cache.dtype))
-    out = _decode_attn_core(q, kc, vc, t, src_mask=_arr(src_mask))
+    if kq is not None:
+        kw = _quantize_kv(k, kq, quant_round_type, quant_max_bound,
+                          quant_min_bound)
+        vw = _quantize_kv(v, vq, quant_round_type, quant_max_bound,
+                          quant_min_bound)
+    else:
+        kw, vw = k.astype(cache.dtype), v.astype(cache.dtype)
+    kc = cache[0].at[bidx, :, t, :].set(kw)
+    vc = cache[1].at[bidx, :, t, :].set(vw)
+    out = _decode_attn_core(q, kc, vc, t, src_mask=_arr(src_mask),
+                            k_dequant=kdq, v_dequant=vdq)
     cache_out = jnp.stack([kc, vc])
     return _wrap(out.reshape(B, H * D)), _wrap(cache_out)
 
@@ -247,13 +321,15 @@ def block_multihead_attention(
     tokens occupy global positions seq_lens_decoder[b] + [0, stt).
     Causal masking by GLOBAL position is always applied; `mask`/`tgt_mask`
     add on top (additive, reference semantics).
+    Int8 KV cache (ref signature's cache_k/v_quant_scales, per kv-head):
+    pages are stored int8 — half the HBM traffic and twice the sequences
+    per pool — quantised on write, dequantised inside the attention
+    einsums (static scales; use_dynamic_cachekv_quant stays
+    unsupported: per-step dynamic scales would force a second pass over
+    the step's k/v).
     Returns (out [token_num, H*D], qkv, key_cache_out, value_cache_out).
     ref: block_multihead_attention.py:19."""
     _check_no_quant(
-        cache_k_quant_scales=cache_k_quant_scales,
-        cache_v_quant_scales=cache_v_quant_scales,
-        cache_k_dequant_scales=cache_k_dequant_scales,
-        cache_v_dequant_scales=cache_v_dequant_scales,
         qkv_out_scale=qkv_out_scale, out_shift=out_shift,
         out_smooth=out_smooth,
         use_dynamic_cachekv_quant=use_dynamic_cachekv_quant)
@@ -306,15 +382,38 @@ def block_multihead_attention(
         kt = _apply_rotary(kt, cos[:, None, :], sin[:, None, :],
                            use_neox_style).astype(kt.dtype)
 
+    kq, kdq = _quant_scales(cache_k_quant_scales, cache_k_dequant_scales,
+                            kvH, "cache_k")
+    vq, vdq = _quant_scales(cache_v_quant_scales, cache_v_dequant_scales,
+                            kvH, "cache_v")
+    if (kq is None) != (vq is None):
+        raise ValueError(
+            "int8 KV cache: cache_k and cache_v scales must be supplied "
+            f"together (k {'set' if kq is not None else 'absent'}, "
+            f"v {'set' if vq is not None else 'absent'})")
+    if (kq is not None) != (kcache.dtype == jnp.int8):
+        raise ValueError(
+            "int8 KV cache: key/value_cache dtype and "
+            "cache_k/v_*_scales must be given together (cache dtype "
+            f"{kcache.dtype}, scales "
+            f"{'set' if kq is not None else 'absent'})")
+
     # --- cache write: one scatter per cache ---
     page = jnp.clip(gpos // bs, 0, npb - 1)
     phys = jnp.maximum(tbl[row, page], 0)
     slot = gpos % bs
+    if kq is not None:
+        ktw = _quantize_kv(kt, kq, quant_round_type, quant_max_bound,
+                           quant_min_bound)
+        vtw = _quantize_kv(vt, vq, quant_round_type, quant_max_bound,
+                           quant_min_bound)
+    else:
+        ktw, vtw = kt.astype(kcache.dtype), vt.astype(vcache.dtype)
     # dead tokens (past cu_seqlens[-1], only possible if the caller
     # padded the packed layout) scatter out-of-bounds -> XLA drops them
     phys = jnp.where(live, phys, nb)
-    kcache = kcache.at[phys, :, slot, :].set(kt.astype(kcache.dtype))
-    vcache = vcache.at[phys, :, slot, :].set(vt.astype(vcache.dtype))
+    kcache = kcache.at[phys, :, slot, :].set(ktw)
+    vcache = vcache.at[phys, :, slot, :].set(vtw)
 
     # --- attention: padded [B, Smax, H, D] q against gathered pages ---
     # Smax (static padded step width): concrete cu_seqlens give the
@@ -334,15 +433,19 @@ def block_multihead_attention(
     qpad = jnp.zeros((B, Smax, H, D), qt.dtype)
     lpos = jnp.where((local < Smax) & live, local, Smax)  # OOB -> drop
     qpad = qpad.at[row, lpos].set(qt)
-    kctx = _paged_gather(kcache, tbl)              # [B, kvH, C, D]
-    vctx = _paged_gather(vcache, tbl)
+    kctx = _paged_gather(kcache, tbl).astype(jnp.float32)  # [B,kvH,C,D]
+    vctx = _paged_gather(vcache, tbl).astype(jnp.float32)
+    if kdq is not None:
+        # dequant fuses into the einsum stream: pages are READ as int8
+        kctx = kctx * kdq[None, :, None, None]
+        vctx = vctx * vdq[None, :, None, None]
     rep = H // kvH
     kctx = jnp.repeat(kctx, rep, axis=1)
     vctx = jnp.repeat(vctx, rep, axis=1)
 
     scale = 1.0 / math.sqrt(D)
     s = jnp.einsum("bshd,bhcd->bhsc", qpad.astype(jnp.float32),
-                   kctx.astype(jnp.float32)) * scale
+                   kctx) * scale
     cpos = jnp.arange(C, dtype=jnp.int32)
     qg = dec[:, None] + jnp.arange(Smax, dtype=jnp.int32)[None, :]
     causal = cpos[None, None, :] <= qg[:, :, None]     # [B, Smax, C]
@@ -354,7 +457,7 @@ def block_multihead_attention(
         s = s + tm[:, :, :, :C]
     s = jnp.where(causal[:, None, :, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    opad = jnp.einsum("bhsc,bhcd->bshd", p, vctx.astype(jnp.float32))
+    opad = jnp.einsum("bhsc,bhcd->bshd", p, vctx)
     out = opad[row, jnp.minimum(local, Smax - 1)]  # [T, H, D]
     # zero (not clamp) outputs for tokens that didn't fit in Smax —
     # see the traced-path contract above

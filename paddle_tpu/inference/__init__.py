@@ -139,3 +139,6 @@ def create_predictor(config: Config) -> Predictor:
 # paged KV-cache serving runtime (native block allocator + manager;
 # pairs with incubate.nn.functional.block_multihead_attention)
 from .paged_cache import BlockAllocator, PagedKVCache  # noqa: E402,F401
+# continuous-batching serving engine over the paged runtime
+from .llm_engine import (LLMEngine, GenerationResult,  # noqa: E402,F401
+                         calibrate_kv_scales)

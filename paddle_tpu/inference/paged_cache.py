@@ -138,6 +138,10 @@ class PagedKVCache:
     def length(self, seq_id) -> int:
         return self._lengths[seq_id]
 
+    def pages(self, seq_id) -> List[int]:
+        """The physical block ids this sequence currently leases."""
+        return list(self._pages[seq_id])
+
     # -- block_multihead_attention operands --
     def block_table(self, seq_ids, max_pages: Optional[int] = None):
         """[len(seq_ids), max_pages] int32, -1-padded — the op's

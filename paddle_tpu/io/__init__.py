@@ -253,6 +253,9 @@ class DataLoader:
         self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -290,9 +293,133 @@ class DataLoader:
             yield from self._batches()
             return
         if self.num_workers > 0 and not self._iterable_mode:
-            yield from self._iter_workers()
+            # PROCESS workers by default (ref: reader.py:216 — python
+            # transforms hold the GIL, so thread workers serialize);
+            # unpicklable datasets/collates fall back to the in-process
+            # thread tier with a warning
+            if self.use_shared_memory and self._spawn_picklable():
+                yield from self._iter_process_workers()
+            else:
+                yield from self._iter_workers()
             return
         yield from self._iter_buffered()
+
+    def _spawn_picklable(self) -> bool:
+        import pickle
+        import warnings
+        cached = self.__dict__.get("_spawn_picklable_result")
+        if cached is not None:      # probe once, not per epoch: pickling
+            return cached           # a large in-memory dataset is not free
+        custom = (None if self.collate_fn is default_collate_fn
+                  else self.collate_fn)
+        try:
+            pickle.dumps((self.dataset, custom, self.worker_init_fn))
+            self._spawn_picklable_result = True
+            return True
+        except Exception as e:
+            warnings.warn(
+                f"DataLoader(num_workers={self.num_workers}): dataset/"
+                f"collate_fn is not picklable for spawned worker "
+                f"processes ({type(e).__name__}: {e}) — falling back to "
+                "in-process thread workers (GIL-bound for python "
+                "transforms). Define the dataset and collate_fn at "
+                "module level to enable process workers.",
+                UserWarning, stacklevel=3)
+            self._spawn_picklable_result = False
+            return False
+
+    def _iter_process_workers(self):
+        """num_workers > 0 process tier: spawned workers (never fork —
+        the parent owns a live TPU client) load + collate into numpy,
+        batches travel via SharedMemory segments, and the parent
+        reassembles round-robin and materialises Tensors. One bounded
+        queue per worker: deterministic order, per-worker backpressure,
+        W * prefetch_factor batches of memory cap (same protocol as the
+        thread tier)."""
+        import multiprocessing as mp
+        import os
+        from . import _process_worker as PW
+
+        idx_batches = list(self.batch_sampler)
+        if not idx_batches:
+            return
+        ctx = mp.get_context("spawn")
+        W = min(self.num_workers, len(idx_batches))
+        queues = [ctx.Queue(maxsize=self.prefetch_factor)
+                  for _ in range(W)]
+        stop = ctx.Event()
+        custom = (None if self.collate_fn is default_collate_fn
+                  else self.collate_fn)
+        procs = [ctx.Process(
+            target=PW.worker_main,
+            args=(w, W, self.dataset, idx_batches, custom, queues[w],
+                  self.worker_init_fn, stop),
+            daemon=True) for w in range(W)]
+        # children force JAX_PLATFORMS=cpu as worker_main's FIRST action
+        # — before any computation can lazily init a backend — so a
+        # spawned worker can never contend for the parent's TPU. (The
+        # parent's env is deliberately NOT mutated here: a temporary
+        # process-wide JAX_PLATFORMS=cpu would race any concurrent
+        # first-time jax init in the parent and silently pin it to CPU.)
+        for p in procs:
+            p.start()
+
+        import queue as _q
+
+        def wrap(obj):
+            if isinstance(obj, np.ndarray):
+                return Tensor(obj)
+            if isinstance(obj, list):
+                return [wrap(x) for x in obj]
+            if isinstance(obj, tuple):
+                return tuple(wrap(x) for x in obj)
+            if isinstance(obj, dict):
+                return {k: wrap(v) for k, v in obj.items()}
+            return obj
+
+        deadline = (None if not self.timeout
+                    else self.timeout)
+        try:
+            for bi in range(len(idx_batches)):
+                q = queues[bi % W]
+                waited = 0.0
+                while True:
+                    try:
+                        kind, tag, payload = q.get(timeout=0.5)
+                        break
+                    except _q.Empty:
+                        waited += 0.5
+                        if not procs[bi % W].is_alive():
+                            raise RuntimeError(
+                                f"DataLoader worker {bi % W} died "
+                                "without reporting an error (OOM-killed"
+                                "?)") from None
+                        if deadline and waited >= deadline:
+                            raise TimeoutError(
+                                f"DataLoader worker {bi % W} produced "
+                                f"no batch within timeout={deadline}s")
+                if kind == "error":
+                    raise RuntimeError(
+                        f"DataLoader worker {tag} failed:\n{payload}")
+                assert kind == "batch" and tag == bi, (kind, tag, bi)
+                batch = PW.unpack(payload)
+                yield batch if custom is not None else wrap(batch)
+        finally:
+            stop.set()
+            # drain so orphaned SharedMemory segments get unlinked
+            for q in queues:
+                while True:
+                    try:
+                        kind, _, payload = q.get_nowait()
+                        if kind == "batch":
+                            PW.unpack(payload)
+                    except Exception:
+                        break
+            for p in procs:
+                p.join(timeout=2.0)
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
 
     def _iter_buffered(self):
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)
@@ -387,8 +514,9 @@ class DataLoader:
 
 
 def get_worker_info():
-    """ref: io/dataloader/worker.py get_worker_info. The native loader
-    collates in C++ threads inside one process (io/native), so from
-    Python's view there is no forked worker context — None, exactly what
-    the reference returns outside a worker process."""
-    return None
+    """ref: io/dataloader/worker.py get_worker_info. Returns the worker
+    context (id, num_workers, dataset) inside a spawned DataLoader
+    worker process; None in the main process (and in the in-process
+    thread/native tiers, matching the reference outside a worker)."""
+    from . import _process_worker
+    return _process_worker._WORKER_INFO

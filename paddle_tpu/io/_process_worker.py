@@ -1,0 +1,148 @@
+"""Multiprocess DataLoader worker (ref: python/paddle/io/reader.py:216 —
+the reference's default workers are PROCESSES because Python transforms
+hold the GIL; thread workers serialize behind transform-heavy
+pipelines).
+
+Design: spawned processes (never fork — the parent owns a live TPU
+client; fork would duplicate its state) + SharedMemory array transport.
+Workers are compute-only: they force JAX_PLATFORMS=cpu before any
+import so a spawned child can never grab the parent's TPU, and the
+default collate produces NUMPY batches — Tensors are materialised by
+the parent. Large arrays travel via multiprocessing.shared_memory (one
+copy into the segment, one copy out in the parent — no pickle of the
+payload bytes); small leaves ride the queue pickle."""
+from __future__ import annotations
+
+import os
+import traceback
+
+import numpy as np
+
+# arrays below this ride the regular queue pickle (a SharedMemory
+# segment costs two syscalls + mmap; not worth it for scalars)
+_SHM_THRESHOLD = 1 << 16
+
+# set inside a spawned worker process (io.get_worker_info reads it)
+_WORKER_INFO = None
+
+
+def np_collate(batch):
+    """Default collate producing numpy leaves (worker-side twin of
+    io.default_collate_fn — the parent wraps leaves into Tensors)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if hasattr(sample, "numpy") and hasattr(sample, "_data"):
+        # framework Tensor samples (duck-typed: this module must stay
+        # importable without paddle_tpu/jax) -> stacked numpy; the
+        # parent re-wraps into one batched Tensor like the thread tier
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return [np_collate(list(s)) for s in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: np_collate([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _pack(obj, segments):
+    """Replace large ndarray leaves with shared-memory markers."""
+    if isinstance(obj, np.ndarray) and obj.nbytes >= _SHM_THRESHOLD:
+        from multiprocessing import shared_memory
+        seg = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        # ownership passes to the CONSUMER: unregister from this
+        # process's resource tracker, or the tracker would unlink the
+        # segment when this (short-lived) worker exits — before the
+        # parent has copied it out (the classic shared_memory pitfall)
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(seg._name, "shared_memory")
+        except Exception:
+            pass
+        np.ndarray(obj.shape, obj.dtype, buffer=seg.buf)[...] = obj
+        segments.append(seg)
+        return ("__shm__", seg.name, str(obj.dtype), obj.shape)
+    if isinstance(obj, list):
+        return ["__list__"] + [_pack(x, segments) for x in obj]
+    if isinstance(obj, tuple):
+        return ("__tuple__",) + tuple(_pack(x, segments) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v, segments) for k, v in obj.items()}
+    return obj
+
+
+def unpack(obj):
+    """Parent-side inverse of _pack: attach, copy out, release."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, tuple) and obj[:1] == ("__shm__",):
+        _, name, dtype, shape = obj
+        seg = shared_memory.SharedMemory(name=name)
+        try:
+            arr = np.array(
+                np.ndarray(shape, np.dtype(dtype), buffer=seg.buf))
+        finally:
+            seg.close()
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+        return arr
+    if isinstance(obj, list) and obj[:1] == ["__list__"]:
+        return [unpack(x) for x in obj[1:]]
+    if isinstance(obj, tuple) and obj[:1] == ("__tuple__",):
+        return tuple(unpack(x) for x in obj[1:])
+    if isinstance(obj, dict):
+        return {k: unpack(v) for k, v in obj.items()}
+    return obj
+
+
+def worker_main(wid, num_workers, dataset, idx_batches, collate_fn,
+                out_queue, worker_init_fn, stop_event):
+    """Entry point of a spawned worker process. Round-robin ownership:
+    worker w produces batches w, w+W, w+2W, ... in order into its own
+    bounded queue (deterministic reassembly, per-worker backpressure —
+    same protocol as the in-process thread tier)."""
+    import queue as _q
+    # a spawned child must never touch the parent's TPU
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    global _WORKER_INFO
+    import types
+    _WORKER_INFO = types.SimpleNamespace(
+        id=wid, num_workers=num_workers, dataset=dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        collate = collate_fn if collate_fn is not None else np_collate
+        for bi in range(wid, len(idx_batches), num_workers):
+            if stop_event.is_set():
+                return
+            samples = [dataset[i] for i in idx_batches[bi]]
+            batch = collate(samples)
+            segments = []
+            payload = _pack(batch, segments)
+            placed = False
+            while not stop_event.is_set():
+                try:
+                    out_queue.put(("batch", bi, payload), timeout=0.2)
+                    placed = True
+                    break
+                except _q.Full:
+                    continue
+            for seg in segments:
+                seg.close()
+            if not placed:      # consumer went away: free the payload
+                for seg in segments:
+                    try:
+                        from multiprocessing import shared_memory
+                        shared_memory.SharedMemory(name=seg.name).unlink()
+                    except FileNotFoundError:
+                        pass
+                return
+        out_queue.put(("done", wid, None))
+    except BaseException:
+        try:
+            out_queue.put(("error", wid, traceback.format_exc()),
+                          timeout=1.0)
+        except Exception:
+            pass

@@ -81,11 +81,12 @@ class StaticFunction:
 
     def __init__(self, function, layer: Optional[Layer] = None,
                  input_spec=None, build_strategy=None, backend=None,
-                 full_graph=True):
+                 full_graph=True, source_available=True):
         self._fn = function
         self._layer = layer
         self._input_spec = input_spec
         self._full_graph = full_graph
+        self._source_available = source_available
         self._op_cache: Dict[Any, Any] = {}
         self._probed: set = set()
         functools.update_wrapper(self, function)
@@ -114,14 +115,22 @@ class StaticFunction:
         except (jax.errors.TracerBoolConversionError,
                 jax.errors.ConcretizationTypeError,
                 jax.errors.TracerIntegerConversionError) as e:
+            src_note = "" if self._source_available else (
+                " NOTE: this function's source is unretrievable "
+                "(lambda, REPL/exec-defined, or stripped bytecode), so "
+                "the dy2static AST converter that would stage this "
+                "control flow into lax.cond/while could not run "
+                "(bytecode-level SOT capture is a documented mechanism "
+                "delta, README).")
             raise RuntimeError(
                 "to_static(full_graph=True): the function branches on a "
                 "Tensor VALUE (data-dependent Python control flow), "
                 "which trace-based staging cannot capture in one graph. "
                 "Rewrite with paddle_tpu.ops.where / select-style ops, "
                 "or use @to_static(full_graph=False) to keep per-call "
-                "eager semantics (no whole-graph compile). Underlying "
-                f"tracer error: {type(e).__name__}: {e}") from e
+                f"eager semantics (no whole-graph compile).{src_note} "
+                f"Underlying tracer error: {type(e).__name__}: {e}") \
+                from e
         # mark only on success: a caught-and-retried failure must be
         # re-detected, not silently skipped into eager miscompile
         self._probed.add(key)
@@ -179,19 +188,52 @@ class StaticFunction:
         return list(self._op_cache.values())
 
 
+def _source_available(fn) -> bool:
+    import inspect
+    try:
+        inspect.getsource(fn)
+        return True
+    except (OSError, TypeError):
+        return False
+
+
+def _warn_no_source(fn):
+    import warnings
+    warnings.warn(
+        f"to_static: source for {getattr(fn, '__qualname__', fn)!r} is "
+        "unretrievable (lambda, REPL/exec-defined, or stripped "
+        "bytecode), so dy2static AST control-flow conversion is "
+        "disabled. Straight-line tensor code still stages into one "
+        "graph via tracing; tensor-dependent Python control flow will "
+        "raise at first call — use full_graph=False to run such "
+        "regions eagerly (ref: the reference's bytecode-level SOT "
+        "executor, jit/sot/opcode_translator/executor/"
+        "opcode_executor.py:1457, is a documented mechanism delta).",
+        UserWarning, stacklevel=3)
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True):
     """@to_static decorator (ref: jit/api.py:171). backend arg accepted for
-    API parity; XLA is always the backend here."""
+    API parity; XLA is always the backend here.
+
+    Functions without retrievable source (lambdas, REPL/exec-defined)
+    stage fine as long as they are straight-line tensor code; their
+    data-dependent control flow cannot be AST-converted, which is
+    detected up front (warning) and reported clearly at first call."""
 
     def decorate(fn):
         if isinstance(fn, Layer):
             fwd = fn.forward
             if full_graph:
                 from .dy2static import ast_transform
+                src_ok = _source_available(fwd)
+                if not src_ok:
+                    _warn_no_source(fwd)
                 fwd = ast_transform(fwd) or fwd
                 sf = StaticFunction(fwd, layer=fn, input_spec=input_spec,
-                                    full_graph=True)
+                                    full_graph=True,
+                                    source_available=src_ok)
             else:
                 sf = GraphBreakFunction(fwd, layer=fn)
             fn.forward = sf
@@ -202,9 +244,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             # AST control-flow conversion (the SOT/AST dy2static path):
             # tensor-predicate if/while stage into lax.cond/while_loop
             from .dy2static import ast_transform
+            src_ok = _source_available(fn)
+            if not src_ok:
+                _warn_no_source(fn)
             fn = ast_transform(fn) or fn
             return StaticFunction(fn, layer=layer, input_spec=input_spec,
-                                  full_graph=True)
+                                  full_graph=True,
+                                  source_available=src_ok)
         return GraphBreakFunction(fn, layer=layer)
 
     if function is not None:

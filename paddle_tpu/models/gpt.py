@@ -36,10 +36,28 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     use_flash_attention: bool = False  # route SDPA through the Pallas kernel
     recompute: bool = False  # per-block activation remat (jax.checkpoint)
+    # remat save-policy (reference recompute_granularity analog):
+    # "full" | "dots" | "dots_no_batch" — see distributed/meta_parallel/
+    # recompute._POLICIES. "dots" keeps matmul outputs so backward only
+    # re-runs the elementwise tail (1/3 less recompute FLOPs).
+    recompute_policy: str = "full"
+    # remat only layers with index % recompute_interval == 0 (1 = all).
+    # Skipped layers keep their activations — spend spare HBM to shave
+    # recompute FLOPs (ref: fleet recompute_interval).
+    recompute_interval: int = 1
 
     def __post_init__(self):
         if self.intermediate_size == 0:
             self.intermediate_size = 4 * self.hidden_size
+        if self.recompute_interval < 1:
+            raise ValueError(
+                f"recompute_interval must be >= 1 (got "
+                f"{self.recompute_interval}); use recompute=False to "
+                "disable remat")
+        if self.recompute_policy not in ("full", "dots",
+                                         "dots_no_batch"):
+            raise ValueError(
+                f"unknown recompute_policy {self.recompute_policy!r}")
 
     @property
     def head_dim(self):
@@ -190,10 +208,12 @@ class GPTModel(Layer):
             if caches is not None:
                 x, c = layer(x, caches[i])
                 new_caches.append(c)
-            elif use_remat:
+            elif use_remat and i % self.config.recompute_interval == 0:
                 # ref: fleet recompute_interval on GPT blocks
                 # (python/paddle/distributed/fleet/recompute/recompute.py:108)
-                x = recompute(layer, x)
+                pol = self.config.recompute_policy
+                x = recompute(layer, x,
+                              policy=None if pol == "full" else pol)
             else:
                 x = layer(x)
         x = self.final_norm(x)

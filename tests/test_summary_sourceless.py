@@ -1,0 +1,114 @@
+"""(a) TB-format SummaryWriter + VisualDL callback (VERDICT r4 next-10b;
+ref: python/paddle/hapi/callbacks.py VisualDL) — events verified with
+tensorboard's own reader when available, plus a framing-level check.
+(b) Source-less @to_static staging (next-10a): straight-line lambdas
+stage; data-dependent control flow warns up front and errors clearly."""
+import glob
+import os
+import struct
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# -- SummaryWriter ---------------------------------------------------------
+def _read_records(path):
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return out
+            (n,) = struct.unpack("<Q", header)
+            f.read(4)
+            out.append(f.read(n))
+            f.read(4)
+
+
+def test_summary_writer_scalars(tmp_path):
+    from paddle_tpu.callbacks import SummaryWriter
+    with SummaryWriter(str(tmp_path)) as w:
+        w.add_scalar("train/loss", 0.5, step=1)
+        w.add_scalar("train/loss", 0.25, step=2)
+        w.add_scalar("eval/acc", np.float32(0.9), step=2)
+    files = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert len(files) == 1
+    recs = _read_records(files[0])
+    assert len(recs) == 4                      # file_version + 3 scalars
+    assert b"brain.Event:2" in recs[0]
+    assert b"train/loss" in recs[1]
+
+    try:
+        from tensorboard.backend.event_processing.event_accumulator \
+            import EventAccumulator
+    except ImportError:
+        return
+    acc = EventAccumulator(str(tmp_path))
+    acc.Reload()
+    assert set(acc.Tags()["scalars"]) == {"train/loss", "eval/acc"}
+    losses = acc.Scalars("train/loss")
+    assert [e.step for e in losses] == [1, 2]
+    np.testing.assert_allclose([e.value for e in losses], [0.5, 0.25])
+
+
+def test_visualdl_callback_with_fit(tmp_path):
+    from paddle_tpu.callbacks import VisualDL
+    import paddle_tpu.nn as nn
+
+    class Ds(pt.io.Dataset):
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return (rng.standard_normal(4).astype(np.float32),
+                    rng.standard_normal(1).astype(np.float32))
+
+        def __len__(self):
+            return 8
+
+    model = pt.Model(nn.Linear(4, 1))
+    opt = pt.optimizer.SGD(learning_rate=0.01,
+                           parameters=model.network.parameters())
+    model.prepare(opt, nn.MSELoss())
+    cb = VisualDL(str(tmp_path / "run"))
+    model.fit(Ds(), epochs=2, batch_size=4, verbose=0, callbacks=[cb])
+    files = glob.glob(str(tmp_path / "run" / "events.out.tfevents.*"))
+    assert len(files) == 1
+    recs = _read_records(files[0])
+    assert any(b"train/loss" in r for r in recs)
+
+
+# -- source-less to_static -------------------------------------------------
+def test_sourceless_straightline_stages():
+    ns = {}
+    exec("def f(x):\n    return x * 2 + 1\n", {"__builtins__": {}}, ns)
+    with pytest.warns(UserWarning, match="unretrievable"):
+        sf = pt.jit.to_static(ns["f"])
+    out = sf(pt.to_tensor(np.array([1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [3.0, 5.0])
+
+
+def test_sourceless_control_flow_reports_clearly():
+    import paddle_tpu.ops as ops
+    ns = {"ops": ops}
+    exec("def g(x):\n"
+         "    if (x.sum() > 0):\n"
+         "        return x\n"
+         "    return -x\n", {"ops": ops, "__builtins__": __builtins__},
+         ns)
+    with pytest.warns(UserWarning, match="unretrievable"):
+        sf = pt.jit.to_static(ns["g"])
+    with pytest.raises(RuntimeError, match="source is unretrievable"):
+        sf(pt.to_tensor(np.array([1.0], np.float32)))
+
+
+def test_sourced_function_does_not_warn():
+    def h(x):
+        return x + 1
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        sf = pt.jit.to_static(h)
+    out = sf(pt.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [2.0])

@@ -1,0 +1,16 @@
+#!/bin/sh
+# Remat-variant sweep + step decomposition for the 1.3B config.
+# One process per variant so HBM fragmentation/donation never carries over.
+cd "$(dirname "$0")/.."
+for a in "micro" "parts --policy full" \
+         "step --policy full" \
+         "step --policy dots" \
+         "step --policy dots_no_batch" \
+         "step --policy full --interval 2" \
+         "step --policy full --interval 3" \
+         "step --policy dots --batch 2" \
+         "step --policy none --batch 2" \
+         "step --policy none --batch 1"; do
+  echo "=== $a"
+  timeout 900 python tools/profile_1p3b.py $a 2>&1 | grep -v '^W' | tail -4
+done
