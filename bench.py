@@ -436,16 +436,24 @@ def bench_decode(on_tpu):
         generate(model, tids, max_new_tokens=n_new).numpy()
         generate(model, tids, max_new_tokens=1).numpy()
 
-        def timed(n):
+        def timed(n, salt):
+            # content-varying input: the tunnel runtime DEDUPLICATES
+            # repeated identical executions (measured: identical-arg
+            # calls return in ~0.03 ms), so every timed call must carry
+            # fresh content; .numpy() is the only reliable sync
+            # (block_until_ready returns early on this backend)
+            ids2 = ids.copy()
+            ids2[:, 0] = (ids2[:, 0] + salt) % cfg.vocab_size
+            t2 = pt.to_tensor(ids2)
             t0 = time.perf_counter()
-            generate(model, tids, max_new_tokens=n).numpy()
+            generate(model, t2, max_new_tokens=n).numpy()
             return time.perf_counter() - t0
 
         # min-of-3 on each leg: the tunnel to the chip is shared, and a
         # contention spike inside either leg otherwise corrupts the
         # prefill subtraction
-        t_prefill = min(timed(1) for _ in range(3))
-        t_full = min(timed(n_new) for _ in range(3))
+        t_prefill = min(timed(1, s) for s in (1, 2, 3))
+        t_full = min(timed(n_new, s) for s in (4, 5, 6))
         dt = max(t_full - t_prefill, 1e-9)
         tok_s = b * (n_new - 1) / dt
         # per-step HBM traffic: all weights once + this row's KV cache

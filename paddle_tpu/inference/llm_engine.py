@@ -236,6 +236,59 @@ def calibrate_kv_scales(model, sample_ids):
             np.asarray(jnp.stack(vs), np.float32))
 
 
+def _pool_decode_attention(q, kpool, vpool, block_off, lens, scale,
+                           block_size, kdq=None, vdq=None):
+    """One-token-per-row attention against the ENTIRE paged pool.
+
+    TPU-native paged decode: instead of gathering each row's pages into
+    a per-row [B, C, ...] context (a big materialised copy whose reads
+    scale with B x padded-length), the query batch einsums against the
+    token-major pool ONCE — [NB*bs, kvH, D] streams from HBM straight
+    into the MXU, so cache traffic per step is the POOL size (== sum of
+    live context at full occupancy, the same bytes a dense batch reads)
+    and the scores against non-owned pool rows are masked out. Decode
+    is HBM-bound with the MXU idle, so the wasted FLOPs are free.
+
+    q: [B, H, D] (current token per row, already written to the pool);
+    kpool/vpool: [NB*bs, kvH, D] token-major; block_off: [B, NB] int32
+    — block's start position within row b's sequence, or -1 when not
+    owned by row b; lens: [B] int32, attend to positions <= lens[b].
+    Int8 pools: per-kv-head dequant scales fold into the (tiny)
+    score/output tensors — the pool is read as int8."""
+    B, H, D = q.shape
+    T, kvH, _ = kpool.shape
+    rep = H // kvH
+    q4 = (q.astype(jnp.float32) * scale).reshape(B, kvH, rep, D)
+    if kpool.dtype == jnp.int8:
+        # int8 pools: correctness-first upcast (the capacity win — 2x
+        # sequences per pool — is the point; see test_kv_int8)
+        s = jnp.einsum("bkrd,tkd->bkrt", q4,
+                       kpool.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+    else:
+        s = jnp.einsum("bkrd,tkd->bkrt", q4.astype(kpool.dtype),
+                       kpool, preferred_element_type=jnp.float32)
+    if kdq is not None:
+        s = s * kdq[None, :, None, None]
+    # pool row t belongs to block t//bs at slot t%bs
+    toff = jnp.repeat(block_off, block_size, axis=1)       # [B, T]
+    gpos = toff + jnp.tile(jnp.arange(block_size, dtype=jnp.int32),
+                           T // block_size)[None, :]
+    valid = (toff >= 0) & (gpos <= lens[:, None])          # [B, T]
+    s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    if vpool.dtype == jnp.int8:
+        out = jnp.einsum("bkrt,tkd->bkrd", p,
+                         vpool.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+    else:
+        out = jnp.einsum("bkrt,tkd->bkrd", p.astype(vpool.dtype),
+                         vpool, preferred_element_type=jnp.float32)
+    if vdq is not None:
+        out = out * vdq[None, :, None, None]
+    return out.reshape(B, H * D)
+
+
 # ---------------------------------------------------------------------------
 # engine
 # ---------------------------------------------------------------------------
@@ -296,7 +349,8 @@ class LLMEngine:
         self.cache = PagedKVCache(
             num_layers=cfg.num_layers, num_blocks=int(num_blocks),
             kv_heads=self.fam.kv_heads, block_size=self.block_size,
-            head_dim=self.fam.head_dim, dtype=cache_dtype)
+            head_dim=self.fam.head_dim, dtype=cache_dtype,
+            layout="token")
         # the trash page: inactive batch rows point their whole block
         # table here so their (ignored) writes never touch live pages
         self._trash_page = self.cache.allocator.alloc(1)[0]
@@ -398,144 +452,263 @@ class LLMEngine:
                     return False
 
     # -- device steps ------------------------------------------------------
-    def _run_prefill(self, seq: _Seq) -> int:
-        """One packed pass over prompt (+ resumed tokens) writing the
-        sequence's pages; returns the first newly sampled token."""
-        self.stats["prefills"] += 1
-        merged = np.concatenate(
-            [seq.prompt, np.asarray(seq.out, np.int32)]) \
-            if seq.out else seq.prompt
-        plen = len(merged)
-        sb = min(_bucket(plen, self.prompt_quantum), self.max_model_len)
+    def _run_prefills(self, seqs: List[_Seq]) -> List[int]:
+        """ONE batched pass over every admitted sequence's prompt
+        (+ resumed tokens): rows are padded to the bucketed max context
+        and to max_batch (empty rows write nothing), so the model's
+        weights stream ONCE per admission wave instead of once per
+        sequence. Returns each sequence's first sampled token."""
+        self.stats["prefills"] += len(seqs)
+        B = self.max_batch
+        merged = [np.concatenate([s.prompt, np.asarray(s.out, np.int32)])
+                  if s.out else s.prompt for s in seqs]
+        plens = [len(m) for m in merged]
+        sb = min(_bucket(max(plens), self.prompt_quantum),
+                 self.max_model_len)
         npb_pf = -(-sb // self.block_size)
-        ids = np.zeros((sb,), np.int32)
-        ids[:plen] = merged
-        tbl = self.cache.block_table([seq.rid], max_pages=npb_pf)
+        ids = np.zeros((B, sb), np.int32)
+        plen = np.zeros((B,), np.int32)
+        tbl = np.full((B, npb_pf), -1, np.int32)
+        for r, (s, m) in enumerate(zip(seqs, merged)):
+            ids[r, :len(m)] = m
+            plen[r] = len(m)
+            pages = self.cache.pages(s.rid)
+            tbl[r, :len(pages)] = pages
         fn = self._prefill_fn(sb, npb_pf)
         kcs, vcs = self.cache.key_caches, self.cache.value_caches
         self._key, sub = jax.random.split(self._key)
         nxt, kcs, vcs = fn([t._data for t in self._tensors], kcs, vcs,
-                           jnp.asarray(ids), jnp.asarray(plen, jnp.int32),
-                           tbl, sub)
+                           jnp.asarray(ids), jnp.asarray(plen),
+                           jnp.asarray(tbl), sub)
         for i in range(self.cache.num_layers):
             self.cache.update(i, kcs[i], vcs[i])
-        return int(np.asarray(nxt))
+        return [int(t) for t in np.asarray(nxt)[:len(seqs)]]
 
     def _prefill_fn(self, sb: int, npb_pf: int):
+        """Prompt pass: plain causal self-attention over the prompt's
+        OWN freshly computed k/v (no pool read-back) + one flat
+        token-major scatter per cache writing the pool pages."""
         hit = self._prefill_fns.get((sb, npb_pf))
         if hit is not None:
             return hit
         from ..jit import _functional_params
         from ..autograd import tape as _tape
         from ..models.generation import _pick_token
-        from ..incubate.nn.functional.serving import \
-            block_multihead_attention
+        from ..incubate.nn.functional.serving import _quantize_kv, \
+            _apply_rotary
+        import math as _math
         fam = self.fam
         rope = self._rope
         bs = self.block_size
+        kvH, H_D = self.fam.kv_heads, self.fam.head_dim
+        scale = 1.0 / _math.sqrt(H_D)
         tensors = self._tensors
         kq, vq = self._kq, self._vq
 
+        B = self.max_batch
+
         def prefill(params, kcs, vcs, ids, plen, tbl, key):
+            # ids [B, sb]; plen [B] (0 = empty row); tbl [B, npb_pf]
             with _tape.no_grad(), _functional_params(tensors, params):
+                T_pool = kcs[0].shape[0]
                 pos = jnp.arange(sb, dtype=jnp.int32)
-                x = Tensor._wrap(fam.embed(ids, pos)[None])   # [1,sb,h]
-                cu = jnp.stack(
-                    [jnp.zeros((), jnp.int32), plen])         # traced
-                enc = plen[None]
-                dec = jnp.zeros((1,), jnp.int32)
-                rope_emb = None
-                if rope is not None:
-                    rope_emb = Tensor._wrap(jnp.broadcast_to(
-                        rope[:, None, :, None, :],
-                        (2, 1, rope.shape[1], 1, rope.shape[2])))
+                x = Tensor._wrap(fam.embed(
+                    ids, jnp.broadcast_to(pos[None], (B, sb))))
+                page = pos[None, :] // bs                   # [1, sb]
+                phys = jnp.maximum(
+                    jnp.take_along_axis(tbl, jnp.broadcast_to(
+                        page, (B, sb)), axis=1), 0)
+                # dead tokens (>= row plen) scatter OOB -> dropped
+                flat = jnp.where(pos[None, :] < plen[:, None],
+                                 phys * bs + pos[None, :] % bs,
+                                 T_pool).reshape(-1)        # [B*sb]
+                live = (pos[None, :] < plen[:, None])
                 new_k, new_v = [], []
                 for li, layer in enumerate(fam.layers()):
-                    qkv = fam.qkv(layer, Tensor._wrap(x._data[0]))
-                    o, _, kc, vc = block_multihead_attention(
-                        Tensor._wrap(qkv), Tensor._wrap(kcs[li]),
-                        Tensor._wrap(vcs[li]), Tensor._wrap(enc),
-                        Tensor._wrap(dec), Tensor._wrap(enc), None, None,
-                        Tensor._wrap(cu), Tensor._wrap(cu),
-                        Tensor._wrap(tbl), rope_emb=rope_emb,
-                        cache_k_quant_scales=(
-                            None if kq is None else Tensor._wrap(kq[li])),
-                        cache_v_quant_scales=(
-                            None if vq is None else Tensor._wrap(vq[li])),
-                        max_seq_len=sb, block_size=bs,
-                        use_neox_style=True)
-                    new_k.append(kc._data)
-                    new_v.append(vc._data)
-                    x = fam.attn_out(layer, x,
-                                     o._data.reshape(1, sb, -1))
+                    qkv = fam.qkv(layer, Tensor._wrap(
+                        x._data.reshape(B * sb, -1)))
+                    nH = qkv.shape[-1] // H_D - 2 * kvH
+                    q = qkv[:, :nH * H_D].reshape(B, sb, nH, H_D)
+                    k = qkv[:, nH * H_D:(nH + kvH) * H_D].reshape(
+                        B, sb, kvH, H_D)
+                    v = qkv[:, (nH + kvH) * H_D:].reshape(
+                        B, sb, kvH, H_D)
+                    if rope is not None:
+                        cos = rope[0][pos][None, :, None, :]
+                        sin = rope[1][pos][None, :, None, :]
+                        q = _apply_rotary(q, cos, sin, True).astype(
+                            q.dtype)
+                        k = _apply_rotary(k, cos, sin, True).astype(
+                            k.dtype)
+                    if kq is not None:
+                        kw = _quantize_kv(k, kq[li], 1, 127., -127.)
+                        vw = _quantize_kv(v, vq[li], 1, 127., -127.)
+                    else:
+                        kw = k.astype(kcs[li].dtype)
+                        vw = v.astype(vcs[li].dtype)
+                    new_k.append(kcs[li].at[flat].set(
+                        kw.reshape(B * sb, kvH, H_D)))
+                    new_v.append(vcs[li].at[flat].set(
+                        vw.reshape(B * sb, kvH, H_D)))
+                    # attention over each row's own prompt (k/v still
+                    # in registers — never read back from the pool)
+                    rep = nH // kvH
+                    kr = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+                    vr = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+                    s = jnp.einsum(
+                        "bqhd,bkhd->bhqk",
+                        (q.astype(jnp.float32) * scale).astype(q.dtype),
+                        kr, preferred_element_type=jnp.float32)
+                    ok = (pos[None, None, :] <= pos[None, :, None]) & \
+                        live[:, None, :]
+                    s = jnp.where(ok[:, None, :, :], s, -jnp.inf)
+                    p = jax.nn.softmax(s, axis=-1)
+                    p = jnp.where(jnp.isnan(p), 0.0, p)  # empty rows
+                    o = jnp.einsum("bhqk,bkhd->bqhd",
+                                   p.astype(vr.dtype), vr,
+                                   preferred_element_type=jnp.float32)
+                    x = fam.attn_out(
+                        layer, x,
+                        o.reshape(B, sb, nH * H_D).astype(
+                            x._data.dtype))
                     x = fam.mlp(layer, x)
                 x = fam.final(x)
-                last = jax.lax.dynamic_slice_in_dim(
-                    x._data, plen - 1, 1, axis=1)            # [1,1,h]
+                last_idx = jnp.maximum(plen - 1, 0)          # [B]
+                last = jnp.take_along_axis(
+                    x._data, last_idx[:, None, None], axis=1)  # [B,1,h]
                 lg = fam.logits(Tensor._wrap(last))._data[:, -1]
                 nxt, _ = _pick_token(lg.astype(jnp.float32), key,
                                      self.do_sample, self.temperature,
                                      self.top_p)
-                return nxt[0], new_k, new_v
+                return nxt, new_k, new_v
 
         fn = jax.jit(prefill, donate_argnums=(1, 2))
         self._prefill_fns[(sb, npb_pf)] = fn
         return fn
 
-    def _decode_fn(self, npb_step: int, chunk: int):
-        hit = self._decode_fns.get((npb_step, chunk))
+    def _decode_fn(self, chunk: int):
+        """Chunked decode executable. The pool stays READ-ONLY inside
+        the scan: a pool that is scattered into AND read by the
+        whole-pool attention in the same scan body loses XLA's in-place
+        aliasing (measured: a full pool copy per step). Each step
+        writes its k/v into a small [L, B, chunk, kvH, D] staging
+        buffer via dynamic-update-slice and attends over pool+staging
+        jointly; the staging merges into the pool with ONE flat
+        token-major scatter per cache at chunk end."""
+        hit = self._decode_fns.get(chunk)
         if hit is not None:
             return hit
         from ..jit import _functional_params
         from ..autograd import tape as _tape
         from ..models.generation import _pick_token
-        from ..incubate.nn.functional.serving import \
-            block_multihead_attention
+        from ..incubate.nn.functional.serving import _quantize_kv, \
+            _apply_rotary
+        import math as _math
         fam, B, bs = self.fam, self.max_batch, self.block_size
+        H_D = fam.head_dim
+        kvH = fam.kv_heads
+        L = len(fam.layers())
+        scale = 1.0 / _math.sqrt(H_D)
         rope = self._rope
         tensors = self._tensors
-        # closure constants must be jnp (a raw numpy array indexed by a
-        # tracer inside the op would call __array__ on the tracer);
-        # concrete jnp constants also keep the op's exact-Smax path: a
-        # decode step is always one token per row
-        cu_j = jnp.arange(B + 1, dtype=jnp.int32)
-        zeros_b = jnp.zeros((B,), jnp.int32)
-        ones_b = jnp.ones((B,), jnp.int32)
         kq, vq = self._kq, self._vq
+        kdq = None if kq is None else 1.0 / kq
+        vdq = None if vq is None else 1.0 / vq
 
-        def decode(params, kcs, vcs, cur, lens, tbl, key):
+        def decode(params, kcs, vcs, cur, start, tbl, off, key):
             with _tape.no_grad(), _functional_params(tensors, params):
-                rope_emb = None
-                if rope is not None:
-                    rope_emb = Tensor._wrap(jnp.broadcast_to(
-                        rope[:, None, :, None, :],
-                        (2, B, rope.shape[1], 1, rope.shape[2])))
+                cdtype = kcs[0].dtype
+                T_pool = kcs[0].shape[0]
+                st_k = jnp.zeros((L, B, chunk, kvH, H_D), cdtype)
+                st_v = jnp.zeros((L, B, chunk, kvH, H_D), cdtype)
+                # pool ownership/position masks are FROZEN for the
+                # whole chunk: every pool token precedes `start`
+                toff = jnp.repeat(off, bs, axis=1)          # [B, Tp]
+                gpos_pool = toff + jnp.tile(
+                    jnp.arange(bs, dtype=jnp.int32),
+                    T_pool // bs)[None, :]
+                pool_ok = (toff >= 0) & (gpos_pool < start[:, None])
+                jpos = jnp.arange(chunk, dtype=jnp.int32)
 
-                def body(carry, _):
-                    kcs, vcs, cur, lens, key = carry
+                def body(carry, i):
+                    st_k, st_v, cur, key = carry
+                    lens = start + i
                     x = Tensor._wrap(fam.embed(cur, lens)[:, None])
-                    kcs2, vcs2 = [], []
                     for li, layer in enumerate(fam.layers()):
                         qkv = fam.qkv(layer,
                                       Tensor._wrap(x._data[:, 0]))
-                        o, _, kc, vc = block_multihead_attention(
-                            Tensor._wrap(qkv), Tensor._wrap(kcs[li]),
-                            Tensor._wrap(vcs[li]),
-                            Tensor._wrap(zeros_b), Tensor._wrap(lens),
-                            Tensor._wrap(ones_b), None, None,
-                            Tensor._wrap(cu_j), Tensor._wrap(cu_j),
-                            Tensor._wrap(tbl), rope_emb=rope_emb,
-                            cache_k_quant_scales=(
-                                None if kq is None
-                                else Tensor._wrap(kq[li])),
-                            cache_v_quant_scales=(
-                                None if vq is None
-                                else Tensor._wrap(vq[li])),
-                            max_seq_len=1, block_size=bs,
-                            use_neox_style=True)
-                        kcs2.append(kc._data)
-                        vcs2.append(vc._data)
-                        x = fam.attn_out(layer, x, o._data[:, None, :])
+                        nH = qkv.shape[-1] // H_D - 2 * kvH
+                        rep = nH // kvH
+                        q = qkv[:, :nH * H_D].reshape(B, nH, H_D)
+                        k = qkv[:, nH * H_D:(nH + kvH) * H_D].reshape(
+                            B, kvH, H_D)
+                        v = qkv[:, (nH + kvH) * H_D:].reshape(
+                            B, kvH, H_D)
+                        if rope is not None:
+                            cos = rope[0][lens][:, None, :]  # [B,1,D/2]
+                            sin = rope[1][lens][:, None, :]
+                            q = _apply_rotary(q, cos, sin, True).astype(
+                                q.dtype)
+                            k = _apply_rotary(k, cos, sin, True).astype(
+                                k.dtype)
+                        if kq is not None:
+                            kw = _quantize_kv(k, kq[li], 1, 127., -127.)
+                            vw = _quantize_kv(v, vq[li], 1, 127., -127.)
+                        else:
+                            kw = k.astype(cdtype)
+                            vw = v.astype(cdtype)
+                        # staged write: one (li, :, i) slice for every
+                        # row -> dynamic-update-slice, stays in place
+                        st_k = jax.lax.dynamic_update_slice(
+                            st_k, kw[None, :, None], (li, 0, i, 0, 0))
+                        st_v = jax.lax.dynamic_update_slice(
+                            st_v, vw[None, :, None], (li, 0, i, 0, 0))
+                        # scores: frozen pool part + staged part
+                        q4 = (q.astype(jnp.float32) * scale).reshape(
+                            B, kvH, rep, H_D)
+                        if cdtype == jnp.int8:
+                            qop = q4
+                            kp = kcs[li].astype(jnp.float32)
+                            ks = st_k[li].astype(jnp.float32)
+                        else:
+                            qop = q4.astype(cdtype)
+                            kp = kcs[li]
+                            ks = st_k[li]
+                        sp = jnp.einsum(
+                            "bkrd,tkd->bkrt", qop, kp,
+                            preferred_element_type=jnp.float32)
+                        ss = jnp.einsum(
+                            "bkrd,bjkd->bkrj", qop, ks,
+                            preferred_element_type=jnp.float32)
+                        if kdq is not None:
+                            sp = sp * kdq[li][None, :, None, None]
+                            ss = ss * kdq[li][None, :, None, None]
+                        sp = jnp.where(pool_ok[:, None, None, :], sp,
+                                       -jnp.inf)
+                        ss = jnp.where((jpos <= i)[None, None, None, :],
+                                       ss, -jnp.inf)
+                        s = jnp.concatenate([sp, ss], axis=-1)
+                        p = jax.nn.softmax(s, axis=-1)
+                        pp, ps = p[..., :T_pool], p[..., T_pool:]
+                        if cdtype == jnp.int8:
+                            vp = vcs[li].astype(jnp.float32)
+                            vs = st_v[li].astype(jnp.float32)
+                            ppo, pso = pp, ps
+                        else:
+                            vp, vs = vcs[li], st_v[li]
+                            ppo, pso = pp.astype(cdtype), ps.astype(
+                                cdtype)
+                        o = jnp.einsum(
+                            "bkrt,tkd->bkrd", ppo, vp,
+                            preferred_element_type=jnp.float32)
+                        o = o + jnp.einsum(
+                            "bkrj,bjkd->bkrd", pso, vs,
+                            preferred_element_type=jnp.float32)
+                        if vdq is not None:
+                            o = o * vdq[li][None, :, None, None]
+                        o = o.reshape(B, nH * H_D)
+                        x = fam.attn_out(layer, x, o.astype(
+                            x._data.dtype)[:, None, :])
                         x = fam.mlp(layer, x)
                     x = fam.final(x)
                     lg = fam.logits(x)._data[:, -1]
@@ -543,16 +716,28 @@ class LLMEngine:
                     nxt, _ = _pick_token(lg.astype(jnp.float32), sub,
                                          self.do_sample,
                                          self.temperature, self.top_p)
-                    return (kcs2, vcs2, nxt, lens + 1, key), nxt
+                    return (st_k, st_v, nxt, key), nxt
 
-                carry = (list(kcs), list(vcs), cur, lens, key)
-                carry, toks = jax.lax.scan(body, carry, None,
-                                           length=chunk)
-                kcs, vcs, cur, lens, key = carry
-                return kcs, vcs, jnp.transpose(toks)   # [B, chunk]
+                carry = (st_k, st_v, cur, key)
+                carry, toks = jax.lax.scan(body, carry, jpos)
+                st_k, st_v, cur, key = carry
+                # merge the chunk into the pool: ONE flat scatter per
+                # cache (indices [B*chunk], token-major rows)
+                gpos = start[:, None] + jpos[None, :]       # [B,chunk]
+                page = jnp.clip(gpos // bs, 0, tbl.shape[1] - 1)
+                phys = jnp.maximum(
+                    jnp.take_along_axis(tbl, page, axis=1), 0)
+                flat = (phys * bs + gpos % bs).reshape(-1)
+                new_k = [kcs[li].at[flat].set(
+                    st_k[li].reshape(B * chunk, kvH, H_D))
+                    for li in range(L)]
+                new_v = [vcs[li].at[flat].set(
+                    st_v[li].reshape(B * chunk, kvH, H_D))
+                    for li in range(L)]
+                return new_k, new_v, jnp.transpose(toks)   # [B, chunk]
 
         fn = jax.jit(decode, donate_argnums=(1, 2))
-        self._decode_fns[(npb_step, chunk)] = fn
+        self._decode_fns[chunk] = fn
         return fn
 
     def _run_decode_chunk(self) -> Dict[int, np.ndarray]:
@@ -573,13 +758,17 @@ class LLMEngine:
                     "paged pool too small for even one sequence's "
                     "decode chunk — enlarge num_blocks")
         active = [s for s in self.slots if s is not None]
-        pages_in_use = max(len(self.cache.pages(s.rid)) for s in active)
-        npb_step = min(_pow2_ceil(pages_in_use), self.npb_full)
-
         B = self.max_batch
+        NB = self.cache.allocator.num_blocks
         cur = np.zeros((B,), np.int32)
         lens = np.zeros((B,), np.int32)
-        tbl = np.full((B, npb_step), self._trash_page, np.int32)
+        # write table (page index -> physical block; full static width)
+        tbl = np.full((B, self.npb_full), self._trash_page, np.int32)
+        # ownership map (physical block -> start position in row b, or
+        # -1) for the whole-pool attention; inactive rows own only the
+        # trash page so their softmax has one (ignored) valid position
+        off = np.full((B, NB), -1, np.int32)
+        off[:, self._trash_page] = 0
         for b in range(B):
             s = self.slots[b]
             if s is None:
@@ -588,13 +777,15 @@ class LLMEngine:
             lens[b] = s.length
             pages = self.cache.pages(s.rid)
             tbl[b, :len(pages)] = pages
-            tbl[b, len(pages):] = -1
-        fn = self._decode_fn(npb_step, chunk)
+            off[b, self._trash_page] = -1
+            off[b, pages] = np.arange(len(pages), dtype=np.int32) \
+                * self.block_size
+        fn = self._decode_fn(chunk)
         kcs, vcs = self.cache.key_caches, self.cache.value_caches
         self._key, sub = jax.random.split(self._key)
         kcs, vcs, toks = fn([t._data for t in self._tensors], kcs, vcs,
                             jnp.asarray(cur), jnp.asarray(lens),
-                            jnp.asarray(tbl), sub)
+                            jnp.asarray(tbl), jnp.asarray(off), sub)
         for i in range(self.cache.num_layers):
             self.cache.update(i, kcs[i], vcs[i])
         toks = np.asarray(toks)
@@ -613,11 +804,13 @@ class LLMEngine:
         """Admit + prefill new sequences, run one decode chunk, retire
         finished sequences. Returns results finished this step."""
         finished: List[GenerationResult] = []
-        for seq in self._admit():
-            first = self._run_prefill(seq)
-            seq.out.append(first)
-            self.stats["decode_tokens"] += 1
-            self._maybe_finish(seq, finished)
+        fresh = self._admit()
+        if fresh:
+            firsts = self._run_prefills(fresh)
+            for seq, first in zip(fresh, firsts):
+                seq.out.append(first)
+                self.stats["decode_tokens"] += 1
+                self._maybe_finish(seq, finished)
         chunk_out = self._run_decode_chunk()
         for slot, toks in chunk_out.items():
             seq = self.slots[slot]

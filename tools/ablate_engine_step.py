@@ -1,0 +1,126 @@
+"""Ablate the LLMEngine decode-chunk body at 1.3B: full vs no-write vs
+no-attention, to locate the per-step cost over the dense fused loop.
+    python tools/ablate_engine_step.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import math
+    import paddle_tpu as pt
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.llm_engine import _pool_decode_attention
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.jit import _functional_params
+    from paddle_tpu.autograd import tape as _tape
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_position_embeddings=2048,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg).bfloat16()
+    model.eval()
+    eng = LLMEngine(model, max_batch=8, num_blocks=49, block_size=64,
+                    decode_chunk=16, prompt_quantum=128,
+                    max_model_len=2048)
+    fam, B, bs = eng.fam, 8, 64
+    H_D, kvH = fam.head_dim, fam.kv_heads
+    scale = 1.0 / math.sqrt(H_D)
+    tensors = eng._tensors
+    chunk = 16
+
+    def make(variant):
+        def decode(params, kcs, vcs, cur, lens, tbl, off, key):
+            with _tape.no_grad(), _functional_params(tensors, params):
+                def body(carry, _):
+                    kcs, vcs, cur, lens = carry
+                    x = Tensor._wrap(fam.embed(cur, lens)[:, None])
+                    bidx = jnp.arange(B)
+                    page = jnp.clip(lens // bs, 0, tbl.shape[1] - 1)
+                    phys = jnp.maximum(tbl[bidx, page], 0)
+                    flat = phys * bs + lens % bs
+                    kcs2, vcs2 = [], []
+                    for li, layer in enumerate(fam.layers()):
+                        qkv = fam.qkv(layer, Tensor._wrap(x._data[:, 0]))
+                        nH = qkv.shape[-1] // H_D - 2 * kvH
+                        q = qkv[:, :nH * H_D].reshape(B, nH, H_D)
+                        k = qkv[:, nH * H_D:(nH + kvH) * H_D].reshape(
+                            B, kvH, H_D)
+                        v = qkv[:, (nH + kvH) * H_D:].reshape(
+                            B, kvH, H_D)
+                        if variant == "no_write":
+                            kc, vc = kcs[li], vcs[li]
+                        else:
+                            kc = kcs[li].at[flat].set(
+                                k.astype(kcs[li].dtype))
+                            vc = vcs[li].at[flat].set(
+                                v.astype(vcs[li].dtype))
+                        kcs2.append(kc)
+                        vcs2.append(vc)
+                        if variant == "no_attn":
+                            rep = nH // kvH
+                            o = (q + jnp.repeat(k, rep, axis=1) * 0.01
+                                 ).reshape(B, nH * H_D)
+                        else:
+                            o = _pool_decode_attention(
+                                q, kc, vc, off, lens, scale, bs)
+                        x = fam.attn_out(
+                            layer, x,
+                            o.astype(x._data.dtype)[:, None, :])
+                        x = fam.mlp(layer, x)
+                    x = fam.final(x)
+                    lg = fam.logits(x)._data[:, -1]
+                    nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                    return (kcs2, vcs2, nxt, lens + 1), nxt
+
+                carry = (list(kcs), list(vcs), cur, lens)
+                carry, toks = jax.lax.scan(body, carry, None,
+                                           length=chunk)
+                return carry[0], carry[1], jnp.transpose(toks)
+
+        return jax.jit(decode, donate_argnums=(1, 2))
+
+    params = [t._data for t in tensors]
+    NB = 49
+    cur = jnp.zeros((B,), jnp.int32)
+    lens = jnp.asarray(np.full((B,), 200, np.int32))
+    tbln = np.full((B, eng.npb_full), eng._trash_page, np.int32)
+    offn = np.full((B, NB), -1, np.int32)
+    for b in range(B):
+        blks = [1 + (b * 5 + j) % (NB - 1) for j in range(5)]
+        tbln[b, :5] = blks
+        offn[b, blks] = np.arange(5) * bs
+    tblj, offj = jnp.asarray(tbln), jnp.asarray(offn)
+    out = {}
+    for variant in ("full", "no_write", "no_attn"):
+        fn = make(variant)
+        kcs = [jnp.zeros_like(a) for a in eng.cache.key_caches]
+        vcs = [jnp.zeros_like(a) for a in eng.cache.value_caches]
+        kcs, vcs, toks = fn(params, kcs, vcs, cur, lens, tblj, offj,
+                            jax.random.PRNGKey(0))
+        np.asarray(toks)
+        t0 = time.perf_counter()
+        for i in range(3):
+            kcs, vcs, toks = fn(params, kcs, vcs, cur + i, lens, tblj,
+                                offj, jax.random.PRNGKey(i))
+            np.asarray(toks)
+        out[variant + "_ms_per_step"] = round(
+            (time.perf_counter() - t0) / 3 / chunk * 1e3, 2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -130,6 +130,32 @@ def cmd_parts(args):
         "opt_update_ms": round((t_step - t_grad) * 1e3, 1)}), flush=True)
 
 
+def _scan_time(body, init, iters=10):
+    """Time `body` by scanning it `iters` times INSIDE one executable
+    and syncing with a real D2H fetch. This backend's tunnel runtime
+    (a) deduplicates repeated identical calls and (b) returns early
+    from block_until_ready — so only device-side loops with data
+    dependence plus .numpy()-style syncs measure truth."""
+    import jax
+
+    f = jax.jit(lambda c: jax.lax.scan(
+        lambda c_, _: (body(c_), None), c, None, length=iters)[0])
+
+    def sync(r):
+        leaf = jax.tree_util.tree_leaves(r)[0]
+        np.asarray(leaf.reshape(-1)[0])
+
+    r = f(init)
+    sync(r)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        r = f(r)
+        sync(r)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
 def cmd_micro(args):
     """Component microbenches at the 1.3B shapes."""
     import jax
@@ -141,50 +167,48 @@ def cmd_micro(args):
     key = jax.random.PRNGKey(0)
     out = {}
 
-    # flash attention fwd and fwd+bwd
+    # flash attention fwd and fwd+bwd (carry the output forward so each
+    # iteration has fresh content)
     from paddle_tpu.kernels.pallas.flash_attention import flash_attention
     q = jax.random.normal(key, (b, s, H, D), jnp.bfloat16)
 
-    def fa(q):
-        return flash_attention(q, q, q, causal=True)
-
-    t = _time(lambda: np.asarray(fa(q)[0, 0, 0, 0], jnp.float32),
-              steps=args.steps)
+    t = _scan_time(lambda q: flash_attention(q, q, q, causal=True)
+                   .astype(jnp.bfloat16), q)
     fl = 4.0 * b * s * s * H * D / 2  # causal halves the work
     out["flash_fwd_ms"] = round(t * 1e3, 2)
     out["flash_fwd_util"] = round(fl / t / peak, 3)
 
-    g = jax.jit(jax.grad(lambda q: fa(q).astype(jnp.float32).sum()))
-    t = _time(lambda: np.asarray(g(q)[0, 0, 0, 0], jnp.float32),
-              steps=args.steps)
-    out["flash_bwd_ms"] = round(t * 1e3, 2)
-    out["flash_fwdbwd_util"] = round(3.5 * fl / t / peak, 3)
+    g = jax.grad(lambda q: flash_attention(q, q, q, causal=True)
+                 .astype(jnp.float32).sum())
+    t = _scan_time(lambda q: (q + 1e-3 * g(q)).astype(jnp.bfloat16), q)
+    out["flash_fwdbwd_ms"] = round(t * 1e3, 2)
+    out["flash_fwdbwd_util"] = round(4.5 * fl / t / peak, 3)
 
-    # the MLP-ish matmul at model shape: [b*s, h] x [h, 4h]
+    # the MLP-ish matmul at model shape: [b*s, h] x [h, 4h] x [4h, h]
     x = jax.random.normal(key, (b * s, h), jnp.bfloat16)
-    w = jax.random.normal(key, (h, 4 * h), jnp.bfloat16)
-    mm = jax.jit(lambda x, w: x @ w)
-    t = _time(lambda: np.asarray(mm(x, w)[0, 0], jnp.float32),
-              steps=args.steps)
-    out["matmul_ms"] = round(t * 1e3, 2)
-    out["matmul_util"] = round(2.0 * b * s * h * 4 * h / t / peak, 3)
+    w1 = jax.random.normal(key, (h, 4 * h), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(key, (4 * h, h), jnp.bfloat16) * 0.02
+    t = _scan_time(lambda x: ((x @ w1) @ w2).astype(jnp.bfloat16), x)
+    out["matmul_pair_ms"] = round(t * 1e3, 2)
+    out["matmul_util"] = round(2.0 * 2 * b * s * h * 4 * h / t / peak,
+                               3)
 
     # lm head + softmax cross-entropy (the vocab-wide tail) fwd+bwd
     hid = jax.random.normal(key, (b * s, h), jnp.bfloat16)
-    wv = jax.random.normal(key, (v, h), jnp.bfloat16)
+    wv = jax.random.normal(key, (v, h), jnp.bfloat16) * 0.02
     lab = jax.random.randint(key, (b * s,), 0, v)
 
-    def head(hid, wv):
+    def head(hid):
         logits = (hid @ wv.T).astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         return (lse - jnp.take_along_axis(
             logits, lab[:, None], axis=-1)[:, 0]).mean()
 
-    hg = jax.jit(jax.grad(head, argnums=(0, 1)))
-    t = _time(lambda: np.asarray(hg(hid, wv)[0][0, 0], jnp.float32),
-              steps=args.steps)
+    hgrad = jax.grad(head)
+    t = _scan_time(lambda hid: (hid - 1e-3 * hgrad(hid)).astype(
+        jnp.bfloat16), hid)
     out["head_ce_fwdbwd_ms"] = round(t * 1e3, 2)
-    out["head_ce_util"] = round(6.0 * b * s * h * v / t / peak, 3)
+    out["head_ce_util"] = round(4.0 * b * s * h * v / t / peak, 3)
 
     # optimizer-update-shaped stream: fp32 param + grad + 2 bf16 moments
     from bench import hbm_bw
@@ -208,7 +232,13 @@ def cmd_micro(args):
         st = (p, g32, m, v_)
         return p
 
-    t = _time(lambda: np.asarray(run()[0], jnp.float32), steps=args.steps)
+    run()
+    np.asarray(st[0][0])        # real sync; donated chain => fresh
+    t0 = time.perf_counter()    # content every call (no dedup)
+    for _ in range(10):
+        run()
+    np.asarray(st[0][0])
+    t = (time.perf_counter() - t0) / 10
     bytes_ = n32 * (4 + 4 + 4 * 2 + 4)  # read p,g,m,v + write p,m,v
     out["optstream_330M_ms"] = round(t * 1e3, 2)
     out["optstream_gbps"] = round(bytes_ / t / 1e9, 1)

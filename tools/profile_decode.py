@@ -1,0 +1,105 @@
+"""Decompose the bs8 decode gap (VERDICT r4 next-6): where do the bytes
+go? Compares the fused decode-loop executable's XLA-reported HBM
+traffic against the analytic roofline (weights + KV cache once per
+step), and times bs1/bs8 steps for the per-row overhead split.
+
+    python tools/profile_decode.py            # 1.3B on the real chip
+    python tools/profile_decode.py --small    # tiny config anywhere
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--steps", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig, num_params
+    from paddle_tpu.models.generation import (_build_fused_loop,
+                                              _static_cache, _family)
+    from bench import hbm_bw
+
+    if args.small:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=256,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0)
+        max_len, batches = 256, (1, 2)
+    else:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=2048,
+                        num_layers=24, num_heads=16,
+                        max_position_embeddings=2048,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0)
+        max_len, batches = 256, (1, 8)
+    model = GPTForCausalLM(cfg).bfloat16()
+    model.eval()
+    _, fwd_fn, emb_dtype = _family(model)
+    dev = jax.devices()[0]
+    n = num_params(cfg)
+    out = {"params": n, "scan_steps": args.steps}
+
+    for b in batches:
+        caches = _static_cache(model, b, max_len, emb_dtype)
+        loop, tensors = _build_fused_loop(model, fwd_fn, False, 1.0,
+                                          1.0, None, args.steps)
+        params = [t._data for t in tensors]
+        nxt = jnp.zeros((b,), jnp.int32)
+        pos0 = jnp.asarray(128, jnp.int32)
+        key = jax.random.PRNGKey(0)
+        fin = jnp.zeros((b,), jnp.bool_)
+        buf = jnp.zeros((b, max_len), jnp.int32)
+
+        lowered = loop.lower(params, caches, nxt, pos0, key, fin, buf)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        bytes_total = float(ca.get("bytes accessed", 0.0))
+        bytes_step = bytes_total / args.steps
+
+        # analytic per-step floor: all weights once (bf16) + this
+        # step's cache read (+ its write-back is the same pages)
+        pbytes = 2.0 * n
+        cache_bytes = (2 * cfg.num_layers * cfg.num_heads * cfg.head_dim
+                       * max_len * 2.0 * b)
+        floor = pbytes + cache_bytes
+        # time it (fresh caches each call: donation consumed them)
+        def run():
+            c2 = _static_cache(model, b, max_len, emb_dtype)
+            b2 = jnp.zeros((b, max_len), jnp.int32)
+            r = loop(params, c2, nxt, pos0, key, fin, b2)
+            np.asarray(r[1])
+            return r
+        run()
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        step_ms = dt / args.steps * 1e3
+        out[f"bs{b}"] = {
+            "xla_bytes_per_step_gb": round(bytes_step / 1e9, 3),
+            "floor_bytes_per_step_gb": round(floor / 1e9, 3),
+            "traffic_ratio": round(bytes_step / floor, 3),
+            "step_ms_incl_cache_realloc": round(step_ms, 3),
+            "roofline_step_ms": round(floor / hbm_bw(dev) * 1e3, 3),
+        }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

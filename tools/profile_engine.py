@@ -1,0 +1,130 @@
+"""Decompose LLMEngine serving time at 1.3B (why is a decode chunk
+slower than chunk_len x the dense decode step?).
+
+Times, with warm executables and a full batch:
+  - one prefill call (sb bucket)
+  - one decode-chunk executable call (host logic bypassed)
+  - one engine.step() (admission + chunk + host bookkeeping)
+
+    python tools/profile_engine.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_position_embeddings=2048,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg).bfloat16()
+    model.eval()
+    rng = np.random.default_rng(0)
+    eng = LLMEngine(model, max_batch=8, num_blocks=49, block_size=64,
+                    decode_chunk=16, prompt_quantum=128,
+                    max_model_len=2048)
+    out = {}
+
+    # fill all 8 slots with long-lived requests
+    for i in range(8):
+        eng.add_request(i, rng.integers(0, 50304, (100,)).astype(
+            np.int32), max_new_tokens=1024)
+    t0 = time.perf_counter()
+    eng.step()          # admits + 8 prefills + first chunk (compiles)
+    out["first_step_s"] = round(time.perf_counter() - t0, 2)
+
+    # warm prefill timing: add one more request into a freed slot? all
+    # slots busy — time the prefill fn directly on seq 0's shapes
+    sb, npb_pf = 128, 2
+    fn = eng._prefill_fns.get((sb, npb_pf))
+    if fn is not None:
+        B = eng.max_batch
+        ids = np.zeros((B, sb), np.int32)
+        plen = np.full((B,), 100, np.int32)
+        tblp = np.full((B, npb_pf), -1, np.int32)
+        for r in range(B):
+            tblp[r, :2] = eng.cache.pages(r)[:2]
+        params = [t._data for t in eng._tensors]
+
+        def one_prefill(salt):
+            nxt, kcs, vcs = fn(params, eng.cache.key_caches,
+                               eng.cache.value_caches,
+                               jnp.asarray(ids + salt),
+                               jnp.asarray(plen), jnp.asarray(tblp),
+                               jax.random.PRNGKey(salt))
+            for i in range(eng.cache.num_layers):
+                eng.cache.update(i, kcs[i], vcs[i])
+            return nxt
+
+        np.asarray(one_prefill(0))         # real sync (D2H)
+        t0 = time.perf_counter()
+        for i in range(4):
+            np.asarray(one_prefill(i + 1))
+        out["batched_prefill_ms"] = round(
+            (time.perf_counter() - t0) / 4 * 1e3, 1)
+
+    # warm chunk call, host logic included (step) vs bypassed
+    t0 = time.perf_counter()
+    eng.step()
+    out["warm_step_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        eng.step()
+    out["steady_step_ms"] = round(
+        (time.perf_counter() - t0) / 4 * 1e3, 1)
+    chunk = eng.decode_chunk
+    out["steady_ms_per_token_row"] = round(
+        out["steady_step_ms"] / chunk, 2)
+
+    # bypass host bookkeeping: repeat the raw chunk executable
+    fn = eng._decode_fns.get(chunk)
+    params = [t._data for t in eng._tensors]
+    B, NB = eng.max_batch, eng.cache.allocator.num_blocks
+    cur = jnp.zeros((B,), jnp.int32)
+    lens = jnp.asarray(np.full((B,), 200, np.int32))
+    tbl = jnp.asarray(np.full((B, eng.npb_full), eng._trash_page,
+                              np.int32))
+    off = jnp.asarray(np.full((B, NB), -1, np.int32)
+                      .__setitem__(slice(None), -1) or
+                      np.full((B, NB), -1, np.int32))
+    # give every row ownership of a few real blocks
+    offn = np.full((B, NB), -1, np.int32)
+    tbln = np.full((B, eng.npb_full), eng._trash_page, np.int32)
+    for b in range(B):
+        blks = [1 + (b * 5 + j) % (NB - 1) for j in range(5)]
+        tbln[b, :5] = blks
+        offn[b, blks] = np.arange(5) * eng.block_size
+    tblj, offj = jnp.asarray(tbln), jnp.asarray(offn)
+    kcs, vcs = eng.cache.key_caches, eng.cache.value_caches
+    kcs, vcs, toks = fn(params, kcs, vcs, cur, lens, tblj, offj,
+                        jax.random.PRNGKey(0))
+    np.asarray(toks)        # real sync; donated caches differ per call
+    t0 = time.perf_counter()
+    for i in range(4):
+        # vary cur so the dedup cache can't short-circuit the call
+        kcs, vcs, toks = fn(params, kcs, vcs, cur + i, lens, tblj,
+                            offj, jax.random.PRNGKey(i))
+        np.asarray(toks)
+    dt = (time.perf_counter() - t0) / 4
+    out["raw_chunk_ms"] = round(dt * 1e3, 1)
+    out["raw_ms_per_scan_step"] = round(dt / chunk * 1e3, 2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

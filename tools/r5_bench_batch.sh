@@ -1,0 +1,14 @@
+#!/bin/sh
+# Round-5 TPU measurement battery (one process per config).
+cd "$(dirname "$0")/.."
+for c in gpt1p3b resnet50 decode_paged dispatch decode; do
+  echo "=== bench $c"
+  timeout 1800 python bench.py --config $c 2>&1 | grep -v '^W' | tail -3
+done
+echo "=== micro"
+timeout 1500 python tools/profile_1p3b.py micro 2>&1 | grep -v '^W' | tail -3
+echo "=== parts"
+timeout 1800 python tools/profile_1p3b.py parts --policy full 2>&1 | grep -v '^W' | tail -3
+echo "=== 6p7b layer proxy"
+timeout 1800 python tools/dryfit_6p7b.py layer 2>&1 | grep -v '^W' | tail -3
+echo "=== ALL DONE"

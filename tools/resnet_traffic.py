@@ -1,0 +1,71 @@
+"""ResNet-50 HBM-traffic accounting (VERDICT r4 next-4: per-lever
+numbers for the remaining roofline gap).
+
+Measures the compiled forward's XLA-reported bytes in three modes:
+  train+fast_bn_stats  — the bench configuration
+  train (two-pass BN)  — what fast_bn_stats already saves
+  eval                 — BN uses running stats: NO batch-stats pass;
+                         the delta vs train bounds what a Pallas
+                         conv+stats epilogue fusion could save
+
+    python tools/resnet_traffic.py          # on the real chip
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def fwd_bytes(model, x, train):
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import amp
+    from paddle_tpu.jit import _collect_params, _functional_params
+    import paddle_tpu.autograd.tape as _tape
+
+    model.train() if train else model.eval()
+    _, pts_, _, bts_ = _collect_params(model)
+    tensors = pts_ + bts_
+
+    def fwd(params, xx):
+        with _tape.no_grad(), _functional_params(tensors, params):
+            with amp.auto_cast(enable=True, level="O1",
+                               dtype="bfloat16"):
+                return model(xx)._data
+
+    ca = jax.jit(fwd).lower([t._data for t in tensors],
+                            x).compile().cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    return float(ca.get("bytes accessed", 0.0))
+
+
+def main():
+    import paddle_tpu as pt
+    from paddle_tpu.vision.models import resnet50
+
+    batch, hw = 256, 224
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, hw, hw, 3)).astype(np.float32)
+    out = {"batch": batch}
+    for name, flags, s2d in [
+            ("train_fast_bn_s2d", True, True),
+            ("train_fast_bn", True, False),
+            ("train_twopass_bn", False, False),
+            ("eval", True, False)]:
+        pt.set_flags({"FLAGS_fast_bn_stats": flags})
+        model = resnet50(data_format="NHWC", space_to_depth_stem=s2d)
+        gb = fwd_bytes(model, x, train=not name.startswith("eval"))
+        out[name + "_fwd_gb"] = round(gb / 1e9, 2)
+    out["stats_pass_bound_gb"] = round(
+        out["train_fast_bn_fwd_gb"] - out["eval_fwd_gb"], 2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
