@@ -71,7 +71,8 @@ def __getattr__(name):
         mod = importlib.import_module(".sparse", __name__)
         globals()["sparse"] = mod
         return mod
-    if name in ("fft", "signal", "quantization", "geometric", "audio", "text"):
+    if name in ("fft", "signal", "quantization", "geometric", "audio", "text",
+                "resilience"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

@@ -13,19 +13,49 @@ dtype, shard index->offset boxes). Loading reassembles the global array
 from shard files and commits it to the DESTINATION tensor's current
 NamedSharding — overlap computation degenerates to slice-assembly +
 device_put, which handles every mesh/placement change.
-"""
+
+Crash safety (resilience layer): a save writes every shard file into a
+hidden sibling temp directory, fsyncs them, writes `metadata.json`
+LAST (itself via tmp+fsync+rename, carrying a `__manifest__` of
+per-file sha256 checksums), and only then renames the whole directory
+into place. Single-writer contract: the controller owns every shard
+(see above), so exactly ONE process saves a given checkpoint path; two
+concurrent writers to the same path race their directory renames
+(last-complete-save wins wholesale — saves are never merged). A crash at ANY point leaves either the previous complete
+checkpoint untouched or a `.*.tmp-*` directory that readers ignore —
+never a half-written checkpoint at the destination path. `is_complete`
+/ `verify_checkpoint` detect torn or corrupted directories and
+`resume_latest` restores the newest checkpoint that passes, skipping
+torn ones (and can reap them). Chaos-tested through the
+`checkpoint.before_meta` / `checkpoint.before_rename` fault points
+(tests/test_resilience.py)."""
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Dict
+import shutil
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...resilience import faults
 
 _META = "metadata.json"
+_MANIFEST = "__manifest__"      # reserved key inside metadata.json
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+from ...utils.fs import fsync_dir as _fsync_dir
 
 
 def _np_dtype(name: str):
@@ -61,9 +91,68 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         _save_state_dict(state_dict, path)
 
 
+class _HashingWriter:
+    """File facade hashing bytes as np.save streams them — the
+    manifest checksum costs zero extra reads or copies. (No fileno():
+    that downgrade-blocks numpy's fwrite fast path, forcing it through
+    write() where we can see the bytes.)"""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha = hashlib.sha256()
+        self.nbytes = 0
+
+    def write(self, b):
+        self.sha.update(b)
+        self.nbytes += len(b)
+        return self._f.write(b)
+
+    def flush(self):
+        self._f.flush()
+
+
+def _write_npy(dirpath: str, fname: str, arr: np.ndarray) -> dict:
+    """Durable shard write: npy bytes + fsync; returns its manifest
+    record (size + content checksum)."""
+    fp = os.path.join(dirpath, fname)
+    with open(fp, "wb") as f:
+        hw = _HashingWriter(f)
+        np.save(hw, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    return {"bytes": hw.nbytes, "sha256": hw.sha.hexdigest()}
+
+
 def _save_state_dict(state_dict: Dict, path: str) -> None:
-    os.makedirs(path, exist_ok=True)
+    """Atomic directory checkpoint: everything lands in a hidden
+    sibling tmp dir; the destination path flips over in one rename
+    after metadata.json (written last) makes the tmp dir complete."""
+    import uuid
+    path = os.path.abspath(path)
+    parent, base = os.path.dirname(path), os.path.basename(path)
+    os.makedirs(parent, exist_ok=True)
+    # pid alone collides across hosts on shared filesystems; the uuid
+    # makes every writer's staging dir private
+    tmp = os.path.join(
+        parent, f".{base}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    os.makedirs(tmp)
+    try:
+        _stage_and_swap(state_dict, path, parent, tmp)
+    except BaseException:
+        # failed save (disk full, injected crash): don't leak a
+        # checkpoint-sized staging dir per retry — mirror
+        # framework_io.save's tmp hygiene. (A HARD crash still leaves
+        # it; resume_latest(cleanup=True) reaps those.)
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def _stage_and_swap(state_dict: Dict, path: str, parent: str,
+                    tmp: str) -> None:
+    import uuid
+    base = os.path.basename(path)
     meta = {}
+    manifest = {}
     for name, arr in _tensor_items(state_dict):
         arr = jax.block_until_ready(arr)
         entry = {"global_shape": list(np.shape(arr)),
@@ -81,8 +170,8 @@ def _save_state_dict(state_dict: Dict, path: str) -> None:
                 seen.add(key)
                 fname = f"{name.replace('/', '_')}." \
                         f"{len(entry['shards'])}.npy"
-                np.save(os.path.join(path, fname),
-                        _to_storable(np.asarray(sh.data)))
+                manifest[fname] = _write_npy(
+                    tmp, fname, _to_storable(np.asarray(sh.data)))
                 offsets = [s.start or 0 for s in sh.index] if sh.index \
                     else [0] * np.ndim(arr)
                 entry["shards"].append(
@@ -90,14 +179,45 @@ def _save_state_dict(state_dict: Dict, path: str) -> None:
                      "shape": list(np.shape(sh.data))})
         else:
             fname = f"{name.replace('/', '_')}.0.npy"
-            np.save(os.path.join(path, fname),
-                    _to_storable(np.asarray(arr)))
+            manifest[fname] = _write_npy(
+                tmp, fname, _to_storable(np.asarray(arr)))
             entry["shards"].append(
                 {"file": fname, "offsets": [0] * np.ndim(arr),
                  "shape": list(np.shape(arr))})
         meta[name] = entry
-    with open(os.path.join(path, _META), "w") as f:
+    faults.fault_point("checkpoint.before_meta", path=path)
+    # metadata.json written LAST and itself atomically: its presence is
+    # the completeness marker, its manifest the integrity record
+    meta[_MANIFEST] = {"version": 1, "files": manifest}
+    mtmp = os.path.join(tmp, _META + ".tmp")
+    with open(mtmp, "w") as f:
         json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(mtmp, os.path.join(tmp, _META))
+    _fsync_dir(tmp)
+    faults.fault_point("checkpoint.before_rename", path=path)
+    if os.path.exists(path):
+        # two renames, not rmtree-then-rename: the destination is never
+        # absent-and-half-written; worst crash window leaves the old
+        # checkpoint aside as .<base>.old-<pid> plus a COMPLETE tmp
+        old = os.path.join(
+            parent, f".{base}.old-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        os.replace(path, old)
+        try:
+            faults.fault_point("checkpoint.between_renames", path=path)
+            os.replace(tmp, path)
+        except BaseException:
+            # soft failure between the renames: roll the previous
+            # checkpoint back so the destination is never left absent
+            # for load_state_dict consumers. (A HARD crash here can't
+            # roll back — resume_latest repairs the stranded .old dir.)
+            os.replace(old, path)
+            raise
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.replace(tmp, path)
+    _fsync_dir(parent)
 
 
 def _read_region(path, entry, starts, stops, dtype):
@@ -188,4 +308,131 @@ def _load_state_dict(state_dict: Dict, path: str) -> None:
 
 def get_checkpoint_files(path):
     with open(os.path.join(path, _META)) as f:
-        return list(json.load(f).keys())
+        return [k for k in json.load(f) if k != _MANIFEST]
+
+
+# ---------------------------------------------------------------------------
+# torn-checkpoint detection + resume (resilience layer)
+# ---------------------------------------------------------------------------
+def is_complete(path: str) -> bool:
+    """Cheap completeness probe: metadata.json parses and every
+    manifest file exists with the recorded size. (Content checksums are
+    the `verify_checkpoint(deep=True)` tier.)"""
+    return not verify_checkpoint(path, deep=False)
+
+
+def verify_checkpoint(path: str, deep: bool = True) -> List[str]:
+    """Integrity report for one checkpoint directory — empty list means
+    healthy. deep=True re-hashes every shard file against the saved
+    sha256 manifest (bit-rot / torn-write detection); deep=False stops
+    at existence + size."""
+    problems: List[str] = []
+    mpath = os.path.join(path, _META)
+    try:
+        with open(mpath) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        return [f"{_META} missing (torn checkpoint: crash before the "
+                "metadata write)"]
+    except (OSError, ValueError) as e:
+        return [f"{_META} unreadable: {e}"]
+    manifest = meta.get(_MANIFEST, {}).get("files")
+    if manifest is None:
+        # pre-manifest checkpoint: fall back to shard-file existence
+        manifest = {}
+        for entry in meta.values():
+            if not isinstance(entry, dict):
+                continue
+            for sh in entry.get("shards", []):
+                manifest[sh["file"]] = None
+    for fname, rec in manifest.items():
+        fp = os.path.join(path, fname)
+        if not os.path.exists(fp):
+            problems.append(f"{fname} missing")
+            continue
+        if rec is None:
+            continue
+        if os.path.getsize(fp) != rec["bytes"]:
+            problems.append(
+                f"{fname}: size {os.path.getsize(fp)} != recorded "
+                f"{rec['bytes']}")
+        elif deep and _sha256(fp) != rec["sha256"]:
+            problems.append(f"{fname}: sha256 mismatch (corrupted)")
+    return problems
+
+
+def _ckpt_order_key(name: str) -> Tuple:
+    """Newest-first sort key: trailing integer in the directory name
+    (step_200 > step_30) with mtime as tiebreak handled by caller."""
+    digits = ""
+    for ch in reversed(name):
+        if ch.isdigit():
+            digits = ch + digits
+        elif digits:
+            break
+    return (1, int(digits)) if digits else (0, 0)
+
+
+def resume_latest(state_dict: Dict, root: str, verify: bool = True,
+                  cleanup: bool = False) -> Optional[str]:
+    """Restore the newest COMPLETE checkpoint under `root` into
+    `state_dict` (in place), skipping torn/corrupted ones — the restart
+    entry point after a crash. Returns the loaded checkpoint's path, or
+    None when no usable checkpoint exists.
+
+    Candidates are the subdirectories of `root` holding a metadata.json
+    (hidden `.*.tmp-*` / `.*.old-*` staging dirs are ignored), ordered
+    by trailing step number then mtime. verify=True re-hashes shard
+    files against the manifest before trusting a candidate.
+    cleanup=True also reaps staging litter and quarantines torn
+    checkpoints it skipped (repair: a torn dir is renamed away so the
+    next scan is clean)."""
+    if not os.path.isdir(root):
+        return None
+    # repair first: a crash between _save_state_dict's two destination
+    # renames leaves the PREVIOUS complete checkpoint stranded as a
+    # hidden .X.old-* dir with X itself absent — restore it so the
+    # atomicity guarantee ("a crash leaves the previous complete
+    # checkpoint") survives that window. .X.tmp-* dirs are different:
+    # they belong to a save whose caller saw it FAIL, so resurrecting
+    # them would un-atomically complete a failed save — they are litter
+    # (reaped under cleanup), never candidates.
+    hidden = [n for n in os.listdir(root)
+              if n.startswith(".")
+              and (".tmp-" in n or ".old-" in n or n.endswith(".torn"))
+              and os.path.isdir(os.path.join(root, n))]
+    for name in hidden:
+        p = os.path.join(root, name)
+        if ".old-" in name:
+            stem = name[1:name.index(".old-")]
+            dest = os.path.join(root, stem)
+            if stem and not os.path.exists(dest) \
+                    and not verify_checkpoint(p, deep=verify):
+                os.replace(p, dest)
+                continue
+        if cleanup:
+            shutil.rmtree(p, ignore_errors=True)
+    entries = []
+    for name in os.listdir(root):
+        p = os.path.join(root, name)
+        if not os.path.isdir(p) or name.startswith("."):
+            continue
+        if not os.path.exists(os.path.join(p, _META)):
+            continue    # not a checkpoint at all (logs/, tensorboard/,
+            # ...) — never a "torn" candidate, never quarantined
+        entries.append((_ckpt_order_key(name), os.path.getmtime(p), p))
+    for _, _, p in sorted(entries, reverse=True):
+        problems = verify_checkpoint(p, deep=verify)
+        if not problems:
+            load_state_dict(state_dict, p)
+            return p
+        import warnings
+        warnings.warn(
+            f"resume_latest: skipping torn checkpoint {p}: "
+            + "; ".join(problems), UserWarning, stacklevel=2)
+        if cleanup:
+            quarantine = os.path.join(
+                os.path.dirname(p), f".{os.path.basename(p)}.torn")
+            shutil.rmtree(quarantine, ignore_errors=True)
+            os.replace(p, quarantine)
+    return None

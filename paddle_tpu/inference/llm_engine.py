@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -43,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..resilience import faults
 from .paged_cache import PagedKVCache
 
 __all__ = ["LLMEngine", "GenerationResult"]
@@ -53,15 +55,22 @@ class GenerationResult:
     request_id: object
     prompt_ids: np.ndarray
     output_ids: np.ndarray          # generated tokens (no prompt)
-    finish_reason: str              # "eos" | "length"
+    finish_reason: str   # "eos" | "length" | "error" | "deadline" | "rejected"
+    error: Optional[str] = None     # failure detail when not ok
+
+    @property
+    def ok(self) -> bool:
+        return self.finish_reason in ("eos", "length")
 
 
-@dataclasses.dataclass
-class _Request:
+@dataclasses.dataclass(eq=False)        # identity eq: field-comparing
+class _Request:                         # ndarray prompts would make
+                                        # waiting.remove() ambiguous
     rid: object
     prompt: np.ndarray                       # int32 [prompt_len]
     max_new_tokens: int                      # TOTAL generation budget
     resume_out: List[int] = dataclasses.field(default_factory=list)
+    deadline: Optional[float] = None         # absolute monotonic seconds
 
     @property
     def context_len(self) -> int:
@@ -71,7 +80,7 @@ class _Request:
 
 class _Seq:
     __slots__ = ("rid", "prompt", "max_new", "slot", "length", "out",
-                 "admit_seq")
+                 "admit_seq", "deadline")
 
     def __init__(self, req: _Request, slot: int, admit_seq: int):
         self.rid = req.rid
@@ -81,6 +90,13 @@ class _Seq:
         self.length = 0                 # tokens currently in the cache
         self.out: List[int] = list(req.resume_out)
         self.admit_seq = admit_seq      # monotonic admission order
+        self.deadline = req.deadline
+
+    @property
+    def token_budget(self) -> int:
+        """Max cache tokens this sequence can ever occupy — the bound
+        add_request validated against the pool."""
+        return len(self.prompt) + self.max_new
 
 
 def _bucket(n: int, quantum: int) -> int:
@@ -310,7 +326,10 @@ class LLMEngine:
                  decode_chunk: int = 8, prompt_quantum: int = 128,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_p: float = 1.0, eos_token_id: Optional[int] = None,
-                 seed: int = 0, kv_quant_scales=None):
+                 seed: int = 0, kv_quant_scales=None,
+                 shed_load: bool = False,
+                 max_waiting: Optional[int] = None,
+                 step_timeout_s: Optional[float] = None):
         cfg = model.config
         self.model = model
         self.fam = _family_for(model)
@@ -365,32 +384,68 @@ class LLMEngine:
         self.slots: List[Optional[_Seq]] = [None] * self.max_batch
         self._prefill_fns: Dict = {}
         self._decode_fns: Dict = {}
+        # load shedding / deadlines / watchdog (resilience layer)
+        self.shed_load = bool(shed_load)
+        self.max_waiting = max_waiting
+        self.step_timeout_s = step_timeout_s
+        self._failed: List[GenerationResult] = []   # drained by step()
+        self._now = time.monotonic                  # stubbable clock
         self.stats = {"preemptions": 0, "prefills": 0, "decode_chunks": 0,
-                      "decode_tokens": 0}
+                      "decode_tokens": 0, "failed_requests": 0,
+                      "rejected_requests": 0, "deadline_expired": 0}
 
     # -- request lifecycle -------------------------------------------------
-    def add_request(self, request_id, prompt_ids, max_new_tokens: int = 32):
+    def _reject(self, request_id, prompt, reason: str, exc_type=None):
+        """Load-shedding admission: record a rejected result instead of
+        crashing the caller (shed_load=True), or raise (legacy)."""
+        if not self.shed_load:
+            raise (exc_type or RuntimeError)(reason)
+        self.stats["rejected_requests"] += 1
+        self._failed.append(GenerationResult(
+            request_id=request_id, prompt_ids=prompt,
+            output_ids=np.zeros((0,), np.int32),
+            finish_reason="rejected", error=reason))
+
+    def add_request(self, request_id, prompt_ids, max_new_tokens: int = 32,
+                    deadline_s: Optional[float] = None):
+        """Queue a request. deadline_s: wall-clock TTL from now — when
+        it expires before the request finishes, the request is failed
+        with finish_reason="deadline" (evicted mid-decode if running)
+        while other requests keep serving."""
         prompt = np.asarray(
             prompt_ids.numpy() if isinstance(prompt_ids, Tensor)
             else prompt_ids, dtype=np.int32).reshape(-1)
         total = len(prompt) + max_new_tokens
         if total > self.max_model_len:
-            raise ValueError(
+            return self._reject(
+                request_id, prompt,
                 f"request {request_id!r}: prompt ({len(prompt)}) + "
                 f"max_new_tokens ({max_new_tokens}) = {total} exceeds "
-                f"max_model_len ({self.max_model_len})")
+                f"max_model_len ({self.max_model_len})", ValueError)
         need = -(-total // self.block_size)
         if need > self.cache.allocator.num_blocks - 1:
-            raise MemoryError(
+            return self._reject(
+                request_id, prompt,
                 f"request {request_id!r} needs {need} cache blocks but "
                 f"the pool only has "
-                f"{self.cache.allocator.num_blocks - 1} usable")
+                f"{self.cache.allocator.num_blocks - 1} usable",
+                MemoryError)
+        if self.max_waiting is not None and \
+                len(self.waiting) >= self.max_waiting:
+            return self._reject(
+                request_id, prompt,
+                f"request {request_id!r}: waiting queue is full "
+                f"({self.max_waiting})", RuntimeError)
+        deadline = (self._now() + deadline_s
+                    if deadline_s is not None else None)
         self.waiting.append(_Request(request_id, prompt,
-                                     int(max_new_tokens)))
+                                     int(max_new_tokens),
+                                     deadline=deadline))
 
     @property
     def has_unfinished(self) -> bool:
-        return bool(self.waiting) or any(s is not None for s in self.slots)
+        return (bool(self.waiting) or bool(self._failed)
+                or any(s is not None for s in self.slots))
 
     # -- scheduling --------------------------------------------------------
     def _free_slot(self) -> Optional[int]:
@@ -437,7 +492,7 @@ class LLMEngine:
         self.slots[victim.slot] = None
         self.waiting.appendleft(_Request(
             victim.rid, victim.prompt, victim.max_new,
-            resume_out=list(victim.out)))
+            resume_out=list(victim.out), deadline=victim.deadline))
         return True
 
     def _grow(self, seq: _Seq, by: int) -> bool:
@@ -459,6 +514,8 @@ class LLMEngine:
         weights stream ONCE per admission wave instead of once per
         sequence. Returns each sequence's first sampled token."""
         self.stats["prefills"] += len(seqs)
+        for s in seqs:
+            faults.fault_point("engine.prefill.seq", rid=s.rid)
         B = self.max_batch
         merged = [np.concatenate([s.prompt, np.asarray(s.out, np.int32)])
                   if s.out else s.prompt for s in seqs]
@@ -477,9 +534,11 @@ class LLMEngine:
         fn = self._prefill_fn(sb, npb_pf)
         kcs, vcs = self.cache.key_caches, self.cache.value_caches
         self._key, sub = jax.random.split(self._key)
-        nxt, kcs, vcs = fn([t._data for t in self._tensors], kcs, vcs,
-                           jnp.asarray(ids), jnp.asarray(plen),
-                           jnp.asarray(tbl), sub)
+        with self._step_watchdog("engine prefill"):
+            nxt, kcs, vcs = fn([t._data for t in self._tensors], kcs, vcs,
+                               jnp.asarray(ids), jnp.asarray(plen),
+                               jnp.asarray(tbl), sub)
+            nxt = jax.block_until_ready(nxt)
         for i in range(self.cache.num_layers):
             self.cache.update(i, kcs[i], vcs[i])
         return [int(t) for t in np.asarray(nxt)[:len(seqs)]]
@@ -740,26 +799,43 @@ class LLMEngine:
         self._decode_fns[chunk] = fn
         return fn
 
-    def _run_decode_chunk(self) -> Dict[int, np.ndarray]:
-        """One chunk of decode steps for every active slot. Returns
-        {slot: np tokens [chunk]}."""
-        active = [s for s in self.slots if s is not None]
+    def _run_decode_chunk(self, only: Optional[_Seq] = None
+                          ) -> Dict[int, np.ndarray]:
+        """One chunk of decode steps for every active slot (or for
+        `only`, with every other row rendered inactive — the
+        poisoned-request isolation retry). Returns {slot: np tokens
+        [chunk]}."""
+        active = [s for s in self.slots
+                  if s is not None and (only is None or s is only)]
         if not active:
             return {}
         # chunk size: power-of-two bucket, never past the model cap
         headroom = min(self.max_model_len - s.length for s in active)
         chunk = _pow2_floor(max(1, min(self.decode_chunk, headroom)))
-        # lease pages for the chunk up front (preempting if needed)
+        # lease pages for the chunk up front (preempting if needed),
+        # capped at each sequence's remaining token budget: decode
+        # never needs more blocks than add_request validated against
+        # the pool (the excess in-chunk writes past the budget fall
+        # through to the trash page via the table padding). Leasing is
+        # delta-based off the cache's leased length, so a retry after a
+        # failed executable call never double-leases.
         for s in list(active):
-            if self.slots[s.slot] is None:      # got preempted meanwhile
+            if self.slots[s.slot] is not s:     # got preempted meanwhile
                 continue
-            if not self._grow(s, chunk):
+            faults.fault_point("engine.decode.seq", rid=s.rid)
+            want = min(s.length + chunk, max(s.token_budget, s.length))
+            by = want - self.cache.length(s.rid)
+            if by > 0 and not self._grow(s, by):
                 raise MemoryError(
                     "paged pool too small for even one sequence's "
                     "decode chunk — enlarge num_blocks")
-        active = [s for s in self.slots if s is not None]
+        active = [s for s in self.slots
+                  if s is not None and (only is None or s is only)]
+        if not active:
+            return {}
         B = self.max_batch
         NB = self.cache.allocator.num_blocks
+        active_slots = {s.slot for s in active}
         cur = np.zeros((B,), np.int32)
         lens = np.zeros((B,), np.int32)
         # write table (page index -> physical block; full static width)
@@ -771,7 +847,7 @@ class LLMEngine:
         off[:, self._trash_page] = 0
         for b in range(B):
             s = self.slots[b]
-            if s is None:
+            if s is None or b not in active_slots:
                 continue
             cur[b] = self._last_token(s)
             lens[b] = s.length
@@ -783,9 +859,11 @@ class LLMEngine:
         fn = self._decode_fn(chunk)
         kcs, vcs = self.cache.key_caches, self.cache.value_caches
         self._key, sub = jax.random.split(self._key)
-        kcs, vcs, toks = fn([t._data for t in self._tensors], kcs, vcs,
-                            jnp.asarray(cur), jnp.asarray(lens),
-                            jnp.asarray(tbl), jnp.asarray(off), sub)
+        with self._step_watchdog("engine decode chunk"):
+            kcs, vcs, toks = fn([t._data for t in self._tensors], kcs, vcs,
+                                jnp.asarray(cur), jnp.asarray(lens),
+                                jnp.asarray(tbl), jnp.asarray(off), sub)
+            toks = jax.block_until_ready(toks)
         for i in range(self.cache.num_layers):
             self.cache.update(i, kcs[i], vcs[i])
         toks = np.asarray(toks)
@@ -799,19 +877,126 @@ class LLMEngine:
     def _last_token(self, seq: _Seq) -> int:
         return int(seq.out[-1]) if seq.out else int(seq.prompt[-1])
 
+    def _step_watchdog(self, what: str):
+        """Hang detector around a device step (step_timeout_s)."""
+        from ..utils.watchdog import watchdog
+        if not self.step_timeout_s:
+            import contextlib
+            return contextlib.nullcontext()
+        return watchdog(self.step_timeout_s, what=what)
+
+    def _fail_seq(self, seq: _Seq, reason: str, finish_reason: str,
+                  finished: List[GenerationResult]) -> None:
+        """Evict a running sequence as failed; the engine keeps serving
+        every other admitted request."""
+        self.stats["failed_requests"] += 1
+        self.cache.free_sequence(seq.rid)
+        self.slots[seq.slot] = None
+        finished.append(GenerationResult(
+            request_id=seq.rid, prompt_ids=seq.prompt,
+            output_ids=np.asarray(seq.out, np.int32),
+            finish_reason=finish_reason, error=reason))
+
+    def _expire_deadlines(self, finished: List[GenerationResult]) -> None:
+        """Fail requests whose TTL elapsed: waiting ones are dropped,
+        running ones evicted (their pages return to the pool)."""
+        now = self._now()
+        expired = [r for r in self.waiting
+                   if r.deadline is not None and now >= r.deadline]
+        for req in expired:
+            self.waiting.remove(req)
+            self.stats["deadline_expired"] += 1
+            self.stats["failed_requests"] += 1
+            finished.append(GenerationResult(
+                request_id=req.rid, prompt_ids=req.prompt,
+                output_ids=np.asarray(req.resume_out, np.int32),
+                finish_reason="deadline",
+                error="deadline exceeded by "
+                      f"{now - req.deadline:.3f}s while queued"))
+        for seq in [s for s in self.slots if s is not None]:
+            if seq.deadline is not None and now >= seq.deadline:
+                self.stats["deadline_expired"] += 1
+                self._fail_seq(seq, "deadline expired mid-generation",
+                               "deadline", finished)
+
+    def _safe_prefills(self, seqs: List[_Seq],
+                       finished: List[GenerationResult]):
+        """Batched prefill with poisoned-request isolation: if the
+        batch raises, each sequence is retried alone (same bucketed
+        executable — rows are padded to max_batch either way) and only
+        the one(s) that still raise are failed and evicted."""
+        try:
+            return list(zip(seqs, self._run_prefills(seqs)))
+        except Exception:
+            # see step(): a failure from the donated jit call itself
+            # leaves no caches to retry against — fatal, not poison
+            if any(getattr(k, "is_deleted", lambda: False)()
+                   for k in self.cache.key_caches):
+                raise
+            pairs = []
+            for s in seqs:
+                if self.slots[s.slot] is not s:  # preempted meanwhile
+                    continue
+                try:
+                    (first,) = self._run_prefills([s])
+                    pairs.append((s, first))
+                except Exception as e:
+                    self._fail_seq(
+                        s, f"prefill raised {type(e).__name__}: {e}",
+                        "error", finished)
+            return pairs
+
     # -- main loop ---------------------------------------------------------
     def step(self) -> List[GenerationResult]:
         """Admit + prefill new sequences, run one decode chunk, retire
-        finished sequences. Returns results finished this step."""
+        finished sequences. Returns results finished this step —
+        including failed/rejected/expired ones (check `.ok`)."""
         finished: List[GenerationResult] = []
+        if self._failed:                    # load-shed rejections
+            finished.extend(self._failed)
+            self._failed.clear()
+        faults.fault_point("engine.step")
+        self._expire_deadlines(finished)
         fresh = self._admit()
         if fresh:
-            firsts = self._run_prefills(fresh)
-            for seq, first in zip(fresh, firsts):
+            for seq, first in self._safe_prefills(fresh, finished):
                 seq.out.append(first)
                 self.stats["decode_tokens"] += 1
                 self._maybe_finish(seq, finished)
-        chunk_out = self._run_decode_chunk()
+        try:
+            chunk_out = self._run_decode_chunk()
+        except Exception:
+            # poisoned-request isolation: one request's failure must
+            # not take down the batch — rerun each sequence alone and
+            # evict only the ones that still fail. If NO sequence
+            # survives alone the failure is systemic (undersized pool,
+            # device OOM), not a poisoned request: re-raise so the
+            # operator sees one loud engine error, not N quiet
+            # per-request ones — unless shed_load says degrade anyway.
+            # A failure raised by the jitted call ITSELF is always
+            # fatal: donation has already consumed the cache buffers,
+            # so no retry can run against them — surface the real
+            # error instead of N 'Array has been deleted' ones.
+            if any(getattr(k, "is_deleted", lambda: False)()
+                   for k in self.cache.key_caches):
+                raise
+            chunk_out = {}
+            survivors = 0
+            casualties = []
+            for s in [s for s in self.slots if s is not None]:
+                if self.slots[s.slot] is not s:  # preempted meanwhile
+                    continue
+                try:
+                    chunk_out.update(self._run_decode_chunk(only=s))
+                    survivors += 1
+                except Exception as e:
+                    casualties.append((s, e))
+            if casualties and not survivors and not self.shed_load:
+                raise
+            for s, e in casualties:
+                self._fail_seq(
+                    s, f"decode raised {type(e).__name__}: {e}",
+                    "error", finished)
         for slot, toks in chunk_out.items():
             seq = self.slots[slot]
             if seq is None:

@@ -247,7 +247,7 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, max_worker_restarts=2):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
@@ -256,6 +256,9 @@ class DataLoader:
         self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        # self-healing: how many times EACH spawned worker may be
+        # respawned after dying without reporting (OOM kill, segfault)
+        self.max_worker_restarts = max(0, int(max_worker_restarts))
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -310,23 +313,70 @@ class DataLoader:
         cached = self.__dict__.get("_spawn_picklable_result")
         if cached is not None:      # probe once, not per epoch: pickling
             return cached           # a large in-memory dataset is not free
+
+        def fallback(detail):
+            warnings.warn(
+                f"DataLoader(num_workers={self.num_workers}): {detail} "
+                "— falling back to in-process thread workers (GIL-bound "
+                "for python transforms). Define the dataset and "
+                "collate_fn at module level (and return numpy, not "
+                "framework Tensors, from collate_fn) to enable process "
+                "workers.", UserWarning, stacklevel=4)
+            self._spawn_picklable_result = False
+            return False
+
         custom = (None if self.collate_fn is default_collate_fn
                   else self.collate_fn)
         try:
             pickle.dumps((self.dataset, custom, self.worker_init_fn))
-            self._spawn_picklable_result = True
-            return True
         except Exception as e:
-            warnings.warn(
-                f"DataLoader(num_workers={self.num_workers}): dataset/"
-                f"collate_fn is not picklable for spawned worker "
-                f"processes ({type(e).__name__}: {e}) — falling back to "
-                "in-process thread workers (GIL-bound for python "
-                "transforms). Define the dataset and collate_fn at "
-                "module level to enable process workers.",
-                UserWarning, stacklevel=3)
-            self._spawn_picklable_result = False
-            return False
+            return fallback(
+                "dataset/collate_fn is not picklable for spawned worker "
+                f"processes ({type(e).__name__}: {e})")
+        if custom is not None:
+            # the collate OUTPUT must survive the queue pickle too —
+            # framework Tensors (fine in the thread tier) define no
+            # pickle protocol, and that must demote to threads up
+            # front, not explode at runtime in a worker
+            from . import _process_worker as PW
+            sample_out = None
+            try:
+                # only draw the probe index from a sampler chain we
+                # KNOW re-iterates (our own classes over their own
+                # index sources) — anything user-supplied may be a
+                # one-shot iterable whose first batch must not be
+                # silently consumed by a probe
+                # (WeightedRandomSampler is excluded: its __iter__
+                # draws from the GLOBAL numpy RNG, so probing it would
+                # silently shift seeded runs relative to num_workers=0)
+                bs = self.batch_sampler
+                reiterable = isinstance(
+                    bs, DistributedBatchSampler) or (
+                    isinstance(bs, BatchSampler) and isinstance(
+                        getattr(bs, "sampler", None),
+                        (SequenceSampler, RandomSampler)))
+                first = next(iter(bs), None) if reiterable else None
+                if first:
+                    sample_out = custom([self.dataset[first[0]]])
+            except Exception:
+                pass    # dataset errors surface in the worker, with
+                        # a real traceback — not the probe's business
+            if sample_out is not None:
+                if PW._has_tensor_leaves(sample_out):
+                    return fallback(
+                        "collate_fn output contains framework Tensors, "
+                        "which the thread tier handles natively but a "
+                        "spawned worker would have to rebuild through "
+                        "its own jax runtime")
+                try:
+                    pickle.dumps(PW._strip_ndarrays(sample_out))
+                except Exception as e:
+                    return fallback(
+                        "collate_fn output is not picklable for the "
+                        "worker->parent queue "
+                        f"({type(e).__name__}: {e})")
+        self._spawn_picklable_result = True
+        return True
 
     def _iter_process_workers(self):
         """num_workers > 0 process tier: spawned workers (never fork —
@@ -335,10 +385,20 @@ class DataLoader:
         reassembles round-robin and materialises Tensors. One bounded
         queue per worker: deterministic order, per-worker backpressure,
         W * prefetch_factor batches of memory cap (same protocol as the
-        thread tier)."""
+        thread tier).
+
+        Self-healing: a worker that dies without reporting an error
+        (OOM kill, segfault) is respawned — bounded exponential-backoff
+        retries per worker — resuming at the first batch of its stripe
+        the parent still needs; stale re-produced batches are discarded
+        (their segments unlinked). On exit the parent joins workers
+        FIRST and only then drains, so in-flight SharedMemory payloads
+        are always unlinked — no /dev/shm leak on early consumer exit."""
         import multiprocessing as mp
-        import os
+        import time as _time
+        import warnings
         from . import _process_worker as PW
+        from ..resilience import faults
 
         idx_batches = list(self.batch_sampler)
         if not idx_batches:
@@ -350,19 +410,35 @@ class DataLoader:
         stop = ctx.Event()
         custom = (None if self.collate_fn is default_collate_fn
                   else self.collate_fn)
-        procs = [ctx.Process(
-            target=PW.worker_main,
-            args=(w, W, self.dataset, idx_batches, custom, queues[w],
-                  self.worker_init_fn, stop),
-            daemon=True) for w in range(W)]
-        # children force JAX_PLATFORMS=cpu as worker_main's FIRST action
-        # — before any computation can lazily init a backend — so a
-        # spawned worker can never contend for the parent's TPU. (The
-        # parent's env is deliberately NOT mutated here: a temporary
+        # re-pickled EVERY epoch (only the picklability verdict is
+        # cached): a dataset mutated between epochs (curriculum state,
+        # swapped transform) must reach the workers, exactly as it does
+        # in the num_workers=0 and thread tiers. One dumps() per epoch,
+        # shared by all workers and respawns — the child unpickles it
+        # only after its env guard (see _process_worker).
+        import pickle
+        payload_bytes = pickle.dumps(
+            (self.dataset, custom, self.worker_init_fn))
+        # io.* faults cross the spawn boundary via snapshot/install
+        specs = faults.snapshot()
+
+        # children force JAX_PLATFORMS=cpu as worker_main's FIRST
+        # action, BEFORE the dataset bytes are unpickled — so a spawned
+        # worker can never contend for the parent's TPU. (The parent's
+        # env is deliberately NOT mutated here: a temporary
         # process-wide JAX_PLATFORMS=cpu would race any concurrent
         # first-time jax init in the parent and silently pin it to CPU.)
-        for p in procs:
+        def spawn(w, resume_from=0, attempt=0):
+            p = ctx.Process(
+                target=PW.worker_main,
+                args=(w, W, payload_bytes, idx_batches, queues[w], stop,
+                      resume_from, specs, attempt),
+                daemon=True)
             p.start()
+            return p
+
+        procs = [spawn(w) for w in range(W)]
+        restarts = [0] * W
 
         import queue as _q
 
@@ -381,45 +457,94 @@ class DataLoader:
                     else self.timeout)
         try:
             for bi in range(len(idx_batches)):
-                q = queues[bi % W]
+                w = bi % W
+                q = queues[w]
                 waited = 0.0
                 while True:
                     try:
                         kind, tag, payload = q.get(timeout=0.5)
-                        break
                     except _q.Empty:
                         waited += 0.5
-                        if not procs[bi % W].is_alive():
-                            raise RuntimeError(
-                                f"DataLoader worker {bi % W} died "
-                                "without reporting an error (OOM-killed"
-                                "?)") from None
+                        if not procs[w].is_alive():
+                            if restarts[w] >= self.max_worker_restarts:
+                                raise RuntimeError(
+                                    f"DataLoader worker {w} died "
+                                    "without reporting an error (OOM-"
+                                    f"killed?) and exhausted its "
+                                    f"{self.max_worker_restarts} "
+                                    "restarts") from None
+                            restarts[w] += 1
+                            backoff = min(
+                                0.05 * (1 << (restarts[w] - 1)), 2.0)
+                            warnings.warn(
+                                f"DataLoader worker {w} died without "
+                                f"reporting an error — respawning at "
+                                f"batch {bi} (restart {restarts[w]}/"
+                                f"{self.max_worker_restarts})",
+                                UserWarning)
+                            _time.sleep(backoff)
+                            # a hard kill can land mid-pipe-write,
+                            # leaving the queue's SHARED write-lock
+                            # held by the corpse — any successor
+                            # putting into the same queue would block
+                            # forever. Drain what did arrive, then
+                            # hand the replacement a fresh queue.
+                            while True:
+                                try:
+                                    kind, _, payload = q.get_nowait()
+                                except Exception:
+                                    break
+                                if kind == "batch":
+                                    PW.discard(payload)
+                            queues[w] = ctx.Queue(
+                                maxsize=self.prefetch_factor)
+                            q = queues[w]
+                            procs[w] = spawn(w, resume_from=bi,
+                                             attempt=restarts[w])
+                            # re-arm the batch deadline: the respawned
+                            # worker re-loads the batch from scratch,
+                            # and that recompute must not be billed
+                            # against the previous incarnation's clock
+                            waited = 0.0
                         if deadline and waited >= deadline:
                             raise TimeoutError(
-                                f"DataLoader worker {bi % W} produced "
+                                f"DataLoader worker {w} produced "
                                 f"no batch within timeout={deadline}s")
-                if kind == "error":
-                    raise RuntimeError(
-                        f"DataLoader worker {tag} failed:\n{payload}")
-                assert kind == "batch" and tag == bi, (kind, tag, bi)
+                        continue
+                    if kind == "error":
+                        raise RuntimeError(
+                            f"DataLoader worker {tag} failed:\n{payload}")
+                    if kind == "done":
+                        continue    # dead worker's farewell; keep waiting
+                    assert kind == "batch", (kind, tag, bi)
+                    if tag < bi:    # stale duplicate after a restart
+                        PW.discard(payload)
+                        continue
+                    assert tag == bi, (tag, bi)
+                    break
                 batch = PW.unpack(payload)
                 yield batch if custom is not None else wrap(batch)
         finally:
             stop.set()
-            # drain so orphaned SharedMemory segments get unlinked
+            # join FIRST: workers observe stop within ~0.2s, self-unlink
+            # unplaced payloads, and flush their queue feeders on exit —
+            # after the join no new batch can arrive behind the drain
+            # (the single get_nowait sweep here used to race exactly
+            # that, leaking /dev/shm segments on early consumer exit)
+            for p in procs:
+                p.join(timeout=5.0)
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+                    p.join(timeout=1.0)
             for q in queues:
                 while True:
                     try:
                         kind, _, payload = q.get_nowait()
-                        if kind == "batch":
-                            PW.unpack(payload)
                     except Exception:
                         break
-            for p in procs:
-                p.join(timeout=2.0)
-            for p in procs:
-                if p.is_alive():
-                    p.terminate()
+                    if kind == "batch":
+                        PW.discard(payload)
 
     def _iter_buffered(self):
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)

@@ -5,12 +5,32 @@ pipelines).
 
 Design: spawned processes (never fork — the parent owns a live TPU
 client; fork would duplicate its state) + SharedMemory array transport.
-Workers are compute-only: they force JAX_PLATFORMS=cpu before any
-import so a spawned child can never grab the parent's TPU, and the
-default collate produces NUMPY batches — Tensors are materialised by
-the parent. Large arrays travel via multiprocessing.shared_memory (one
-copy into the segment, one copy out in the parent — no pickle of the
-payload bytes); small leaves ride the queue pickle."""
+Workers are compute-only: the dataset/collate/init objects cross the
+spawn boundary as an opaque pickle BYTES blob, so `worker_main` can
+force JAX_PLATFORMS=cpu before those bytes are unpickled — no import-
+or unpickle-time computation in the dataset's module chain can
+initialize a backend and contend for the parent's TPU. (Shipping the
+objects as plain Process args would not guarantee that: with the spawn
+start method the child unpickles its args in `spawn_main`, BEFORE the
+target function runs.) The default collate produces NUMPY batches —
+Tensors are materialised by the parent. Large arrays travel via
+multiprocessing.shared_memory (one copy into the segment, one copy out
+in the parent — no pickle of the payload bytes); small leaves ride the
+queue pickle.
+
+Self-healing contract (resilience layer): a worker that dies without
+reporting (OOM kill, segfault, chaos `io.worker.batch` fault) is
+detected by the parent's queue-wait loop and respawned with
+`resume_from` pointing at the first batch the parent still needs; on
+every SOFT exit path — orderly stop, early consumer exit, error —
+SharedMemory payloads that never reached the parent are unlinked
+(worker-side for unplaced ones, parent-side `discard()` after join for
+in-flight ones), so /dev/shm does not leak. Known residual window: a
+HARD kill landing strictly between segment creation in `_pack` and the
+payload reaching the parent's queue can leak that one batch's segments
+— only the dead worker knew their names (they are deliberately
+unregistered from the resource tracker so ownership can pass to the
+consumer)."""
 from __future__ import annotations
 
 import os
@@ -72,6 +92,64 @@ def _pack(obj, segments):
     return obj
 
 
+def _has_tensor_leaves(obj) -> bool:
+    """True if a collate output contains framework Tensors (duck-typed
+    `_data` + `numpy`, keeping this module importable without
+    paddle_tpu/jax). The parent's probe demotes such loaders to the
+    thread tier: the thread tier handles Tensors natively, while a
+    spawned worker would have to materialise them through its own
+    full jax runtime just to re-serialise them."""
+    if hasattr(obj, "_data") and hasattr(obj, "numpy"):
+        return True
+    if isinstance(obj, (list, tuple)):
+        return any(_has_tensor_leaves(x) for x in obj)
+    if isinstance(obj, dict):
+        return any(_has_tensor_leaves(v) for v in obj.values())
+    return False
+
+
+def _strip_ndarrays(obj):
+    """Replace ndarray leaves with None — what's left is what a batch
+    payload would pickle onto the queue (ndarrays either ride a
+    SharedMemory segment or pickle trivially). Used by the parent's
+    collate-output picklability probe."""
+    if isinstance(obj, np.ndarray):
+        return None
+    if isinstance(obj, list):
+        return [_strip_ndarrays(x) for x in obj]
+    if isinstance(obj, tuple):
+        return tuple(_strip_ndarrays(x) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _strip_ndarrays(v) for k, v in obj.items()}
+    return obj
+
+
+def discard(obj):
+    """Unlink every SharedMemory segment a packed payload references
+    WITHOUT copying it out — the parent's cleanup path for batches
+    nobody will consume."""
+    from multiprocessing import shared_memory
+    if isinstance(obj, tuple) and obj[:1] == ("__shm__",):
+        try:
+            seg = shared_memory.SharedMemory(name=obj[1])
+        except FileNotFoundError:
+            return
+        seg.close()
+        try:
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+    elif isinstance(obj, list):
+        for x in obj:
+            discard(x)
+    elif isinstance(obj, tuple):
+        for x in obj:
+            discard(x)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            discard(v)
+
+
 def unpack(obj):
     """Parent-side inverse of _pack: attach, copy out, release."""
     from multiprocessing import shared_memory
@@ -97,30 +175,62 @@ def unpack(obj):
     return obj
 
 
-def worker_main(wid, num_workers, dataset, idx_batches, collate_fn,
-                out_queue, worker_init_fn, stop_event):
+def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
+                stop_event, resume_from=0, fault_specs=None, attempt=0):
     """Entry point of a spawned worker process. Round-robin ownership:
     worker w produces batches w, w+W, w+2W, ... in order into its own
     bounded queue (deterministic reassembly, per-worker backpressure —
-    same protocol as the in-process thread tier)."""
+    same protocol as the in-process thread tier).
+
+    payload_bytes: pickle of (dataset, collate_fn_or_None,
+    worker_init_fn_or_None) — deserialized HERE, after the env guard.
+    resume_from: first batch index the parent still needs; a worker
+    respawned to replace a dead one skips its stripe's earlier batches.
+    fault_specs: a faults.snapshot() from the parent, re-armed in this
+    process so `io.*` fault points work across the spawn boundary.
+    attempt: this worker slot's incarnation number (0 = original spawn)
+    — exposed in the fault context so a chaos kill can target only the
+    first life (match={"bi": 2, "attempt": 0}) and let the respawn
+    survive."""
+    import pickle
     import queue as _q
-    # a spawned child must never touch the parent's TPU
+    # a spawned child must never touch the parent's TPU: the env guard
+    # runs BEFORE any user code (dataset unpickle / init fn) executes
     os.environ["JAX_PLATFORMS"] = "cpu"
-    global _WORKER_INFO
-    import types
-    _WORKER_INFO = types.SimpleNamespace(
-        id=wid, num_workers=num_workers, dataset=dataset)
     try:
+        dataset, collate_fn, worker_init_fn = pickle.loads(payload_bytes)
+        from ..resilience import faults
+        faults.install(fault_specs)
+        global _WORKER_INFO
+        import types
+        _WORKER_INFO = types.SimpleNamespace(
+            id=wid, num_workers=num_workers, dataset=dataset)
         if worker_init_fn is not None:
             worker_init_fn(wid)
         collate = collate_fn if collate_fn is not None else np_collate
         for bi in range(wid, len(idx_batches), num_workers):
+            if bi < resume_from:
+                continue        # the parent already consumed this one
             if stop_event.is_set():
                 return
+            faults.fault_point("io.worker.batch", wid=wid, bi=bi,
+                               attempt=attempt)
             samples = [dataset[i] for i in idx_batches[bi]]
             batch = collate(samples)
             segments = []
-            payload = _pack(batch, segments)
+            try:
+                payload = _pack(batch, segments)
+            except BaseException:
+                # mid-pack failure (e.g. ENOSPC on /dev/shm): the
+                # segments created so far are unregistered from the
+                # tracker, so WE must unlink them or they outlive us
+                for seg in segments:
+                    seg.close()
+                    try:
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+                raise
             placed = False
             while not stop_event.is_set():
                 try:
@@ -132,14 +242,17 @@ def worker_main(wid, num_workers, dataset, idx_batches, collate_fn,
             for seg in segments:
                 seg.close()
             if not placed:      # consumer went away: free the payload
-                for seg in segments:
-                    try:
-                        from multiprocessing import shared_memory
-                        shared_memory.SharedMemory(name=seg.name).unlink()
-                    except FileNotFoundError:
-                        pass
+                discard(payload)
                 return
-        out_queue.put(("done", wid, None))
+        # same stop-aware put as batches: an unbounded put here would
+        # block against a full queue after early consumer exit and
+        # stall the parent's join-then-drain teardown
+        while not stop_event.is_set():
+            try:
+                out_queue.put(("done", wid, None), timeout=0.2)
+                break
+            except _q.Full:
+                continue
     except BaseException:
         try:
             out_queue.put(("error", wid, traceback.format_exc()),

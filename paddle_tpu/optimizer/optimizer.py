@@ -110,6 +110,17 @@ class Optimizer:
             "lr_scale": group.get("learning_rate", 1.0),
         }
 
+    def _hyper_fingerprint(self) -> tuple:
+        """Instance-level hyperparameters `_update_rule` reads off
+        `self` (beta1, epsilon, rho, ...). They get baked into the
+        fused-step executable as constants, so they MUST be part of its
+        cache key — otherwise mutating them mid-training is silently
+        ignored on the fused path while the eager path honors it.
+        Override alongside `_update_rule`."""
+        wd = getattr(self.weight_decay, "_coeff", self.weight_decay)
+        return (wd if isinstance(wd, (int, float, type(None)))
+                else repr(wd),)
+
     # -- public API --
     @no_grad()
     def step(self):
@@ -196,7 +207,11 @@ class Optimizer:
             except TypeError:
                 return repr(items)
 
-        key = tuple(
+        # instance-level hypers (self.beta1/epsilon/rho/...) are traced
+        # into the executable as constants exactly like group hypers —
+        # fingerprint them so mid-training mutation recompiles instead
+        # of being silently ignored on the fused path
+        key = (self._hyper_fingerprint(),) + tuple(
             (w.shape, str(w.dtype), str(g.dtype),
              tuple(sorted((k, v.shape, str(v.dtype))
                           for k, v in s.items())),

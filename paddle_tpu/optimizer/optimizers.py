@@ -29,6 +29,10 @@ class Momentum(Optimizer):
         self.momentum = momentum
         self.use_nesterov = use_nesterov
 
+    def _hyper_fingerprint(self):
+        return super()._hyper_fingerprint() + (self.momentum,
+                                               self.use_nesterov)
+
     def _state_names(self):
         return ["velocity"]
 
@@ -66,6 +70,10 @@ class Adam(Optimizer):
         # paddle/phi/kernels/gpu/adamw_kernel.cu's MP path, inverted for
         # TPU where params stay f32 and moments shrink)
         self.moment_dtype = moment_dtype
+
+    def _hyper_fingerprint(self):
+        return super()._hyper_fingerprint() + (self.beta1, self.beta2,
+                                               self.epsilon)
 
     def _state_names(self):
         return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
@@ -160,6 +168,10 @@ class Adamax(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
+    def _hyper_fingerprint(self):
+        return super()._hyper_fingerprint() + (self.beta1, self.beta2,
+                                               self.epsilon)
+
     def _state_names(self):
         return ["moment", "inf_norm", "beta1_pow"]
 
@@ -185,6 +197,9 @@ class Adagrad(Optimizer):
         self.epsilon = epsilon
         self.initial_accumulator_value = initial_accumulator_value
 
+    def _hyper_fingerprint(self):
+        return super()._hyper_fingerprint() + (self.epsilon,)
+
     def _state_names(self):
         return ["moment"]
 
@@ -206,6 +221,11 @@ class RMSProp(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self.rho, self.epsilon = rho, epsilon
         self.momentum, self.centered = momentum, centered
+
+    def _hyper_fingerprint(self):
+        return super()._hyper_fingerprint() + (self.rho, self.epsilon,
+                                               self.momentum,
+                                               self.centered)
 
     def _state_names(self):
         return ["mean_square", "mean_grad", "momentum_acc"]
@@ -243,6 +263,11 @@ class Lamb(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.exclude_fn = exclude_from_weight_decay_fn
 
+    def _hyper_fingerprint(self):
+        return super()._hyper_fingerprint() + (self.lamb_weight_decay,
+                                               self.beta1, self.beta2,
+                                               self.epsilon)
+
     def _state_names(self):
         return ["moment1", "moment2", "beta1_pow", "beta2_pow"]
 
@@ -278,6 +303,9 @@ class Adadelta(Optimizer):
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self.epsilon, self.rho = epsilon, rho
+
+    def _hyper_fingerprint(self):
+        return super()._hyper_fingerprint() + (self.epsilon, self.rho)
 
     def _state_names(self):
         return ["avg_squared_grad", "avg_squared_update"]

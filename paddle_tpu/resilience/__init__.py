@@ -1,0 +1,32 @@
+"""paddle_tpu.resilience — fault tolerance layer + chaos-test harness.
+
+What lives here vs. where the behaviors are implemented:
+
+  * `faults` (this package) — the deterministic fault-injection
+    registry every resilience path is tested through.
+  * LLMEngine hardening (deadlines, poisoned-request isolation,
+    load-shedding admission) — `inference/llm_engine.py`, instrumented
+    with `engine.*` fault points.
+  * Crash-safe checkpoints (atomic tmp+fsync+rename, checksum
+    manifest, torn-checkpoint skip) — `distributed/checkpoint` and
+    `framework_io`, instrumented with `checkpoint.*` /
+    `framework_io.*` fault points. `resume_latest` re-exported here.
+  * Self-healing DataLoader (dead-worker restart, guaranteed
+    SharedMemory unlink) — `io/`, instrumented with `io.*` points.
+
+See README "Fault tolerance & chaos testing" and
+tests/test_resilience.py for the contract each path guarantees."""
+from . import faults  # noqa: F401
+from .faults import fault_point, inject  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: distributed.checkpoint pulls in jax; keep `import
+    # paddle_tpu.resilience.faults` light for spawned workers
+    if name in ("resume_latest", "is_complete", "verify_checkpoint"):
+        from ..distributed import checkpoint as _ckpt
+        val = getattr(_ckpt, name)
+        globals()[name] = val
+        return val
+    raise AttributeError(
+        f"module 'paddle_tpu.resilience' has no attribute {name!r}")

@@ -1,0 +1,207 @@
+"""Deterministic fault injection for chaos testing.
+
+Reference: the reference framework's fault tolerance stack exercises
+elastic restarts end-to-end (distributed/fleet/elastic — SURVEY §L6)
+but offers no way to *provoke* the faults it claims to survive; every
+resilience path here is instead wired through named **fault points**
+so tests (and operators) can inject failures deterministically:
+
+    from paddle_tpu.resilience import faults
+
+    with faults.inject("engine.decode.seq", exc=MemoryError("chaos"),
+                       match={"rid": "bad"}):
+        engine.generate(...)     # request "bad" fails, others finish
+
+A fault point is a single call at an instrumented site::
+
+    faults.fault_point("checkpoint.before_rename", path=tmp)
+
+and costs one truthiness check on a module-level dict when nothing is
+injected — cheap enough to leave in production paths.
+
+Registered fault points (grep `fault_point(` for ground truth):
+
+    engine.prefill.seq        per-sequence, before the prefill executable
+                              (ctx: rid)
+    engine.decode.seq         per-sequence, before the decode executable
+                              (ctx: rid)
+    engine.step               once per LLMEngine.step() (ctx: none)
+    checkpoint.before_meta    after shard files, before metadata.json
+                              (ctx: path)
+    checkpoint.before_rename  after the tmp dir/file is complete, before
+                              the atomic rename (ctx: path)
+    checkpoint.between_renames  overwrite-save only: after the previous
+                              checkpoint moved aside, before the new one
+                              lands (ctx: path)
+    framework_io.before_rename  paddle_tpu.save, between tmp write and
+                              rename (ctx: path)
+    io.worker.batch           in a spawned DataLoader worker, before
+                              producing a batch (ctx: wid, bi)
+
+Injection specs support:
+
+    exc=...         exception instance or class to raise
+    delay=...       seconds to sleep before continuing (composable with
+                    exc: sleep then raise)
+    exit_code=N     call os._exit(N) — simulates a hard crash /
+                    SIGKILL'd process (no exception propagates, no
+                    cleanup runs). Used to chaos-test dead-worker
+                    detection and torn checkpoints.
+    times=N         fire at most N times (None = every hit)
+    match={k: v}    fire only when the fault point's context kwargs
+                    contain all given key/values (picklable — crosses
+                    the spawn boundary into DataLoader workers)
+    when=callable   fire only when `when(ctx_dict)` is truthy (not
+                    picklable; in-process use only)
+
+`inject` doubles as a context manager that removes the spec on exit;
+called plainly it stays active until `clear(name)` / `clear_all()`.
+Spawned DataLoader workers receive a `snapshot()` of the picklable
+specs and `install()` it after their env guard, so `io.*` faults
+reach child processes."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["inject", "clear", "clear_all", "fault_point", "fired",
+           "snapshot", "install", "FaultSpec"]
+
+
+# reentrant: fault_point() evaluates user `when=` predicates under the
+# lock, and a predicate may legitimately call back into this module
+# (e.g. when=lambda ctx: faults.fired("other.point") > 0)
+_LOCK = threading.RLock()
+# name -> FaultSpec; module-level dict so fault_point's disarmed path is
+# one truthiness check
+_ACTIVE: Dict[str, "FaultSpec"] = {}
+_FIRED: Dict[str, int] = {}
+
+
+class FaultSpec:
+    """One armed fault. Attribute bag + remaining-fire accounting."""
+
+    __slots__ = ("name", "exc", "delay", "exit_code", "times", "match",
+                 "when")
+
+    def __init__(self, name, exc=None, delay=None, exit_code=None,
+                 times=None, match=None, when=None):
+        if exc is None and delay is None and exit_code is None:
+            raise ValueError(
+                f"fault {name!r}: give at least one of exc=, delay=, "
+                "exit_code=")
+        self.name = name
+        self.exc = exc
+        self.delay = delay
+        self.exit_code = exit_code
+        self.times = times
+        self.match = dict(match) if match else None
+        self.when = when
+
+    def _matches(self, ctx: dict) -> bool:
+        if self.match is not None:
+            for k, v in self.match.items():
+                if ctx.get(k) != v:
+                    return False
+        if self.when is not None and not self.when(ctx):
+            return False
+        return True
+
+    def _picklable(self) -> bool:
+        # `when` callables don't cross the spawn boundary; exceptions
+        # and match dicts do
+        return self.when is None
+
+    def __getstate__(self):
+        return {s: getattr(self, s) for s in self.__slots__}
+
+    def __setstate__(self, state):
+        for s in self.__slots__:
+            setattr(self, s, state.get(s))
+
+
+class _Injection:
+    """Handle returned by inject(): context manager + .remove()."""
+
+    def __init__(self, name):
+        self._name = name
+
+    def remove(self):
+        clear(self._name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.remove()
+        return False
+
+
+def inject(name: str, exc=None, delay: Optional[float] = None,
+           exit_code: Optional[int] = None, times: Optional[int] = None,
+           match: Optional[dict] = None, when=None) -> _Injection:
+    """Arm fault point `name`. See module docstring for the spec
+    semantics. Returns a handle usable as a context manager."""
+    spec = FaultSpec(name, exc=exc, delay=delay, exit_code=exit_code,
+                     times=times, match=match, when=when)
+    with _LOCK:
+        _ACTIVE[name] = spec
+    return _Injection(name)
+
+
+def clear(name: str) -> None:
+    with _LOCK:
+        _ACTIVE.pop(name, None)
+
+
+def clear_all() -> None:
+    with _LOCK:
+        _ACTIVE.clear()
+        _FIRED.clear()
+
+
+def fired(name: str) -> int:
+    """How many times fault `name` has fired in this process."""
+    with _LOCK:
+        return _FIRED.get(name, 0)
+
+
+def fault_point(name: str, **ctx) -> None:
+    """Instrumented-site hook. No-op (one dict truthiness check) unless
+    a matching fault is armed."""
+    if not _ACTIVE:
+        return
+    with _LOCK:
+        spec = _ACTIVE.get(name)
+        if spec is None or not spec._matches(ctx):
+            return
+        if spec.times is not None:
+            spec.times -= 1
+            if spec.times <= 0:
+                _ACTIVE.pop(name, None)
+        _FIRED[name] = _FIRED.get(name, 0) + 1
+    if spec.delay:
+        time.sleep(spec.delay)
+    if spec.exit_code is not None:
+        import os
+        os._exit(spec.exit_code)
+    if spec.exc is not None:
+        exc = spec.exc() if isinstance(spec.exc, type) else spec.exc
+        raise exc
+
+
+def snapshot() -> list:
+    """Picklable list of the currently armed specs — ship this across
+    a spawn boundary and `install()` it in the child."""
+    with _LOCK:
+        return [s for s in _ACTIVE.values() if s._picklable()]
+
+
+def install(specs) -> None:
+    """Arm a snapshot()'d spec list in this (child) process."""
+    if not specs:
+        return
+    with _LOCK:
+        for s in specs:
+            _ACTIVE[s.name] = s
