@@ -609,17 +609,30 @@ CONFIGS = {
 
 def main():
     import jax
+    from paddle_tpu import observability as obs
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", choices=sorted(CONFIGS), default="gpt2s")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
+    ap.add_argument("--no-obs", action="store_true",
+                    help="skip the observability snapshot in the output")
     args = ap.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
     names = list(CONFIGS) if args.all else [args.config]
     for name in names:
-        print(json.dumps(CONFIGS[name](on_tpu)), flush=True)
+        if not args.no_obs:
+            # per-config window so each BENCH line carries ITS series
+            # (step-latency histogram summary, preemption / fused-step
+            # recompile counters — see observability.summary())
+            obs.enable()
+            obs.reset()
+        result = CONFIGS[name](on_tpu)
+        if not args.no_obs:
+            result["obs"] = obs.summary()
+            obs.disable()
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -37,14 +37,45 @@ import os
 import shutil
 from typing import Dict, List, Optional, Tuple
 
+import time
+
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...observability import metrics as _om
+from ...observability import tracing as _ot
 from ...resilience import faults
 
 _META = "metadata.json"
 _MANIFEST = "__manifest__"      # reserved key inside metadata.json
+
+_METRICS = None
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        r = _om.registry()
+        _METRICS = {
+            "save": r.histogram(
+                "paddle_tpu_checkpoint_save_seconds",
+                "save_state_dict wall time (stage + fsync + rename)"),
+            "restore": r.histogram(
+                "paddle_tpu_checkpoint_restore_seconds",
+                "load_state_dict wall time (assemble + reshard + "
+                "device_put)"),
+            "bytes": r.counter(
+                "paddle_tpu_checkpoint_shard_bytes_total",
+                "shard-file bytes written (op=save) / referenced by a "
+                "restore's manifest (op=restore)", ("op",)),
+            "torn": r.counter(
+                "paddle_tpu_checkpoint_torn_total",
+                "torn/corrupted checkpoints resume_latest skipped "
+                "(action=skipped) or quarantined away "
+                "(action=quarantined)", ("action",)),
+        }
+    return _METRICS
 
 
 def _sha256(path: str) -> str:
@@ -87,8 +118,11 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0) -> None:
     """ref: save_state_dict.py:77"""
     from ...utils.watchdog import watchdog
-    with watchdog(what=f"checkpoint save to {path}"):
-        _save_state_dict(state_dict, path)
+    t0 = time.perf_counter()
+    with _ot.span("checkpoint.save", path=path):
+        with watchdog(what=f"checkpoint save to {path}"):
+            _save_state_dict(state_dict, path)
+    _metrics()["save"].observe(time.perf_counter() - t0)
 
 
 class _HashingWriter:
@@ -186,6 +220,9 @@ def _stage_and_swap(state_dict: Dict, path: str, parent: str,
                  "shape": list(np.shape(arr))})
         meta[name] = entry
     faults.fault_point("checkpoint.before_meta", path=path)
+    if _om._ENABLED and manifest:
+        _metrics()["bytes"].labels(op="save").inc(
+            sum(rec["bytes"] for rec in manifest.values()))
     # metadata.json written LAST and itself atomically: its presence is
     # the completeness marker, its manifest the integrity record
     meta[_MANIFEST] = {"version": 1, "files": manifest}
@@ -264,13 +301,20 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     the metadata schema there is tied to its Program/DistTensor
     serialization."""
     from ...utils.watchdog import watchdog
-    with watchdog(what=f"checkpoint load from {path}"):
-        _load_state_dict(state_dict, path)
+    t0 = time.perf_counter()
+    with _ot.span("checkpoint.restore", path=path):
+        with watchdog(what=f"checkpoint load from {path}"):
+            _load_state_dict(state_dict, path)
+    _metrics()["restore"].observe(time.perf_counter() - t0)
 
 
 def _load_state_dict(state_dict: Dict, path: str) -> None:
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
+    if _om._ENABLED:
+        files = meta.get(_MANIFEST, {}).get("files") or {}
+        _metrics()["bytes"].labels(op="restore").inc(
+            sum(rec["bytes"] for rec in files.values() if rec))
     for name, t in list(state_dict.items()):
         if name not in meta:
             continue
@@ -430,9 +474,11 @@ def resume_latest(state_dict: Dict, root: str, verify: bool = True,
         warnings.warn(
             f"resume_latest: skipping torn checkpoint {p}: "
             + "; ".join(problems), UserWarning, stacklevel=2)
+        _metrics()["torn"].labels(action="skipped").inc()
         if cleanup:
             quarantine = os.path.join(
                 os.path.dirname(p), f".{os.path.basename(p)}.torn")
             shutil.rmtree(quarantine, ignore_errors=True)
             os.replace(p, quarantine)
+            _metrics()["torn"].labels(action="quarantined").inc()
     return None

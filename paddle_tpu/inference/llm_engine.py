@@ -44,10 +44,70 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _om
+from ..observability import tracing as _ot
 from ..resilience import faults
 from .paged_cache import PagedKVCache
 
 __all__ = ["LLMEngine", "GenerationResult"]
+
+
+# ---------------------------------------------------------------------------
+# observability (process-global series; per-engine exact counts live on
+# engine.stats). Handles are created once and cached — the disabled
+# path through any of them is a single module-flag check.
+# ---------------------------------------------------------------------------
+_METRICS = None
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        r = _om.registry()
+        _METRICS = {
+            "step": r.histogram(
+                "paddle_tpu_engine_step_seconds",
+                "LLMEngine.step() wall time (admission + prefills + one "
+                "decode chunk + retirement)"),
+            "prefill": r.histogram(
+                "paddle_tpu_engine_prefill_seconds",
+                "one batched prefill executable call incl. host prep"),
+            "decode": r.histogram(
+                "paddle_tpu_engine_decode_chunk_seconds",
+                "one decode-chunk executable call incl. host prep"),
+            "queue": r.gauge(
+                "paddle_tpu_engine_queue_depth",
+                "requests per scheduler queue after a step",
+                ("queue",)),
+            "pool": r.gauge(
+                "paddle_tpu_engine_page_pool_blocks",
+                "paged KV cache pool occupancy after a step",
+                ("state",)),
+            "events": r.counter(
+                "paddle_tpu_engine_events_total",
+                "engine.stats counters (preemptions, prefills, "
+                "decode_chunks, decode_tokens, failed/rejected "
+                "requests, deadline_expired) aggregated across engines",
+                ("event",)),
+        }
+    return _METRICS
+
+
+class _EngineStats(dict):
+    """The ad-hoc stats dict, migrated onto the registry while staying
+    a real dict: every increment site (`stats[k] += n`) keeps its exact
+    per-engine semantics (tests and bench read those), and the write
+    mirrors the delta onto the process-global
+    `paddle_tpu_engine_events_total{event=k}` counter. Mirroring is a
+    no-op while observability is disabled — per-engine counts keep
+    working regardless."""
+
+    def __setitem__(self, key, value):
+        if _om._ENABLED:
+            delta = value - self.get(key, 0)
+            if delta > 0:
+                _metrics()["events"].labels(event=key).inc(delta)
+        super().__setitem__(key, value)
 
 
 @dataclasses.dataclass
@@ -390,9 +450,12 @@ class LLMEngine:
         self.step_timeout_s = step_timeout_s
         self._failed: List[GenerationResult] = []   # drained by step()
         self._now = time.monotonic                  # stubbable clock
-        self.stats = {"preemptions": 0, "prefills": 0, "decode_chunks": 0,
-                      "decode_tokens": 0, "failed_requests": 0,
-                      "rejected_requests": 0, "deadline_expired": 0}
+        # backward-compatible per-engine view; writes mirror onto the
+        # observability registry (see _EngineStats)
+        self.stats = _EngineStats(
+            preemptions=0, prefills=0, decode_chunks=0,
+            decode_tokens=0, failed_requests=0, rejected_requests=0,
+            deadline_expired=0)
 
     # -- request lifecycle -------------------------------------------------
     def _reject(self, request_id, prompt, reason: str, exc_type=None):
@@ -513,6 +576,13 @@ class LLMEngine:
         and to max_batch (empty rows write nothing), so the model's
         weights stream ONCE per admission wave instead of once per
         sequence. Returns each sequence's first sampled token."""
+        t0 = time.perf_counter()
+        with _ot.span("engine.prefill", seqs=len(seqs)):
+            out = self._run_prefills_impl(seqs)
+        _metrics()["prefill"].observe(time.perf_counter() - t0)
+        return out
+
+    def _run_prefills_impl(self, seqs: List[_Seq]) -> List[int]:
         self.stats["prefills"] += len(seqs)
         for s in seqs:
             faults.fault_point("engine.prefill.seq", rid=s.rid)
@@ -805,6 +875,15 @@ class LLMEngine:
         `only`, with every other row rendered inactive — the
         poisoned-request isolation retry). Returns {slot: np tokens
         [chunk]}."""
+        t0 = time.perf_counter()
+        with _ot.span("engine.decode_chunk"):
+            out = self._run_decode_chunk_impl(only)
+        if out:     # skip empty calls (no active slots)
+            _metrics()["decode"].observe(time.perf_counter() - t0)
+        return out
+
+    def _run_decode_chunk_impl(self, only: Optional[_Seq] = None
+                               ) -> Dict[int, np.ndarray]:
         active = [s for s in self.slots
                   if s is not None and (only is None or s is only)]
         if not active:
@@ -951,6 +1030,22 @@ class LLMEngine:
         """Admit + prefill new sequences, run one decode chunk, retire
         finished sequences. Returns results finished this step —
         including failed/rejected/expired ones (check `.ok`)."""
+        t0 = time.perf_counter()
+        with _ot.span("engine.step"):
+            finished = self._step_impl()
+        if _om._ENABLED:
+            m = _metrics()
+            m["step"].observe(time.perf_counter() - t0)
+            m["queue"].labels(queue="waiting").set(len(self.waiting))
+            m["queue"].labels(queue="running").set(
+                sum(s is not None for s in self.slots))
+            free = self.cache.allocator.num_free
+            m["pool"].labels(state="free").set(free)
+            m["pool"].labels(state="used").set(
+                self.cache.allocator.num_blocks - free)
+        return finished
+
+    def _step_impl(self) -> List[GenerationResult]:
         finished: List[GenerationResult] = []
         if self._failed:                    # load-shed rejections
             finished.extend(self._failed)

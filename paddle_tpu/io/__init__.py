@@ -11,12 +11,42 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, List, Optional
 
 import numpy as np
 
 from ..core.tensor import Tensor
 from ..core.generator import default_generator
+from ..observability import metrics as _om
+
+# process-global DataLoader metrics (handles cached: the disabled path
+# through any of them is one module-flag check inside inc/observe)
+_IO_METRICS = None
+
+
+def _io_metrics():
+    global _IO_METRICS
+    if _IO_METRICS is None:
+        r = _om.registry()
+        _IO_METRICS = {
+            "wait": r.histogram(
+                "paddle_tpu_dataloader_batch_wait_seconds",
+                "consumer-side wait for the next batch (all tiers)"),
+            "restarts": r.counter(
+                "paddle_tpu_dataloader_worker_restarts_total",
+                "spawned workers respawned after dying without "
+                "reporting (OOM kill, segfault)"),
+            "shm_bytes": r.counter(
+                "paddle_tpu_dataloader_shm_bytes_total",
+                "bytes transported worker->parent via SharedMemory "
+                "segments"),
+            "shm_inflight": r.gauge(
+                "paddle_tpu_dataloader_shm_bytes_in_flight",
+                "SharedMemory payload bytes received but not yet "
+                "copied out of /dev/shm"),
+        }
+    return _IO_METRICS
 
 
 class Dataset:
@@ -428,11 +458,15 @@ class DataLoader:
         # env is deliberately NOT mutated here: a temporary
         # process-wide JAX_PLATFORMS=cpu would race any concurrent
         # first-time jax init in the parent and silently pin it to CPU.)
+        # workers inherit the parent's observability flag at spawn time
+        # and ship their metric snapshots back with the "done" farewell
+        obs_on = _om._ENABLED
+
         def spawn(w, resume_from=0, attempt=0):
             p = ctx.Process(
                 target=PW.worker_main,
                 args=(w, W, payload_bytes, idx_batches, queues[w], stop,
-                      resume_from, specs, attempt),
+                      resume_from, specs, attempt, obs_on),
                 daemon=True)
             p.start()
             return p
@@ -460,6 +494,8 @@ class DataLoader:
                 w = bi % W
                 q = queues[w]
                 waited = 0.0
+                obs = _om._ENABLED
+                t_wait = time.perf_counter() if obs else 0.0
                 while True:
                     try:
                         kind, tag, payload = q.get(timeout=0.5)
@@ -474,6 +510,7 @@ class DataLoader:
                                     f"{self.max_worker_restarts} "
                                     "restarts") from None
                             restarts[w] += 1
+                            _io_metrics()["restarts"].inc()
                             backoff = min(
                                 0.05 * (1 << (restarts[w] - 1)), 2.0)
                             warnings.warn(
@@ -515,14 +552,28 @@ class DataLoader:
                         raise RuntimeError(
                             f"DataLoader worker {tag} failed:\n{payload}")
                     if kind == "done":
-                        continue    # dead worker's farewell; keep waiting
+                        # finished worker's farewell (its successor may
+                        # still owe batches): merge its metric snapshot
+                        if payload:
+                            _om.registry().merge(payload)
+                        continue
                     assert kind == "batch", (kind, tag, bi)
                     if tag < bi:    # stale duplicate after a restart
                         PW.discard(payload)
                         continue
                     assert tag == bi, (tag, bi)
                     break
+                shm_bytes = 0
+                if obs:
+                    iom = _io_metrics()
+                    iom["wait"].observe(time.perf_counter() - t_wait)
+                    shm_bytes = PW.shm_payload_bytes(payload)
+                    if shm_bytes:
+                        iom["shm_bytes"].inc(shm_bytes)
+                        iom["shm_inflight"].inc(shm_bytes)
                 batch = PW.unpack(payload)
+                if shm_bytes:
+                    _io_metrics()["shm_inflight"].dec(shm_bytes)
                 yield batch if custom is not None else wrap(batch)
         finally:
             stop.set()
@@ -545,6 +596,11 @@ class DataLoader:
                         break
                     if kind == "batch":
                         PW.discard(payload)
+                    elif kind == "done" and payload:
+                        # the common race: the worker's farewell (with
+                        # its metrics snapshot) lands after the parent
+                        # consumed the last batch — merge it here
+                        _om.registry().merge(payload)
 
     def _iter_buffered(self):
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)
@@ -563,7 +619,12 @@ class DataLoader:
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         while True:
-            item = q.get()
+            if _om._ENABLED:
+                t0 = time.perf_counter()
+                item = q.get()
+                _io_metrics()["wait"].observe(time.perf_counter() - t0)
+            else:
+                item = q.get()
             if item is sentinel:
                 if err:
                     raise err[0]
@@ -620,6 +681,8 @@ class DataLoader:
             t.start()
         try:
             for bi in range(len(idx_batches)):
+                obs = _om._ENABLED
+                t0 = time.perf_counter() if obs else 0.0
                 while True:
                     if errs:
                         raise errs[0]
@@ -628,6 +691,9 @@ class DataLoader:
                         break
                     except TimeoutError:
                         continue
+                if obs:
+                    _io_metrics()["wait"].observe(
+                        time.perf_counter() - t0)
                 if batch is _WORKER_ERROR:
                     raise errs[0] if errs else RuntimeError(
                         "dataloader worker failed")

@@ -124,6 +124,25 @@ def _strip_ndarrays(obj):
     return obj
 
 
+def shm_payload_bytes(obj) -> int:
+    """Total SharedMemory bytes a packed payload references (from the
+    markers alone — no segment is attached). The parent's shm-traffic
+    metrics read this at receipt time."""
+    if isinstance(obj, tuple) and obj[:1] == ("__shm__",):
+        _, _, dtype, shape = obj
+        n = np.dtype(dtype).itemsize
+        for d in shape:
+            n *= d
+        return n
+    if isinstance(obj, list):
+        return sum(shm_payload_bytes(x) for x in obj)
+    if isinstance(obj, tuple):
+        return sum(shm_payload_bytes(x) for x in obj)
+    if isinstance(obj, dict):
+        return sum(shm_payload_bytes(v) for v in obj.values())
+    return 0
+
+
 def discard(obj):
     """Unlink every SharedMemory segment a packed payload references
     WITHOUT copying it out — the parent's cleanup path for batches
@@ -176,7 +195,8 @@ def unpack(obj):
 
 
 def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
-                stop_event, resume_from=0, fault_specs=None, attempt=0):
+                stop_event, resume_from=0, fault_specs=None, attempt=0,
+                obs_enabled=False):
     """Entry point of a spawned worker process. Round-robin ownership:
     worker w produces batches w, w+W, w+2W, ... in order into its own
     bounded queue (deterministic reassembly, per-worker backpressure —
@@ -191,9 +211,17 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
     attempt: this worker slot's incarnation number (0 = original spawn)
     — exposed in the fault context so a chaos kill can target only the
     first life (match={"bi": 2, "attempt": 0}) and let the respawn
-    survive."""
+    survive.
+    obs_enabled: the parent's observability flag at spawn time — when
+    set, this worker records its own produce-latency/batch metrics and
+    ships a registry snapshot back with its "done" farewell; the parent
+    merges it (worker metrics survive the spawn boundary the same way
+    fault specs cross it). A worker killed before its farewell loses
+    its (partial) series — its replacement recounts the recomputed
+    batches."""
     import pickle
     import queue as _q
+    import time as _time
     # a spawned child must never touch the parent's TPU: the env guard
     # runs BEFORE any user code (dataset unpickle / init fn) executes
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -201,6 +229,20 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
         dataset, collate_fn, worker_init_fn = pickle.loads(payload_bytes)
         from ..resilience import faults
         faults.install(fault_specs)
+        wm = None
+        if obs_enabled:
+            from ..observability import metrics as _om
+            _om.enable()
+            r = _om.registry()
+            wm = {
+                "produce": r.histogram(
+                    "paddle_tpu_dataloader_worker_batch_seconds",
+                    "worker-side dataset load + collate + shm pack "
+                    "time per batch"),
+                "batches": r.counter(
+                    "paddle_tpu_dataloader_worker_batches_total",
+                    "batches produced by spawned DataLoader workers"),
+            }
         global _WORKER_INFO
         import types
         _WORKER_INFO = types.SimpleNamespace(
@@ -215,6 +257,7 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
                 return
             faults.fault_point("io.worker.batch", wid=wid, bi=bi,
                                attempt=attempt)
+            t_produce = _time.perf_counter() if wm else 0.0
             samples = [dataset[i] for i in idx_batches[bi]]
             batch = collate(samples)
             segments = []
@@ -231,6 +274,9 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
                     except FileNotFoundError:
                         pass
                 raise
+            if wm:
+                wm["produce"].observe(_time.perf_counter() - t_produce)
+                wm["batches"].inc()
             placed = False
             while not stop_event.is_set():
                 try:
@@ -244,15 +290,24 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
             if not placed:      # consumer went away: free the payload
                 discard(payload)
                 return
-        # same stop-aware put as batches: an unbounded put here would
-        # block against a full queue after early consumer exit and
-        # stall the parent's join-then-drain teardown
-        while not stop_event.is_set():
+        # farewell carries this worker's metrics snapshot (None when
+        # observability is off). Stop-aware like the batch puts — an
+        # unbounded put would block against a full queue after early
+        # consumer exit and stall the parent's join-then-drain teardown
+        # — but always attempt at least ONCE: the parent sets stop the
+        # instant it consumes the last batch, and that common race must
+        # not drop the farewell (the parent's post-join drain merges it)
+        snap = None
+        if wm is not None:
+            from ..observability import metrics as _om
+            snap = _om.registry().snapshot()
+        while True:
             try:
-                out_queue.put(("done", wid, None), timeout=0.2)
+                out_queue.put(("done", wid, snap), timeout=0.2)
                 break
             except _q.Full:
-                continue
+                if stop_event.is_set():
+                    break
     except BaseException:
         try:
             out_queue.put(("error", wid, traceback.format_exc()),

@@ -1,0 +1,123 @@
+"""Unified observability: metrics registry + structured tracing +
+runtime instrumentation (see README "Observability").
+
+The subsystem is the connective tissue the serving/perf work reads its
+numbers from. Built-in instrumentation (recorded only while enabled):
+
+* `inference.LLMEngine` — step latency, prefill / decode-chunk timing
+  histograms, waiting/running queue-depth and page-pool gauges, and
+  every `engine.stats` counter mirrored as
+  `paddle_tpu_engine_events_total{event=...}`.
+* `io.DataLoader` — batch wait latency (consumer side), worker batch
+  produce latency + batch counts (recorded IN spawned workers and
+  merged into the parent registry when each worker finishes), worker
+  restarts, SharedMemory bytes transported / in flight.
+* `distributed.checkpoint` — save/restore duration, shard bytes, torn
+  checkpoints skipped/quarantined by `resume_latest`.
+* `optimizer` fused step — executable-cache hits / compiles (misses) /
+  eager fallbacks.
+* `profiler.RecordEvent` — routed through the same trace ring buffer,
+  so both exporters see one event stream.
+
+Quick start::
+
+    from paddle_tpu import observability as obs
+    obs.enable()
+    ...            # run the workload
+    print(obs.to_prometheus())
+    obs.export_chrome_trace("/tmp/trace.json")
+
+`enable()`/`disable()` flip metrics AND tracing together; the
+submodules expose the flags separately for finer control
+(`obs.metrics.enable()`, `obs.tracing.enable()`). Everything is
+process-global; `snapshot()` / `merge()` carry metrics across spawn
+boundaries (the DataLoader does this automatically for its workers).
+"""
+from __future__ import annotations
+
+from . import metrics, tracing  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, registry,
+    DEFAULT_BUCKETS,
+)
+from .tracing import (  # noqa: F401
+    span, export_chrome_trace, export_jsonl,
+)
+
+__all__ = [
+    "enable", "disable", "enabled", "registry", "snapshot", "merge",
+    "reset", "to_prometheus", "to_json", "span", "trace_events",
+    "trace_clear", "export_chrome_trace", "export_jsonl", "summary",
+    "metrics", "tracing", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "DEFAULT_BUCKETS",
+]
+
+
+def enable() -> None:
+    """Enable metric recording and tracing, process-wide."""
+    metrics.enable()
+    tracing.enable()
+
+
+def disable() -> None:
+    metrics.disable()
+    tracing.disable()
+
+
+def enabled() -> bool:
+    return metrics.enabled()
+
+
+def snapshot() -> dict:
+    return registry().snapshot()
+
+
+def merge(snap: dict) -> None:
+    registry().merge(snap)
+
+
+def reset() -> None:
+    """Zero every metric series and drop buffered trace events."""
+    registry().reset()
+    tracing.clear()
+
+
+def to_prometheus() -> str:
+    return registry().to_prometheus()
+
+
+def to_json() -> str:
+    return registry().to_json()
+
+
+def trace_events() -> list:
+    return tracing.events()
+
+
+def trace_clear() -> None:
+    tracing.clear()
+
+
+def summary() -> dict:
+    """Compact summary for machine consumers (bench.py attaches this to
+    BENCH json): non-zero counters/gauges as flat `name{k=v}` keys and
+    per-histogram {count, sum, mean, min, max}. Small by construction —
+    bucket vectors stay out; use to_prometheus()/to_json() for those."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for name, rec in snapshot().items():
+        for key, val in sorted(rec["series"].items()):
+            lbl = name if not key else name + "{" + ",".join(
+                f"{k}={v}" for k, v in zip(rec["labelnames"], key)) + "}"
+            if rec["kind"] == "histogram":
+                if val["count"]:
+                    out["histograms"][lbl] = {
+                        "count": val["count"],
+                        "sum": round(val["sum"], 6),
+                        "mean": round(val["sum"] / val["count"], 6),
+                        "min": round(val["min"], 6),
+                        "max": round(val["max"], 6),
+                    }
+            elif val:
+                out["counters" if rec["kind"] == "counter"
+                    else "gauges"][lbl] = val
+    return {k: v for k, v in out.items() if v}

@@ -1,0 +1,415 @@
+"""Process-global metrics registry: Counter / Gauge / Histogram with
+label sets, Prometheus text exposition and JSON export.
+
+Design constraints (the subsystem is compiled into hot paths — the
+LLMEngine step loop, DataLoader queues, the fused optimizer step):
+
+* **Near-zero cost when disabled.** Every mutation method's first
+  action is one module-global flag check (`if not _ENABLED: return`) —
+  no allocation, no lock, no label lookup. Child handles (the objects
+  returned by `labels()`) are created eagerly by the instrumented
+  modules at first use, so the disabled path never touches the
+  registry at all.
+* **Process-global with snapshot + reset.** One `MetricsRegistry` per
+  process (`registry()`); `snapshot()` returns a picklable plain-data
+  view that crosses the DataLoader spawn boundary (the same
+  snapshot/install idiom as `resilience.faults`), and `merge()`
+  aggregates a child's snapshot into the parent additively.
+* **Idempotent registration.** `registry().counter(name, ...)` is
+  get-or-create: instrumented modules can re-request their metrics on
+  every import/instance without duplicating series. Re-registering a
+  name with a different kind/labelnames/buckets is a bug and raises.
+
+Naming conventions (see README "Observability"): metrics are prefixed
+`paddle_tpu_`, carry base units in the suffix (`_seconds`, `_bytes`),
+and monotonic counters end in `_total`.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+    "enable", "disable", "enabled", "DEFAULT_BUCKETS",
+]
+
+# module-global so instrumented call sites pay exactly one attribute
+# load + truthiness test when observability is off
+_ENABLED = False
+
+# latency-oriented default buckets (seconds), Prometheus-style
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def enable() -> None:
+    """Turn metric recording on, process-wide."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    """Turn metric recording off (recorded values are kept)."""
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+# ---------------------------------------------------------------------------
+# children: the leaf objects mutation happens on. Updates are plain
+# attribute stores on floats/ints under the GIL — racing increments can
+# interleave but never corrupt, which is the standard tradeoff for
+# in-process metrics (a lock per inc() would cost more than the metric).
+# ---------------------------------------------------------------------------
+class _CounterChild:
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if not _ENABLED:
+            return
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class _GaugeChild:
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        if not _ENABLED:
+            return
+        self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        if not _ENABLED:
+            return
+        self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        if not _ENABLED:
+            return
+        self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class _HistogramChild:
+    __slots__ = ("_bounds", "_buckets", "_sum", "_count", "_min", "_max")
+
+    def __init__(self, bounds: Tuple[float, ...]):
+        self._bounds = bounds
+        self._buckets = [0] * (len(bounds) + 1)     # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        if not _ENABLED:
+            return
+        self._buckets[bisect.bisect_left(self._bounds, v)] += 1
+        self._sum += v
+        self._count += 1
+        if v < self._min:
+            self._min = v
+        if v > self._max:
+            self._max = v
+
+    @property
+    def value(self) -> dict:
+        return {
+            "buckets": list(self._buckets), "sum": self._sum,
+            "count": self._count,
+            "min": None if self._count == 0 else self._min,
+            "max": None if self._count == 0 else self._max,
+        }
+
+
+_CHILD_FOR = {"counter": _CounterChild, "gauge": _GaugeChild,
+              "histogram": _HistogramChild}
+
+
+# ---------------------------------------------------------------------------
+# parent metric: owns the label-set -> child map
+# ---------------------------------------------------------------------------
+class _Metric:
+    kind: str = ""
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Tuple[str, ...] = (),
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = (tuple(buckets) if buckets is not None
+                        else DEFAULT_BUCKETS) \
+            if self.kind == "histogram" else None
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+        if not self.labelnames:
+            self._default = self._new_child()
+            self._children[()] = self._default
+        else:
+            self._default = None
+
+    def _new_child(self):
+        if self.kind == "histogram":
+            return _HistogramChild(self.buckets)
+        return _CHILD_FOR[self.kind]()
+
+    def labels(self, **kv):
+        """Child handle for one label set. Cached: repeated lookups with
+        the same values return the same object, so instrumented modules
+        can hold the handle and skip the lookup on the hot path."""
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(kv)}")
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._new_child())
+        return child
+
+    # unlabeled convenience: forward to the default child
+    def inc(self, n: float = 1.0):
+        self._require_default().inc(n)
+
+    def set(self, v: float):
+        self._require_default().set(v)
+
+    def dec(self, n: float = 1.0):
+        self._require_default().dec(n)
+
+    def observe(self, v: float):
+        self._require_default().observe(v)
+
+    def _require_default(self):
+        if self._default is None:
+            raise ValueError(
+                f"{self.name} has labels {self.labelnames}; call "
+                ".labels(...) first")
+        return self._default
+
+    def _series(self):
+        """[(labelvalues_tuple, child)] snapshot-stable list."""
+        with self._lock:
+            return list(self._children.items())
+
+    def _reset(self):
+        with self._lock:
+            for key in list(self._children):
+                self._children[key] = self._new_child()
+            if self._default is not None:
+                self._default = self._children[()]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+
+_KIND_CLASS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+def _escape_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    # -- registration (get-or-create) --
+    def _get_or_create(self, kind, name, help, labelnames, buckets=None):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                want_buckets = (tuple(buckets) if buckets is not None
+                                else DEFAULT_BUCKETS)
+                if m.kind != kind or m.labelnames != tuple(labelnames) \
+                        or (kind == "histogram"
+                            and m.buckets != want_buckets):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}{m.labelnames} — conflicting "
+                        "re-registration")
+                return m
+            m = _KIND_CLASS[kind](name, help, labelnames, buckets)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._get_or_create("counter", name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._get_or_create("gauge", name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=None) -> Histogram:
+        return self._get_or_create("histogram", name, help, labelnames,
+                                   buckets)
+
+    def get(self, name) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def _items(self):
+        with self._lock:
+            return sorted(self._metrics.items())
+
+    # -- lifecycle --
+    def reset(self) -> None:
+        """Zero every series; registrations (and handed-out parent
+        objects) survive, so instrumented modules keep working."""
+        for _, m in self._items():
+            m._reset()
+
+    # -- snapshot / merge (spawn-boundary aggregation) --
+    def snapshot(self) -> dict:
+        """Picklable plain-data view: {name: {kind, help, labelnames,
+        buckets?, series: {labelvalues_tuple: value}}}. Histogram values
+        are dicts (buckets/sum/count/min/max)."""
+        out = {}
+        for name, m in self._items():
+            series = {key: child.value for key, child in m._series()}
+            rec = {"kind": m.kind, "help": m.help,
+                   "labelnames": m.labelnames, "series": series}
+            if m.kind == "histogram":
+                rec["buckets"] = m.buckets
+            out[name] = rec
+        return out
+
+    def merge(self, snap: dict) -> None:
+        """Aggregate a snapshot() (typically from a DataLoader worker
+        process) into this registry: counters and histograms add;
+        gauges add too (a worker gauge is that worker's contribution —
+        e.g. bytes in flight — so sum is the meaningful aggregate).
+        Merging bypasses the enabled flag: the child only has a
+        snapshot to ship because recording was on when it mattered."""
+        if not snap:
+            return
+        for name, rec in snap.items():
+            m = self._get_or_create(rec["kind"], name, rec["help"],
+                                    tuple(rec["labelnames"]),
+                                    rec.get("buckets"))
+            for key, val in rec["series"].items():
+                key = tuple(key)
+                child = m._children.get(key)
+                if child is None:
+                    with m._lock:
+                        child = m._children.setdefault(
+                            key, m._new_child())
+                if m.kind == "histogram":
+                    for i, b in enumerate(val["buckets"]):
+                        child._buckets[i] += b
+                    child._sum += val["sum"]
+                    child._count += val["count"]
+                    if val["count"]:
+                        child._min = min(child._min, val["min"])
+                        child._max = max(child._max, val["max"])
+                else:
+                    child._value += val
+
+    # -- exporters --
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        for name, m in self._items():
+            lines.append(f"# HELP {name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            for key, child in sorted(m._series()):
+                base = list(zip(m.labelnames, key))
+
+                def render(suffix, extra, v):
+                    pairs = base + extra
+                    lbl = ("{" + ",".join(
+                        f'{k}="{_escape_label(str(x))}"'
+                        for k, x in pairs) + "}") if pairs else ""
+                    lines.append(f"{name}{suffix}{lbl} {_fmt(v)}")
+
+                if m.kind == "histogram":
+                    acc = 0
+                    for bound, n in zip(m.buckets, child._buckets):
+                        acc += n
+                        render("_bucket", [("le", _fmt(bound))], acc)
+                    acc += child._buckets[-1]
+                    render("_bucket", [("le", "+Inf")], acc)
+                    render("_sum", [], child._sum)
+                    render("_count", [], child._count)
+                else:
+                    render("", [], child._value)
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self) -> str:
+        """JSON export: same data as snapshot() with JSON-safe keys
+        (label values joined into an object per series)."""
+        out = {}
+        for name, m in self._items():
+            series = []
+            for key, child in sorted(m._series()):
+                series.append({
+                    "labels": dict(zip(m.labelnames, key)),
+                    "value": child.value,
+                })
+            rec = {"kind": m.kind, "help": m.help, "series": series}
+            if m.kind == "histogram":
+                rec["buckets"] = list(m.buckets)
+            out[name] = rec
+        return json.dumps(out, sort_keys=True)
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry every built-in instrumentation
+    records into."""
+    return _GLOBAL

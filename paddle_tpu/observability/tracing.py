@@ -1,0 +1,171 @@
+"""Structured tracing: nestable spans into a bounded in-memory ring
+buffer, exported as Chrome-trace JSON (chrome://tracing / Perfetto) or
+JSONL.
+
+One event stream: `profiler.RecordEvent` routes its host spans through
+the same ring buffer, so `profiler.export_chrome_tracing` and the
+exporters here produce one consistent file whichever API recorded the
+span.
+
+Events are stored directly in chrome-trace "complete event" shape —
+{"name", "ph": "X", "pid", "tid", "ts", "dur", "args"} with ts/dur in
+microseconds on the monotonic `time.perf_counter_ns` clock — so export
+is a dump, not a conversion.
+
+Cost model: `span()` returns a shared no-op singleton when tracing is
+disabled (zero allocation on the hot path); when enabled, one small
+object + one dict per finished span, into a deque bounded at
+`capacity()` events (oldest dropped)."""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+__all__ = [
+    "span", "add_event", "events", "clear", "enable", "disable",
+    "enabled", "set_capacity", "capacity", "export_chrome_trace",
+    "export_jsonl",
+]
+
+_ENABLED = False
+_DEFAULT_CAPACITY = 65536
+_LOCK = threading.Lock()
+_RING: collections.deque = collections.deque(maxlen=_DEFAULT_CAPACITY)
+
+
+def enable() -> None:
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_capacity(n: int) -> None:
+    """Resize the ring buffer (keeps the newest events that fit)."""
+    global _RING
+    with _LOCK:
+        _RING = collections.deque(_RING, maxlen=max(1, int(n)))
+
+
+def capacity() -> int:
+    return _RING.maxlen
+
+
+def clear() -> None:
+    with _LOCK:
+        _RING.clear()
+
+
+def add_event(name: str, ts_us: float, dur_us: float,
+              pid: Optional[int] = None, tid: Optional[int] = None,
+              args: Optional[dict] = None) -> None:
+    """Append one complete event to the ring. ts_us must come from the
+    perf_counter clock (microseconds) so events from different
+    recording APIs order consistently."""
+    ev = {"name": name, "ph": "X",
+          "pid": os.getpid() if pid is None else pid,
+          "tid": threading.get_ident() if tid is None else tid,
+          "ts": ts_us, "dur": dur_us}
+    if args:
+        ev["args"] = args
+    _RING.append(ev)      # deque.append is atomic under the GIL
+
+
+def events() -> List[dict]:
+    """Copy of the buffered events, oldest first."""
+    with _LOCK:
+        return list(_RING)
+
+
+class _NullSpan:
+    """Shared disabled-mode span: no state, no allocation."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def end(self):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "_t0")
+
+    def __init__(self, name, args):
+        self.name = name
+        self.args = args
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def end(self):
+        """Idempotent: the second end()/__exit__ is a no-op."""
+        t0, self._t0 = self._t0, None
+        if t0 is None:
+            return
+        t1 = time.perf_counter_ns()
+        add_event(self.name, t0 / 1000.0, (t1 - t0) / 1000.0,
+                  args=self.args)
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def span(name: str, **attrs) -> object:
+    """Nestable timing context:
+
+        with tracing.span("engine.step", batch=8):
+            ...
+
+    Records one complete event on exit when tracing is enabled; returns
+    a shared no-op context when disabled."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _Span(name, attrs or None)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+def export_chrome_trace(path: str, extra_events: Optional[list] = None
+                        ) -> str:
+    """Write the ring buffer as a chrome://tracing / Perfetto-loadable
+    JSON object. Returns the path written."""
+    evs = events()
+    if extra_events:
+        evs = evs + list(extra_events)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+def export_jsonl(path: str) -> str:
+    """Write the ring buffer as one JSON object per line (stream-
+    friendly: cat/grep/jq-able, appendable across runs)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        for ev in events():
+            f.write(json.dumps(ev))
+            f.write("\n")
+    return path

@@ -15,7 +15,24 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..autograd import no_grad
+from ..observability import metrics as _om
 from .lr import LRScheduler
+
+_FUSED_COUNTER = None
+
+
+def _fused_counter(outcome: str) -> None:
+    """paddle_tpu_optimizer_fused_step_total{outcome=} — hit: cached
+    executable reused; compile: traced+compiled fresh (a cache miss;
+    beyond the first signature this means a RECOMPILE — mutated hypers,
+    changed dtypes); fallback: rule not jittable, eager path taken."""
+    global _FUSED_COUNTER
+    if _FUSED_COUNTER is None:
+        _FUSED_COUNTER = _om.registry().counter(
+            "paddle_tpu_optimizer_fused_step_total",
+            "fused optimizer-step executable cache outcomes",
+            ("outcome",))
+    _FUSED_COUNTER.labels(outcome=outcome).inc()
 
 
 class Optimizer:
@@ -221,7 +238,11 @@ class Optimizer:
                                                  states))
         entry = cache.get(key)
         if entry is self._FUSED_FAIL:
+            if _om._ENABLED:
+                _fused_counter("fallback")
             return False
+        if entry is not None and _om._ENABLED:
+            _fused_counter("hit")
         if entry is None:
             hypers = [{k: v for k, v in grp.items() if k != "params"}
                       for _, grp, _ in infos]
@@ -254,8 +275,12 @@ class Optimizer:
                     lr32, work, garrs, states).compile()
             except Exception:
                 cache[key] = self._FUSED_FAIL   # not jittable as-is
+                if _om._ENABLED:
+                    _fused_counter("fallback")
                 return False
             cache[key] = entry
+            if _om._ENABLED:
+                _fused_counter("compile")
         lr32 = jnp.asarray(lr, jnp.float32)
         new_w, new_s, casts = entry(lr32, work, garrs, states)
         for (p, _, has_mw), nw, ns, cast in zip(infos, new_w, new_s,

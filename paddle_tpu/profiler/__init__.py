@@ -1,11 +1,13 @@
 """Profiler (ref: python/paddle/profiler/profiler.py:346 + C++ host/device
 tracers §5.1).
 
-Host spans: RecordEvent context managers into an in-process event store,
-exported as chrome-trace JSON (the reference's ChromeTracingLogger role).
-Device timeline: jax.profiler (XLA/PJRT trace) captured alongside when a
-dir is given — TPU kernels, transfers, and host callbacks land in the same
-tensorboard-loadable trace."""
+Host spans: RecordEvent context managers into the observability trace
+ring (`paddle_tpu.observability.tracing`) — ONE event stream shared
+with `observability.span`, so `export_chrome_tracing` here and the
+observability exporters produce consistent files whichever API recorded
+the span. Device timeline: jax.profiler (XLA/PJRT trace) captured
+alongside when a dir is given — TPU kernels, transfers, and host
+callbacks land in the same tensorboard-loadable trace."""
 from __future__ import annotations
 
 import json
@@ -16,6 +18,8 @@ from enum import Enum
 from typing import Callable, List, Optional
 
 import jax
+
+from ..observability import tracing as _tracing
 
 
 class ProfilerTarget(Enum):
@@ -31,11 +35,6 @@ class ProfilerState(Enum):
     READY = 1
     RECORD = 2
     RECORD_AND_RETURN = 3
-
-
-_events: List[dict] = []
-_events_lock = threading.Lock()
-_enabled = False
 
 # --- per-op dispatch spans (ref: eager_gen.py:251 "Dygraph Record
 # Event" slot — the reference opens a platform::RecordEvent in every
@@ -63,7 +62,12 @@ def _record_op(name: str, t0_ns: int, cached: bool) -> None:
 
 class RecordEvent:
     """(ref: paddle.profiler.RecordEvent / C++ platform/profiler/
-    event_tracing.h:43)"""
+    event_tracing.h:43)
+
+    Idempotent: a second end() (or __exit__ after an explicit end()) is
+    a no-op — the span is consumed by the first end. Events land in the
+    shared observability trace ring whenever tracing is enabled (by a
+    running Profiler or by observability.enable())."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
@@ -73,15 +77,11 @@ class RecordEvent:
         self._t0 = time.perf_counter_ns()
 
     def end(self):
-        if self._t0 is None or not _enabled:
+        t0, self._t0 = self._t0, None       # consume: double end no-ops
+        if t0 is None or not _tracing.enabled():
             return
         t1 = time.perf_counter_ns()
-        with _events_lock:
-            _events.append({
-                "name": self.name, "ph": "X", "pid": os.getpid(),
-                "tid": threading.get_ident(),
-                "ts": self._t0 / 1000.0, "dur": (t1 - self._t0) / 1000.0,
-            })
+        _tracing.add_event(self.name, t0 / 1000.0, (t1 - t0) / 1000.0)
 
     def __enter__(self):
         self.begin()
@@ -136,13 +136,16 @@ class Profiler:
         self._jax_trace_dir = None
 
     def start(self):
-        global _enabled, _events
-        _enabled = True
+        # one event stream: Profiler sessions record into the shared
+        # observability ring. start() clears it (a profiling session is
+        # a fresh window); tracing stays enabled afterwards only if
+        # observability had it on before this session.
+        self._trace_was_enabled = _tracing.enabled()
+        _tracing.clear()
+        _tracing.enable()
         from ..ops import registry as _registry
         _registry._set_op_profiling(True)
         _op_stats.clear()
-        with _events_lock:
-            _events = []
         if not self.timer_only:
             self._jax_trace_dir = os.environ.get(
                 "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
@@ -153,8 +156,8 @@ class Profiler:
         return self
 
     def stop(self):
-        global _enabled
-        _enabled = False
+        if not getattr(self, "_trace_was_enabled", False):
+            _tracing.disable()
         from ..ops import registry as _registry
         _registry._set_op_profiling(False)
         if self._jax_trace_dir is not None:
@@ -170,8 +173,7 @@ class Profiler:
         self.step_num += 1
 
     def events(self):
-        with _events_lock:
-            return list(_events)
+        return _tracing.events()
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):

@@ -1,0 +1,584 @@
+"""Unified observability subsystem (paddle_tpu/observability/):
+metrics registry semantics, Prometheus/JSON/Chrome-trace exports, the
+disabled-mode overhead guard, profiler unification, and the runtime
+instrumentation wired into LLMEngine / DataLoader (incl. across the
+spawn boundary) / distributed checkpoint / fused optimizer step."""
+import json
+import os
+import pickle
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu import profiler
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.observability import MetricsRegistry, metrics, tracing
+from paddle_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Every test starts and ends disabled with empty series/ring (the
+    registry is process-global)."""
+    obs.disable()
+    obs.reset()
+    cap = tracing.capacity()
+    yield
+    obs.disable()
+    obs.reset()
+    tracing.set_capacity(cap)
+    faults.clear_all()
+
+
+def _series(name):
+    return obs.snapshot()[name]["series"]
+
+
+# ---------------------------------------------------------------------------
+# metrics registry semantics
+# ---------------------------------------------------------------------------
+class TestRegistry:
+    def test_counter_inc_and_snapshot(self):
+        obs.enable()
+        c = obs.registry().counter("t_reg_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert _series("t_reg_total")[()] == 3.5
+
+    def test_counter_rejects_negative(self):
+        obs.enable()
+        c = obs.registry().counter("t_neg_total", "")
+        with pytest.raises(ValueError, match="only go up"):
+            c.inc(-1)
+
+    def test_labels_are_independent_series(self):
+        obs.enable()
+        c = obs.registry().counter("t_lbl_total", "", ("op", "ok"))
+        c.labels(op="read", ok="true").inc(2)
+        c.labels(op="write", ok="false").inc(5)
+        s = _series("t_lbl_total")
+        assert s[("read", "true")] == 2
+        assert s[("write", "false")] == 5
+        # cached child: same label values -> same object
+        assert c.labels(op="read", ok="true") is \
+            c.labels(op="read", ok="true")
+        with pytest.raises(ValueError, match="expected labels"):
+            c.labels(op="read")
+
+    def test_gauge_set_inc_dec(self):
+        obs.enable()
+        g = obs.registry().gauge("t_gauge", "")
+        g.set(10)
+        g.inc(2)
+        g.dec(5)
+        assert _series("t_gauge")[()] == 7
+
+    def test_histogram_bucket_correctness(self):
+        obs.enable()
+        h = obs.registry().histogram("t_hist_seconds", "",
+                                     buckets=(1.0, 2.0))
+        for v in (0.5, 1.0, 1.5, 3.0):      # le semantics: 1.0 -> le=1
+            h.observe(v)
+        val = _series("t_hist_seconds")[()]
+        assert val["buckets"] == [2, 1, 1]   # (le 1, le 2, +Inf)
+        assert val["count"] == 4
+        assert val["sum"] == pytest.approx(6.0)
+        assert val["min"] == 0.5 and val["max"] == 3.0
+
+    def test_get_or_create_idempotent_and_conflict(self):
+        r = obs.registry()
+        a = r.counter("t_same_total", "h")
+        assert r.counter("t_same_total", "h") is a
+        with pytest.raises(ValueError, match="conflicting"):
+            r.gauge("t_same_total")
+        with pytest.raises(ValueError, match="conflicting"):
+            r.counter("t_same_total", "h", labelnames=("x",))
+
+    def test_reset_zeroes_but_keeps_registrations(self):
+        obs.enable()
+        c = obs.registry().counter("t_reset_total", "")
+        c.inc(4)
+        obs.reset()
+        assert _series("t_reset_total")[()] == 0
+        c.inc()                       # handed-out handle still works
+        assert _series("t_reset_total")[()] == 1
+
+    def test_disabled_records_nothing(self):
+        c = obs.registry().counter("t_off_total", "")
+        h = obs.registry().histogram("t_off_seconds", "")
+        c.inc(5)
+        h.observe(1.0)
+        assert _series("t_off_total")[()] == 0
+        assert _series("t_off_seconds")[()]["count"] == 0
+
+    def test_disabled_mode_no_allocation_growth(self):
+        """The acceptance guard: registry off => no net allocation per
+        op (one flag check and out; span() returns a shared null)."""
+        import tracemalloc
+        c = obs.registry().counter("t_ov_total", "")
+        h = obs.registry().histogram("t_ov_seconds", "")
+        g = obs.registry().gauge("t_ov_gauge", "")
+        for _ in range(16):           # warm any lazy state
+            c.inc()
+            h.observe(1.0)
+            g.set(1.0)
+            with obs.span("t.ov", k=1):
+                pass
+        tracemalloc.start()
+        base = tracemalloc.get_traced_memory()[0]
+        for _ in range(5000):
+            c.inc()
+            h.observe(1.0)
+            g.set(1.0)
+            with obs.span("t.ov", k=1):
+                pass
+        grown = tracemalloc.get_traced_memory()[0] - base
+        tracemalloc.stop()
+        assert grown < 2048, f"disabled-mode ops leaked {grown}B"
+        assert _series("t_ov_total")[()] == 0
+        assert tracing.events() == []
+
+    def test_snapshot_pickles_and_merges(self):
+        obs.enable()
+        src = MetricsRegistry()
+        src.counter("t_m_total", "", ("k",)).labels(k="a").inc(2)
+        hsrc = src.histogram("t_m_seconds", "", buckets=(1.0,))
+        hsrc.observe(0.5)
+        hsrc.observe(2.0)
+        snap = pickle.loads(pickle.dumps(src.snapshot()))
+        dst = MetricsRegistry()
+        dst.merge(snap)
+        dst.merge(snap)               # additive
+        assert dst.counter("t_m_total", "", ("k",)) \
+            .labels(k="a").value == 4
+        out = dst.snapshot()["t_m_seconds"]["series"][()]
+        assert out["count"] == 4
+        assert out["buckets"] == [2, 2]
+        assert out["sum"] == pytest.approx(5.0)
+        assert out["min"] == 0.5 and out["max"] == 2.0
+
+    def test_merge_applies_while_disabled(self):
+        # the parent may have turned recording off by the time a worker
+        # farewell arrives; the shipped history still counts
+        src = MetricsRegistry()
+        obs.enable()
+        src.counter("t_md_total", "").inc(3)
+        snap = src.snapshot()
+        obs.disable()
+        obs.registry().merge(snap)
+        assert _series("t_md_total")[()] == 3
+
+
+# ---------------------------------------------------------------------------
+# exports
+# ---------------------------------------------------------------------------
+class TestExports:
+    def test_prometheus_exposition_golden(self):
+        obs.enable()
+        reg = MetricsRegistry()
+        reg.counter("demo_total", "a counter", ("method",)) \
+            .labels(method="get").inc(3)
+        reg.gauge("demo_gauge", "a gauge").set(2.5)
+        h = reg.histogram("demo_seconds", "a histogram",
+                          buckets=(0.25, 1.0))
+        for v in (0.25, 0.5, 2.0):
+            h.observe(v)
+        assert reg.to_prometheus() == (
+            "# HELP demo_gauge a gauge\n"
+            "# TYPE demo_gauge gauge\n"
+            "demo_gauge 2.5\n"
+            "# HELP demo_seconds a histogram\n"
+            "# TYPE demo_seconds histogram\n"
+            'demo_seconds_bucket{le="0.25"} 1\n'
+            'demo_seconds_bucket{le="1"} 2\n'
+            'demo_seconds_bucket{le="+Inf"} 3\n'
+            "demo_seconds_sum 2.75\n"
+            "demo_seconds_count 3\n"
+            "# HELP demo_total a counter\n"
+            "# TYPE demo_total counter\n"
+            'demo_total{method="get"} 3\n')
+
+    def test_prometheus_label_escaping(self):
+        obs.enable()
+        reg = MetricsRegistry()
+        reg.counter("esc_total", "", ("p",)) \
+            .labels(p='a"b\\c\nd').inc()
+        assert 'esc_total{p="a\\"b\\\\c\\nd"} 1' in reg.to_prometheus()
+
+    def test_json_export_roundtrip(self):
+        obs.enable()
+        reg = MetricsRegistry()
+        reg.counter("j_total", "", ("k",)).labels(k="v").inc(7)
+        reg.histogram("j_seconds", "", buckets=(1.0,)).observe(0.5)
+        doc = json.loads(reg.to_json())
+        assert doc["j_total"]["series"] == [
+            {"labels": {"k": "v"}, "value": 7.0}]
+        hs = doc["j_seconds"]
+        assert hs["buckets"] == [1.0]
+        assert hs["series"][0]["value"]["count"] == 1
+
+    @pytest.mark.obs
+    def test_chrome_trace_export(self, tmp_path):
+        obs.enable()
+        with obs.span("outer", kind="test"):
+            with obs.span("inner"):
+                pass
+        path = obs.export_chrome_trace(str(tmp_path / "t.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert names == ["inner", "outer"]    # inner span ends first
+        for e in doc["traceEvents"]:
+            assert {"ph", "pid", "tid", "ts", "dur"} <= set(e)
+            assert e["ph"] == "X" and e["dur"] >= 0
+        outer = doc["traceEvents"][1]
+        assert outer["args"] == {"kind": "test"}
+
+    @pytest.mark.obs
+    def test_jsonl_export(self, tmp_path):
+        obs.enable()
+        for i in range(3):
+            with obs.span(f"s{i}"):
+                pass
+        path = obs.export_jsonl(str(tmp_path / "t.jsonl"))
+        lines = [json.loads(l) for l in open(path)]
+        assert [e["name"] for e in lines] == ["s0", "s1", "s2"]
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+class TestTracing:
+    def test_span_nesting_monotonic(self):
+        obs.enable()
+        with obs.span("a"):
+            with obs.span("b"):
+                pass
+        evs = tracing.events()
+        b, a = evs[0], evs[1]
+        assert (b["name"], a["name"]) == ("b", "a")
+        # the inner span is contained in the outer one
+        assert a["ts"] <= b["ts"]
+        assert b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-3
+
+    def test_ring_buffer_bounded(self):
+        obs.enable()
+        tracing.set_capacity(8)
+        for i in range(20):
+            with obs.span(f"e{i}"):
+                pass
+        evs = tracing.events()
+        assert len(evs) == 8
+        assert evs[0]["name"] == "e12"     # oldest dropped
+
+    def test_disabled_span_is_shared_noop(self):
+        s1 = obs.span("x", a=1)
+        s2 = obs.span("y")
+        assert s1 is s2                    # no allocation when off
+        with s1:
+            pass
+        assert tracing.events() == []
+
+    def test_span_end_idempotent(self):
+        obs.enable()
+        s = obs.span("once")
+        with s:
+            pass
+        s.end()
+        s.__exit__(None, None, None)
+        assert len(tracing.events()) == 1
+
+
+# ---------------------------------------------------------------------------
+# profiler unification
+# ---------------------------------------------------------------------------
+class TestProfilerUnification:
+    def test_record_event_double_end_idempotent(self):
+        obs.enable()
+        ev = profiler.RecordEvent("re")
+        ev.begin()
+        ev.end()
+        ev.end()
+        with profiler.RecordEvent("re2") as ev2:
+            ev2.end()                      # explicit end inside with
+        evs = [e["name"] for e in tracing.events()]
+        assert evs == ["re", "re2"]
+
+    @pytest.mark.obs
+    def test_one_event_stream(self, tmp_path):
+        """RecordEvent and observability spans land in ONE buffer;
+        profiler export and the obs exporter see the same events."""
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        try:
+            with profiler.RecordEvent("via_profiler"):
+                pass
+            with obs.span("via_obs"):
+                pass
+        finally:
+            p.stop()
+        names = {e["name"] for e in p.events()}
+        assert {"via_profiler", "via_obs"} <= names
+        handler = profiler.export_chrome_tracing(str(tmp_path), "w")
+        with open(handler(p)) as f:
+            doc = json.load(f)
+        assert {e["name"] for e in doc["traceEvents"]} >= names
+        # profiler session over, obs was off before -> tracing off again
+        assert not tracing.enabled()
+
+    def test_profiler_restores_obs_tracing(self):
+        obs.enable()
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        p.stop()
+        assert tracing.enabled()       # obs had it on before the session
+
+
+# ---------------------------------------------------------------------------
+# engine instrumentation (real LLMEngine run)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import gpt_tiny
+    pt.seed(0)
+    return GPTForCausalLM(gpt_tiny())
+
+
+def _run_engine(model, n_prompts=3, n_new=6):
+    from paddle_tpu.inference import LLMEngine
+    rng = np.random.default_rng(0)
+    eng = LLMEngine(model, max_batch=2, block_size=16, decode_chunk=4,
+                    prompt_quantum=16, max_model_len=64)
+    prompts = [rng.integers(0, 1024, (int(n),)).astype(np.int32)
+               for n in (5, 9, 13, 7, 11)[:n_prompts]]
+    return eng, eng.generate(prompts, max_new_tokens=n_new)
+
+
+class TestEngineInstrumentation:
+    def test_engine_emits_expected_series(self, tiny_gpt):
+        obs.enable()
+        eng, results = _run_engine(tiny_gpt)
+        assert all(r.ok for r in results)
+        snap = obs.snapshot()
+        assert snap["paddle_tpu_engine_step_seconds"]["series"][()][
+            "count"] >= 2
+        # 3 prompts through max_batch=2 -> at least 2 admission waves
+        assert snap["paddle_tpu_engine_prefill_seconds"]["series"][()][
+            "count"] >= 2
+        assert snap["paddle_tpu_engine_decode_chunk_seconds"]["series"][
+            ()]["count"] >= 1
+        ev = snap["paddle_tpu_engine_events_total"]["series"]
+        assert ev[("prefills",)] == eng.stats["prefills"] == 3
+        assert ev[("decode_tokens",)] == eng.stats["decode_tokens"]
+        pool = snap["paddle_tpu_engine_page_pool_blocks"]["series"]
+        assert pool[("free",)] + pool[("used",)] == \
+            eng.cache.allocator.num_blocks
+        q = snap["paddle_tpu_engine_queue_depth"]["series"]
+        assert q[("waiting",)] == 0 and q[("running",)] == 0  # drained
+
+    def test_engine_trace_spans(self, tiny_gpt):
+        obs.enable()
+        _run_engine(tiny_gpt, n_prompts=1, n_new=4)
+        names = {e["name"] for e in tracing.events()}
+        assert {"engine.step", "engine.prefill",
+                "engine.decode_chunk"} <= names
+
+    def test_engine_stats_backward_compatible_when_disabled(self,
+                                                            tiny_gpt):
+        """engine.stats stays a plain per-engine dict whether or not
+        observability records — the pre-existing contract."""
+        eng, results = _run_engine(tiny_gpt, n_prompts=2, n_new=4)
+        assert isinstance(eng.stats, dict)
+        assert dict(eng.stats) == eng.stats
+        assert eng.stats["prefills"] == 2
+        assert eng.stats["decode_tokens"] >= 2
+        assert sorted(eng.stats) == [
+            "deadline_expired", "decode_chunks", "decode_tokens",
+            "failed_requests", "preemptions", "prefills",
+            "rejected_requests"]
+        # nothing leaked into the (disabled) registry
+        ev = _series("paddle_tpu_engine_events_total")
+        assert sum(ev.values()) == 0
+        assert tracing.events() == []
+
+    def test_engine_failure_counters_mirror(self, tiny_gpt):
+        obs.enable()
+        from paddle_tpu.inference import LLMEngine
+        eng = LLMEngine(tiny_gpt, max_batch=2, block_size=8,
+                        num_blocks=5, max_model_len=64, shed_load=True)
+        # infeasible: needs more blocks than the pool owns -> rejected
+        eng.add_request("big", np.arange(30, dtype=np.int32),
+                        max_new_tokens=30)
+        res = eng.step()
+        assert res and res[0].finish_reason == "rejected"
+        ev = _series("paddle_tpu_engine_events_total")
+        assert ev[("rejected_requests",)] == 1
+
+
+# ---------------------------------------------------------------------------
+# DataLoader instrumentation (incl. spawn-boundary aggregation)
+# ---------------------------------------------------------------------------
+class ObsShmDs(Dataset):
+    """Module-level (spawn-picklable); 256 KiB samples force the
+    SharedMemory transport."""
+
+    def __init__(self, n=12):
+        self.n = n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        return rng.standard_normal(64 * 1024).astype(np.float32), \
+            np.int64(i)
+
+    def __len__(self):
+        return self.n
+
+
+class ObsSmallDs(Dataset):
+    """Tiny samples: rides the queue pickle, no SharedMemory."""
+
+    def __init__(self, n=12):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.full((4,), i, np.float32)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoaderInstrumentation:
+    def test_buffered_tier_wait_histogram(self):
+        obs.enable()
+        ds = ObsSmallDs(n=8)
+        out = list(DataLoader(ds, batch_size=2, num_workers=0))
+        assert len(out) == 4
+        wait = _series("paddle_tpu_dataloader_batch_wait_seconds")[()]
+        # one wait per batch + one for the end-of-epoch sentinel
+        assert wait["count"] >= 4
+
+    def test_spawn_worker_metrics_survive_aggregation(self):
+        """Worker-side series are recorded IN the spawned processes and
+        merged into the parent registry via the workers' farewell
+        messages (the faults snapshot/install pattern, reversed)."""
+        obs.enable()
+        ds = ObsShmDs(n=12)
+        out = list(DataLoader(ds, batch_size=4, num_workers=2))
+        assert len(out) == 3
+        snap = obs.snapshot()
+        produced = snap["paddle_tpu_dataloader_worker_batches_total"][
+            "series"][()]
+        assert produced == 3
+        lat = snap["paddle_tpu_dataloader_worker_batch_seconds"][
+            "series"][()]
+        assert lat["count"] == 3 and lat["sum"] > 0
+        # parent-side series from the same epoch
+        wait = snap["paddle_tpu_dataloader_batch_wait_seconds"][
+            "series"][()]
+        assert wait["count"] == 3
+        shm = snap["paddle_tpu_dataloader_shm_bytes_total"]["series"][()]
+        assert shm == 3 * 4 * 64 * 1024 * 4    # 3 batches x [4, 64Ki] f32
+        assert snap["paddle_tpu_dataloader_shm_bytes_in_flight"][
+            "series"][()] == 0                 # all unpacked
+
+    def test_worker_restart_counter(self):
+        obs.enable()
+        ds = ObsSmallDs(n=12)
+        with faults.inject("io.worker.batch", exit_code=1, times=1,
+                           match={"bi": 2, "attempt": 0}):
+            # the hard exit can land before the queue feeder flushes
+            # earlier batches, so the respawn batch number varies — the
+            # restart COUNT is the contract here
+            with pytest.warns(UserWarning, match="respawning at batch"):
+                out = list(DataLoader(ds, batch_size=2, num_workers=2))
+        assert len(out) == 6
+        assert _series(
+            "paddle_tpu_dataloader_worker_restarts_total")[()] == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint instrumentation
+# ---------------------------------------------------------------------------
+class TestCheckpointInstrumentation:
+    def test_save_restore_metrics(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        obs.enable()
+        sd = {"w": pt.to_tensor(np.arange(32, dtype=np.float32)),
+              "b": pt.to_tensor(np.ones((4,), np.float32))}
+        ckpt.save_state_dict(sd, str(tmp_path / "step_1"))
+        dst = {"w": pt.to_tensor(np.zeros(32, np.float32)),
+               "b": pt.to_tensor(np.zeros(4, np.float32))}
+        ckpt.load_state_dict(dst, str(tmp_path / "step_1"))
+        np.testing.assert_array_equal(dst["w"].numpy(), sd["w"].numpy())
+        snap = obs.snapshot()
+        assert snap["paddle_tpu_checkpoint_save_seconds"]["series"][()][
+            "count"] == 1
+        assert snap["paddle_tpu_checkpoint_restore_seconds"]["series"][
+            ()]["count"] == 1
+        by = snap["paddle_tpu_checkpoint_shard_bytes_total"]["series"]
+        assert by[("save",)] > 0
+        assert by[("save",)] == by[("restore",)]
+        names = {e["name"] for e in tracing.events()}
+        assert {"checkpoint.save", "checkpoint.restore"} <= names
+
+    def test_torn_quarantine_counters(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        obs.enable()
+        sd = {"w": pt.to_tensor(np.arange(8, dtype=np.float32))}
+        ckpt.save_state_dict(sd, str(tmp_path / "step_1"))
+        ckpt.save_state_dict(
+            {"w": pt.to_tensor(np.arange(8, 16).astype(np.float32))},
+            str(tmp_path / "step_2"))
+        # tear the newer checkpoint: drop a manifest-listed shard file
+        shard = next(f for f in os.listdir(tmp_path / "step_2")
+                     if f.endswith(".npy"))
+        os.remove(tmp_path / "step_2" / shard)
+        dst = {"w": pt.to_tensor(np.zeros(8, np.float32))}
+        with pytest.warns(UserWarning, match="skipping torn"):
+            loaded = ckpt.resume_latest(dst, str(tmp_path),
+                                        cleanup=True)
+        assert loaded and loaded.endswith("step_1")
+        torn = _series("paddle_tpu_checkpoint_torn_total")
+        assert torn[("skipped",)] == 1
+        assert torn[("quarantined",)] == 1
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer step instrumentation
+# ---------------------------------------------------------------------------
+class TestOptimizerInstrumentation:
+    def test_fused_cache_hit_miss_counters(self):
+        obs.enable()
+        lin = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lin.parameters())
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            (lin(x) ** 2).mean().backward()
+            opt.step()
+            opt.clear_grad()
+        s = _series("paddle_tpu_optimizer_fused_step_total")
+        assert s[("compile",)] == 1          # first signature compiles
+        assert s[("hit",)] == 2              # then the executable reuses
+
+    def test_hyper_mutation_counts_recompile(self):
+        obs.enable()
+        lin = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lin.parameters())
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+
+        def one_step():
+            (lin(x) ** 2).mean().backward()
+            opt.step()
+            opt.clear_grad()
+
+        one_step()
+        opt.beta1 = 0.5          # instance-hyper mutation -> new key
+        one_step()
+        s = _series("paddle_tpu_optimizer_fused_step_total")
+        assert s[("compile",)] == 2

@@ -417,10 +417,15 @@ class TestSelfHealingDataLoader:
         before = _shm_segments()
         serial = _collect(DataLoader(ds, batch_size=4, num_workers=0))
         # hard-exit (SIGKILL-equivalent: no error report, no cleanup)
-        # worker 0 the first time it reaches batch 2
+        # worker 0 the first time it reaches batch 2. The respawn batch
+        # NUMBER is load-dependent — the hard exit can kill the queue's
+        # feeder thread before batch 0's pickle ever reaches the pipe,
+        # in which case the parent (correctly) respawns at batch 0 —
+        # so only the respawn itself is asserted; the real contract is
+        # the batch-exact healed epoch checked below.
         with faults.inject("io.worker.batch", exit_code=1, times=1,
                            match={"bi": 2, "attempt": 0}):
-            with pytest.warns(UserWarning, match="respawning at batch 2"):
+            with pytest.warns(UserWarning, match="respawning at batch"):
                 healed = _collect(DataLoader(ds, batch_size=4,
                                              num_workers=2))
         assert len(healed) == len(serial) == 6
