@@ -553,10 +553,15 @@ def bench_decode_paged(on_tpu):
     # ONE engine across warmup and timing: its compiled prefill/decode
     # executables live on the instance, mirroring how generate() caches
     # its fused loops on the model — both timed runs are compile-free
+    # prefix caching OFF: this config isolates paging vs dense padding;
+    # the warmup/timed runs repeat identical prompts, which caching
+    # would (legitimately) short-circuit — bench that with
+    # --config prefix_serving instead
     eng = LLMEngine(model, max_batch=max_batch, num_blocks=num_blocks,
                     block_size=block_size, decode_chunk=chunk,
                     prompt_quantum=quantum,
-                    max_model_len=cfg.max_position_embeddings)
+                    max_model_len=cfg.max_position_embeddings,
+                    enable_prefix_caching=False)
 
     def run_paged():
         start_tokens = eng.stats["decode_tokens"]
@@ -596,6 +601,98 @@ def bench_decode_paged(on_tpu):
     }
 
 
+def bench_prefix_serving(on_tpu):
+    """Automatic prefix caching on the shared-prefix serving workload
+    it exists for: every request = one shared few-shot prefix + a short
+    per-request tail, driven through LLMEngine with caching ON vs OFF
+    at EQUAL cache HBM (same pool, same blocks — retention only parks
+    pages the free list wasn't using). Both engines are warmed on the
+    workload first (compiles executables; for the caching engine this
+    also seeds the index — the honest steady state, since a serving
+    process keeps its prefix cache across requests), then timed.
+    vs_baseline = cached tokens/s over uncached; extra carries the
+    headline prefill-token reduction."""
+    import jax
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if on_tpu:
+        kw = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                  num_heads=16, max_position_embeddings=2048,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        n_req, max_batch, block_size, chunk = 16, 8, 64, 16
+        prefix_len, tlo, thi, n_new = 512, 8, 32, 64
+        quantum = 128
+    else:
+        kw = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                  num_heads=4, max_position_embeddings=256,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        n_req, max_batch, block_size, chunk = 6, 2, 16, 4
+        prefix_len, tlo, thi, n_new = 32, 2, 6, 8
+        quantum = 16
+    cfg = GPTConfig(**kw)
+    model = GPTForCausalLM(cfg).bfloat16() if on_tpu else \
+        GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size,
+                          (prefix_len,)).astype(np.int32)
+    prompts = [np.concatenate([prefix, rng.integers(
+        0, cfg.vocab_size, (int(t),)).astype(np.int32)])
+        for t in rng.integers(tlo, thi + 1, n_req)]
+
+    def make(enable):
+        return LLMEngine(
+            model, max_batch=max_batch, block_size=block_size,
+            decode_chunk=chunk, prompt_quantum=quantum,
+            max_model_len=cfg.max_position_embeddings,
+            enable_prefix_caching=enable)
+
+    def run(eng):
+        before = dict(eng.stats)
+        for i, p in enumerate(prompts):
+            eng.add_request(i, p, max_new_tokens=n_new)
+        done = 0
+        t0 = time.perf_counter()
+        while eng.has_unfinished:
+            for r in eng.step():
+                done += len(r.output_ids)
+        dt = time.perf_counter() - t0
+        delta = {k: eng.stats[k] - before.get(k, 0) for k in eng.stats}
+        return done, dt, delta
+
+    eng_on, eng_off = make(True), make(False)
+    run(eng_on)                 # compile + seed the prefix index
+    run(eng_off)                # compile
+    tokens_on, t_on, d_on = run(eng_on)
+    tokens_off, t_off, d_off = run(eng_off)
+    tps_on = tokens_on / t_on
+    tps_off = tokens_off / t_off
+    prefill_on = d_on["prefix_cache_miss_tokens"]
+    prefill_off = d_off["prefix_cache_miss_tokens"]
+    return {
+        "metric": "prefix_cache_serving_tokens_per_sec",
+        "value": round(tps_on, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_on / tps_off, 4),
+        "extra": {
+            "uncached_tokens_per_sec": round(tps_off, 1),
+            "prefill_tokens_cached": prefill_on,
+            "prefill_tokens_uncached": prefill_off,
+            "prefill_token_reduction": round(
+                1.0 - prefill_on / max(prefill_off, 1), 4),
+            "prefix_hit_tokens": d_on["prefix_cache_hit_tokens"],
+            "requests": n_req, "shared_prefix_len": prefix_len,
+            "max_batch": max_batch, "block_size": block_size,
+            "num_blocks": eng_on.cache.allocator.num_blocks,
+            "new_tokens": n_new,
+            "device": str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform)),
+        },
+    }
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2_small,
     "gpt1p3b": bench_gpt_1p3b,
@@ -604,6 +701,7 @@ CONFIGS = {
     "dispatch": bench_dispatch,
     "decode": bench_decode,
     "decode_paged": bench_decode_paged,
+    "prefix_serving": bench_prefix_serving,
 }
 
 

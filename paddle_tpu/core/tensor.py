@@ -302,6 +302,17 @@ class Tensor:
         memo[id(self)] = t
         return t
 
+    def __reduce__(self):
+        # pickle via a NUMPY roundtrip, not the jax.Array's own pickle:
+        # the payload is then backend-neutral — a Tensor built in a
+        # JAX_PLATFORMS=cpu DataLoader worker materialises on whatever
+        # device the unpickling parent runs (jax re-imports lazily at
+        # load time). Autograd meta is deliberately dropped: a pickled
+        # tensor crosses a process boundary, where grad graph nodes
+        # have no meaning.
+        return (_rebuild_tensor, (np.asarray(self._data),
+                                  self.stop_gradient, self.name))
+
     def __repr__(self):
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
         return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
@@ -314,6 +325,12 @@ class Tensor:
             yield self[i]
 
     # __getitem__/__setitem__ and math dunders patched in ops/__init__.py
+
+
+def _rebuild_tensor(arr, stop_gradient, name):
+    """Unpickle target of Tensor.__reduce__ (numpy -> device array)."""
+    return Tensor._wrap(jnp.asarray(arr), stop_gradient=stop_gradient,
+                        name=name)
 
 
 def _parse_dev(s):

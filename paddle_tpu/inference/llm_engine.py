@@ -31,6 +31,15 @@ allocator; every device step is ONE cached XLA executable:
     and chunk buckets) so the number of compiled executables stays
     O(log) in every dimension while attention reads scale with the
     CURRENT longest sequence, not the model maximum.
+  * Automatic prefix caching (enable_prefix_caching, default on): full
+    prompt blocks are content-hashed in the PagedKVCache; a request
+    sharing a page-aligned prefix with earlier traffic (system prompt,
+    few-shot template, its own pre-preemption context) leases the
+    already-computed pages at +1 refcount and prefills only its
+    uncached tail through a prefix-resume executable that reads the
+    cached prefix from the pool. Pages of finished sequences park in
+    an LRU, evicted only when an alloc would otherwise fail — greedy
+    outputs are bit-identical with caching on or off.
 """
 from __future__ import annotations
 
@@ -89,6 +98,17 @@ def _metrics():
                 "decode_chunks, decode_tokens, failed/rejected "
                 "requests, deadline_expired) aggregated across engines",
                 ("event",)),
+            "prefix": r.counter(
+                "paddle_tpu_engine_prefix_cache_tokens_total",
+                "prompt tokens served from the prefix cache (hit) vs "
+                "prefilled from scratch (miss), counted at admission",
+                ("outcome",)),
+            "prefix_pages": r.gauge(
+                "paddle_tpu_engine_prefix_cache_pages",
+                "prefix-cache page index occupancy after a step: "
+                "indexed = hash-addressable pages (leased or parked), "
+                "lru = parked cached-but-unreferenced pages",
+                ("state",)),
         }
     return _METRICS
 
@@ -100,10 +120,17 @@ class _EngineStats(dict):
     mirrors the delta onto the process-global
     `paddle_tpu_engine_events_total{event=k}` counter. Mirroring is a
     no-op while observability is disabled — per-engine counts keep
-    working regardless."""
+    working regardless. The prefix-cache token tallies are NOT mirrored:
+    they already land on the dedicated
+    `paddle_tpu_engine_prefix_cache_tokens_total{outcome=}` counter, and
+    double-exporting them would let token volumes swamp the event
+    series."""
+
+    _UNMIRRORED = frozenset(
+        ("prefix_cache_hit_tokens", "prefix_cache_miss_tokens"))
 
     def __setitem__(self, key, value):
-        if _om._ENABLED:
+        if _om._ENABLED and key not in self._UNMIRRORED:
             delta = value - self.get(key, 0)
             if delta > 0:
                 _metrics()["events"].labels(event=key).inc(delta)
@@ -131,6 +158,7 @@ class _Request:                         # ndarray prompts would make
     max_new_tokens: int                      # TOTAL generation budget
     resume_out: List[int] = dataclasses.field(default_factory=list)
     deadline: Optional[float] = None         # absolute monotonic seconds
+    hash_chain: Optional[list] = None        # memoized block_hashes()
 
     @property
     def context_len(self) -> int:
@@ -140,7 +168,7 @@ class _Request:                         # ndarray prompts would make
 
 class _Seq:
     __slots__ = ("rid", "prompt", "max_new", "slot", "length", "out",
-                 "admit_seq", "deadline")
+                 "admit_seq", "deadline", "cached_len")
 
     def __init__(self, req: _Request, slot: int, admit_seq: int):
         self.rid = req.rid
@@ -151,6 +179,7 @@ class _Seq:
         self.out: List[int] = list(req.resume_out)
         self.admit_seq = admit_seq      # monotonic admission order
         self.deadline = req.deadline
+        self.cached_len = 0             # prefix tokens leased from cache
 
     @property
     def token_budget(self) -> int:
@@ -385,11 +414,20 @@ class LLMEngine:
                  max_model_len: Optional[int] = None,
                  decode_chunk: int = 8, prompt_quantum: int = 128,
                  do_sample: bool = False, temperature: float = 1.0,
-                 top_p: float = 1.0, eos_token_id: Optional[int] = None,
+                 top_p: float = 1.0, top_k: int = 0,
+                 eos_token_id: Optional[int] = None,
                  seed: int = 0, kv_quant_scales=None,
                  shed_load: bool = False,
                  max_waiting: Optional[int] = None,
-                 step_timeout_s: Optional[float] = None):
+                 step_timeout_s: Optional[float] = None,
+                 enable_prefix_caching: bool = True):
+        """enable_prefix_caching (default on): full prompt blocks are
+        hash-indexed so requests sharing a page-aligned prefix (system
+        prompts, few-shot templates, multi-turn history) lease the
+        already-computed KV pages and prefill only their tail; pages of
+        finished sequences are retained in an LRU evicted only under
+        pool pressure. Greedy outputs are unchanged either way — set
+        False to force every request to prefill from scratch."""
         cfg = model.config
         self.model = model
         self.fam = _family_for(model)
@@ -406,6 +444,7 @@ class LLMEngine:
         self.do_sample = bool(do_sample)
         self.temperature = float(temperature)
         self.top_p = float(top_p)
+        self.top_k = int(top_k)
         self.eos_token_id = eos_token_id
         self._key = jax.random.PRNGKey(seed)
 
@@ -429,7 +468,9 @@ class LLMEngine:
             num_layers=cfg.num_layers, num_blocks=int(num_blocks),
             kv_heads=self.fam.kv_heads, block_size=self.block_size,
             head_dim=self.fam.head_dim, dtype=cache_dtype,
-            layout="token")
+            layout="token",
+            enable_prefix_caching=bool(enable_prefix_caching))
+        self.enable_prefix_caching = self.cache.enable_prefix_caching
         # the trash page: inactive batch rows point their whole block
         # table here so their (ignored) writes never touch live pages
         self._trash_page = self.cache.allocator.alloc(1)[0]
@@ -455,7 +496,8 @@ class LLMEngine:
         self.stats = _EngineStats(
             preemptions=0, prefills=0, decode_chunks=0,
             decode_tokens=0, failed_requests=0, rejected_requests=0,
-            deadline_expired=0)
+            deadline_expired=0, prefix_cache_hit_tokens=0,
+            prefix_cache_miss_tokens=0)
 
     # -- request lifecycle -------------------------------------------------
     def _reject(self, request_id, prompt, reason: str, exc_type=None):
@@ -517,25 +559,59 @@ class LLMEngine:
                 return i
         return None
 
+    @staticmethod
+    def _merged_tokens(seq_or_req) -> np.ndarray:
+        """prompt + carried output tokens — the context a prefill must
+        (re)build, and the byte string the prefix index is keyed on."""
+        out = getattr(seq_or_req, "resume_out", None)
+        if out is None:
+            out = seq_or_req.out
+        if not out:
+            return seq_or_req.prompt
+        return np.concatenate([seq_or_req.prompt,
+                               np.asarray(out, np.int32)])
+
     def _admit(self) -> List[_Seq]:
         """Admit waiting requests into free slots while context pages
-        fit. Returns the newly admitted (prefill-pending) sequences."""
+        fit. With prefix caching the feasibility check and the lease
+        both account for the request's longest cached page-aligned
+        prefix: matched pages are taken at +1 refcount (parked ones
+        leave the LRU) and only the remainder is freshly allocated.
+        Returns the newly admitted (prefill-pending) sequences."""
         fresh = []
         while self.waiting:
             slot = self._free_slot()
             if slot is None:
                 break
             req = self.waiting[0]
-            need = -(-req.context_len // self.block_size)
-            if need > self.cache.allocator.num_free:
+            merged = self._merged_tokens(req)
+            if self.enable_prefix_caching and req.hash_chain is None:
+                # hash the prompt ONCE per (re)queued request — a head
+                # request blocked on pool pages re-plans every step,
+                # and the chain is immutable in the tokens
+                req.hash_chain = self.cache.block_hashes(merged)
+            plan_cached, feasible, plan_pages = self.cache.prefix_plan(
+                merged, req.context_len, hashes=req.hash_chain)
+            if not feasible:
                 break
             self.waiting.popleft()
             self._admit_counter = getattr(self, "_admit_counter", 0) + 1
             seq = _Seq(req, slot, self._admit_counter)
-            self.cache.add_sequence(seq.rid, req.context_len)
+            ncached = self.cache.add_sequence(
+                seq.rid, req.context_len, tokens=merged,
+                match=(plan_cached, plan_pages))
+            seq.cached_len = ncached
             seq.length = req.context_len
             self.slots[slot] = seq
             fresh.append(seq)
+            self.stats["prefix_cache_hit_tokens"] += ncached
+            self.stats["prefix_cache_miss_tokens"] += \
+                req.context_len - ncached
+            if _om._ENABLED:
+                pm = _metrics()["prefix"]
+                if ncached:
+                    pm.labels(outcome="hit").inc(ncached)
+                pm.labels(outcome="miss").inc(req.context_len - ncached)
         return fresh
 
     def _preempt_one(self, exclude=None) -> bool:
@@ -587,12 +663,31 @@ class LLMEngine:
         for s in seqs:
             faults.fault_point("engine.prefill.seq", rid=s.rid)
         B = self.max_batch
-        merged = [np.concatenate([s.prompt, np.asarray(s.out, np.int32)])
-                  if s.out else s.prompt for s in seqs]
+        merged = [self._merged_tokens(s) for s in seqs]
         plens = [len(m) for m in merged]
-        sb = min(_bucket(max(plens), self.prompt_quantum),
-                 self.max_model_len)
-        npb_pf = -(-sb // self.block_size)
+        starts = [s.cached_len for s in seqs]
+        # COW guard: the suffix write range must not touch shared pages
+        # (a no-op under page-aligned matching, by construction)
+        for s, st in zip(seqs, starts):
+            self.cache.ensure_writable(s.rid, st)
+        # the context bucket governs the write-table width either way
+        sbc = min(_bucket(max(plens), self.prompt_quantum),
+                  self.max_model_len)
+        npb_pf = -(-sbc // self.block_size)
+        if not any(starts):
+            # no cached prefix anywhere: the legacy executable (no pool
+            # read-back) — bit-for-bit the caching-off path
+            nxt = self._call_prefill_full(seqs, merged, sbc, npb_pf)
+        else:
+            nxt = self._call_prefill_prefix(seqs, merged, starts,
+                                            npb_pf)
+        if self.cache.enable_prefix_caching:
+            for s, m in zip(seqs, merged):
+                self.cache.commit_prefix(s.rid, m)
+        return nxt
+
+    def _call_prefill_full(self, seqs, merged, sb, npb_pf) -> List[int]:
+        B = self.max_batch
         ids = np.zeros((B, sb), np.int32)
         plen = np.zeros((B,), np.int32)
         tbl = np.full((B, npb_pf), -1, np.int32)
@@ -608,6 +703,44 @@ class LLMEngine:
             nxt, kcs, vcs = fn([t._data for t in self._tensors], kcs, vcs,
                                jnp.asarray(ids), jnp.asarray(plen),
                                jnp.asarray(tbl), sub)
+            nxt = jax.block_until_ready(nxt)
+        for i in range(self.cache.num_layers):
+            self.cache.update(i, kcs[i], vcs[i])
+        return [int(t) for t in np.asarray(nxt)[:len(seqs)]]
+
+    def _call_prefill_prefix(self, seqs, merged, starts,
+                             npb_pf) -> List[int]:
+        """Prefix-resume prefill: each row computes only its UNCACHED
+        suffix; attention over the cached page-aligned prefix reads the
+        pool through the per-row ownership map (the decode pattern).
+        The suffix length, not the full context, picks the bucket — the
+        FLOPs saved are exactly the cache-hit tokens."""
+        B = self.max_batch
+        NB = self.cache.allocator.num_blocks
+        slens = [len(m) - st for m, st in zip(merged, starts)]
+        sb = min(_bucket(max(slens), self.prompt_quantum),
+                 self.max_model_len)
+        ids = np.zeros((B, sb), np.int32)
+        pstart = np.zeros((B,), np.int32)
+        plen = np.zeros((B,), np.int32)
+        tbl = np.full((B, npb_pf), -1, np.int32)
+        off = np.full((B, NB), -1, np.int32)
+        for r, (s, m, st) in enumerate(zip(seqs, merged, starts)):
+            ids[r, :len(m) - st] = m[st:]
+            pstart[r] = st
+            plen[r] = len(m)
+            pages = self.cache.pages(s.rid)
+            tbl[r, :len(pages)] = pages
+            off[r, pages] = np.arange(len(pages), dtype=np.int32) \
+                * self.block_size
+        fn = self._prefill_prefix_fn(sb, npb_pf)
+        kcs, vcs = self.cache.key_caches, self.cache.value_caches
+        self._key, sub = jax.random.split(self._key)
+        with self._step_watchdog("engine prefill"):
+            nxt, kcs, vcs = fn([t._data for t in self._tensors], kcs, vcs,
+                               jnp.asarray(ids), jnp.asarray(pstart),
+                               jnp.asarray(plen), jnp.asarray(tbl),
+                               jnp.asarray(off), sub)
             nxt = jax.block_until_ready(nxt)
         for i in range(self.cache.num_layers):
             self.cache.update(i, kcs[i], vcs[i])
@@ -708,11 +841,155 @@ class LLMEngine:
                 lg = fam.logits(Tensor._wrap(last))._data[:, -1]
                 nxt, _ = _pick_token(lg.astype(jnp.float32), key,
                                      self.do_sample, self.temperature,
-                                     self.top_p)
+                                     self.top_p, self.top_k)
                 return nxt, new_k, new_v
 
         fn = jax.jit(prefill, donate_argnums=(1, 2))
         self._prefill_fns[(sb, npb_pf)] = fn
+        return fn
+
+    def _prefill_prefix_fn(self, sb: int, npb_pf: int):
+        """Prefix-resume prompt pass: each row starts at its per-row
+        cached offset `pstart` (page-aligned). The suffix's q/k/v are
+        computed fresh and its self-attention stays in registers
+        (exactly the legacy prefill); attention over the cached prefix
+        reads the POOL through the per-row block-ownership map, the
+        same masked whole-pool pattern decode uses. Rows with
+        pstart=0 reduce to the legacy math."""
+        hit = self._prefill_fns.get((sb, npb_pf, "prefix"))
+        if hit is not None:
+            return hit
+        from ..jit import _functional_params
+        from ..autograd import tape as _tape
+        from ..models.generation import _pick_token
+        from ..incubate.nn.functional.serving import _quantize_kv, \
+            _apply_rotary
+        import math as _math
+        fam = self.fam
+        rope = self._rope
+        bs = self.block_size
+        kvH, H_D = self.fam.kv_heads, self.fam.head_dim
+        scale = 1.0 / _math.sqrt(H_D)
+        tensors = self._tensors
+        kq, vq = self._kq, self._vq
+        kdq = None if kq is None else 1.0 / kq
+        vdq = None if vq is None else 1.0 / vq
+        B = self.max_batch
+
+        def prefill(params, kcs, vcs, ids, pstart, plen, tbl, off, key):
+            # ids [B, sb]: suffix tokens; pstart [B]: cached-prefix
+            # length (page-aligned); plen [B]: total context; tbl
+            # [B, npb_pf]: full write table; off [B, NB]: block ->
+            # start position in row b, -1 when not owned
+            with _tape.no_grad(), _functional_params(tensors, params):
+                cdtype = kcs[0].dtype
+                T_pool = kcs[0].shape[0]
+                j = jnp.arange(sb, dtype=jnp.int32)
+                pos = pstart[:, None] + j[None, :]     # [B, sb] absolute
+                slen = plen - pstart
+                live = j[None, :] < slen[:, None]      # [B, sb]
+                x = Tensor._wrap(fam.embed(ids, pos))
+                page = jnp.clip(pos // bs, 0, npb_pf - 1)
+                phys = jnp.maximum(
+                    jnp.take_along_axis(tbl, page, axis=1), 0)
+                # dead tokens (>= row suffix) scatter OOB -> dropped
+                flat = jnp.where(live, phys * bs + pos % bs,
+                                 T_pool).reshape(-1)
+                # pool ownership/position mask is frozen for the pass:
+                # only positions strictly inside the cached prefix
+                toff = jnp.repeat(off, bs, axis=1)     # [B, T_pool]
+                gpos_pool = toff + jnp.tile(
+                    jnp.arange(bs, dtype=jnp.int32),
+                    T_pool // bs)[None, :]
+                pool_ok = (toff >= 0) & (gpos_pool < pstart[:, None])
+                new_k, new_v = [], []
+                for li, layer in enumerate(fam.layers()):
+                    qkv = fam.qkv(layer, Tensor._wrap(
+                        x._data.reshape(B * sb, -1)))
+                    nH = qkv.shape[-1] // H_D - 2 * kvH
+                    rep = nH // kvH
+                    q = qkv[:, :nH * H_D].reshape(B, sb, nH, H_D)
+                    k = qkv[:, nH * H_D:(nH + kvH) * H_D].reshape(
+                        B, sb, kvH, H_D)
+                    v = qkv[:, (nH + kvH) * H_D:].reshape(
+                        B, sb, kvH, H_D)
+                    if rope is not None:
+                        cos = rope[0][pos][:, :, None, :]  # [B,sb,1,D/2]
+                        sin = rope[1][pos][:, :, None, :]
+                        q = _apply_rotary(q, cos, sin, True).astype(
+                            q.dtype)
+                        k = _apply_rotary(k, cos, sin, True).astype(
+                            k.dtype)
+                    if kq is not None:
+                        kw = _quantize_kv(k, kq[li], 1, 127., -127.)
+                        vw = _quantize_kv(v, vq[li], 1, 127., -127.)
+                    else:
+                        kw = k.astype(kcs[li].dtype)
+                        vw = v.astype(vcs[li].dtype)
+                    new_k.append(kcs[li].at[flat].set(
+                        kw.reshape(B * sb, kvH, H_D)))
+                    new_v.append(vcs[li].at[flat].set(
+                        vw.reshape(B * sb, kvH, H_D)))
+                    # suffix self-attention: own k/v still in registers
+                    kr = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+                    vr = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+                    qs = q.astype(jnp.float32) * scale
+                    ss = jnp.einsum("bqhd,bkhd->bhqk",
+                                    qs.astype(q.dtype), kr,
+                                    preferred_element_type=jnp.float32)
+                    ok = (j[None, None, :] <= j[None, :, None]) & \
+                        live[:, None, :]
+                    ss = jnp.where(ok[:, None, :, :], ss, -jnp.inf)
+                    # cached-prefix attention against the pool (read of
+                    # kcs/vcs BEFORE this layer's scatter: prefix pages
+                    # and suffix writes are disjoint rows)
+                    q4 = qs.reshape(B, sb, kvH, rep, H_D)
+                    if cdtype == jnp.int8:
+                        qop = q4
+                        kp = kcs[li].astype(jnp.float32)
+                    else:
+                        qop = q4.astype(cdtype)
+                        kp = kcs[li]
+                    sp = jnp.einsum("bqkrd,tkd->bkrqt", qop, kp,
+                                    preferred_element_type=jnp.float32)
+                    if kdq is not None:
+                        sp = sp * kdq[li][None, :, None, None, None]
+                    sp = sp.reshape(B, nH, sb, T_pool)
+                    sp = jnp.where(pool_ok[:, None, None, :], sp,
+                                   -jnp.inf)
+                    s = jnp.concatenate([sp, ss], axis=-1)
+                    p = jax.nn.softmax(s, axis=-1)
+                    p = jnp.where(jnp.isnan(p), 0.0, p)    # empty rows
+                    pp, psf = p[..., :T_pool], p[..., T_pool:]
+                    pp = pp.reshape(B, kvH, rep, sb, T_pool)
+                    if cdtype == jnp.int8:
+                        vp, ppo = vcs[li].astype(jnp.float32), pp
+                    else:
+                        vp, ppo = vcs[li], pp.astype(cdtype)
+                    o = jnp.einsum("bkrqt,tkd->bqkrd", ppo, vp,
+                                   preferred_element_type=jnp.float32)
+                    if vdq is not None:
+                        o = o * vdq[li][None, None, :, None, None]
+                    o = o.reshape(B, sb, nH * H_D)
+                    o = o + jnp.einsum(
+                        "bhqk,bkhd->bqhd", psf.astype(vr.dtype), vr,
+                        preferred_element_type=jnp.float32).reshape(
+                            B, sb, nH * H_D)
+                    x = fam.attn_out(layer, x,
+                                     o.astype(x._data.dtype))
+                    x = fam.mlp(layer, x)
+                x = fam.final(x)
+                last_idx = jnp.maximum(slen - 1, 0)          # [B]
+                last = jnp.take_along_axis(
+                    x._data, last_idx[:, None, None], axis=1)  # [B,1,h]
+                lg = fam.logits(Tensor._wrap(last))._data[:, -1]
+                nxt, _ = _pick_token(lg.astype(jnp.float32), key,
+                                     self.do_sample, self.temperature,
+                                     self.top_p, self.top_k)
+                return nxt, new_k, new_v
+
+        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        self._prefill_fns[(sb, npb_pf, "prefix")] = fn
         return fn
 
     def _decode_fn(self, chunk: int):
@@ -844,7 +1121,8 @@ class LLMEngine:
                     key, sub = jax.random.split(key)
                     nxt, _ = _pick_token(lg.astype(jnp.float32), sub,
                                          self.do_sample,
-                                         self.temperature, self.top_p)
+                                         self.temperature, self.top_p,
+                                         self.top_k)
                     return (st_k, st_v, nxt, key), nxt
 
                 carry = (st_k, st_v, cur, key)
@@ -908,6 +1186,10 @@ class LLMEngine:
                 raise MemoryError(
                     "paged pool too small for even one sequence's "
                     "decode chunk — enlarge num_blocks")
+            # COW guard: the chunk's write range must not touch pages
+            # other sequences still reference (no-op by construction
+            # under page-aligned prefix matching)
+            self.cache.ensure_writable(s.rid, s.length)
         active = [s for s in self.slots
                   if s is not None and (only is None or s is only)]
         if not active:
@@ -1043,6 +1325,10 @@ class LLMEngine:
             m["pool"].labels(state="free").set(free)
             m["pool"].labels(state="used").set(
                 self.cache.allocator.num_blocks - free)
+            m["prefix_pages"].labels(state="indexed").set(
+                self.cache.cached_pages)
+            m["prefix_pages"].labels(state="lru").set(
+                self.cache.lru_pages)
         return finished
 
     def _step_impl(self) -> List[GenerationResult]:
@@ -1104,6 +1390,17 @@ class LLMEngine:
                 if (self.eos_token_id is not None
                         and int(t) == self.eos_token_id):
                     break
+            if self.cache.enable_prefix_caching:
+                # register newly FILLED full blocks before the sequence
+                # can retire (so its pages park hash-indexed): valid KV
+                # covers prompt + appended tokens, capped at what the
+                # chunk actually wrote. Skip the token-array rebuild
+                # entirely when no block boundary was crossed.
+                ntok = min(seq.length, len(seq.prompt) + len(seq.out))
+                if self.cache.cached_prefix_len(seq.rid) \
+                        + self.block_size <= ntok:
+                    self.cache.commit_prefix(
+                        seq.rid, self._merged_tokens(seq), upto=ntok)
             self._maybe_finish(seq, finished)
         return finished
 

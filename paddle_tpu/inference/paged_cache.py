@@ -9,12 +9,27 @@ lease logical pages from a native C++ free-list allocator
 ctypes) and the manager renders the int32 block tables
 block_multihead_attention consumes. Device arrays never move — only
 the page accounting changes as sequences grow, finish, and new ones
-reuse their blocks."""
+reuse their blocks.
+
+Automatic prefix caching (enable_prefix_caching=True): full token
+blocks are content-addressed with a chained hash (parent digest +
+block tokens, page-aligned), so a new sequence whose prompt shares a
+page-aligned prefix with earlier traffic leases the EXISTING physical
+pages at +1 refcount instead of recomputing their KV. Pages of
+finished sequences are not freed immediately: the last holder's
+reference is parked in an LRU of cached-but-unreferenced pages,
+evicted only when an allocation would otherwise fail — pool pressure
+behaves exactly as without caching. Shared pages are never mutated:
+`ensure_writable` copy-on-writes any page another sequence still
+references before the engine scatters into it.
+"""
 from __future__ import annotations
 
+import collections
 import ctypes
+import hashlib
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -42,6 +57,12 @@ def _load_lib():
     lib.pba_free.argtypes = [ctypes.c_void_p,
                              ctypes.POINTER(ctypes.c_int32),
                              ctypes.c_int32]
+    lib.pba_ref.restype = ctypes.c_int32
+    lib.pba_ref.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_int32),
+                            ctypes.c_int32]
+    lib.pba_refcount.restype = ctypes.c_int32
+    lib.pba_refcount.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.pba_num_free.restype = ctypes.c_int32
     lib.pba_num_free.argtypes = [ctypes.c_void_p]
     _LIB = lib
@@ -49,7 +70,14 @@ def _load_lib():
 
 
 class BlockAllocator:
-    """ctypes facade over the native free-list allocator."""
+    """ctypes facade over the native refcounting free-list allocator.
+
+    `alloc` leases blocks at refcount 1; `ref` adds sharers; `free` is
+    unref (a block returns to the free list at count zero). Invalid
+    mutations — double free, free/ref of an unallocated or out-of-range
+    id, unref'ing a block more times in one call than its refcount —
+    raise ValueError and leave the native free list untouched (the
+    native side validates all-or-nothing before applying anything)."""
 
     def __init__(self, num_blocks: int):
         self._lib = _load_lib()
@@ -68,10 +96,35 @@ class BlockAllocator:
         return list(out[:n])
 
     def free(self, blocks: List[int]) -> int:
+        """Unref `blocks`; returns how many were unref'd (== len).
+        Raises ValueError on double free / unknown id, with nothing
+        applied."""
         if not blocks:
             return 0
         arr = (ctypes.c_int32 * len(blocks))(*blocks)
-        return self._lib.pba_free(self._h, arr, len(blocks))
+        rc = self._lib.pba_free(self._h, arr, len(blocks))
+        if rc < 0:
+            bad = blocks[-rc - 1]
+            raise ValueError(
+                f"invalid free of block {bad}: not allocated, out of "
+                f"range, or freed more times than its refcount "
+                f"({self.refcount(bad)}) allows — nothing was freed")
+        return len(blocks)
+
+    def ref(self, blocks: List[int]) -> None:
+        """Add one reference to each (already allocated) block."""
+        if not blocks:
+            return
+        arr = (ctypes.c_int32 * len(blocks))(*blocks)
+        rc = self._lib.pba_ref(self._h, arr, len(blocks))
+        if rc < 0:
+            raise ValueError(
+                f"invalid ref of block {blocks[-rc - 1]}: not "
+                "allocated or out of range — nothing was ref'd")
+
+    def refcount(self, block: int) -> int:
+        """Current reference count (0 = free; -1 = out of range)."""
+        return self._lib.pba_refcount(self._h, block)
 
     @property
     def num_free(self) -> int:
@@ -95,7 +148,8 @@ class PagedKVCache:
 
     def __init__(self, num_layers: int, num_blocks: int, kv_heads: int,
                  block_size: int, head_dim: int, dtype=jnp.bfloat16,
-                 layout: str = "block"):
+                 layout: str = "block",
+                 enable_prefix_caching: bool = False):
         """layout="block": [num_blocks, kv_heads, block_size, head_dim]
         (the block_multihead_attention operand layout, reference
         contract). layout="token": [num_blocks*block_size, kv_heads,
@@ -103,13 +157,18 @@ class PagedKVCache:
         Token-major exists because a per-row (block, slot) scatter into
         the 4-D layout lowers catastrophically on TPU (measured 134 ms
         vs ~0 ms per decode step for 24 layers x k+v at B=8); a 1-D
-        leading-axis scatter is free. LLMEngine uses "token"."""
+        leading-axis scatter is free. LLMEngine uses "token".
+
+        enable_prefix_caching turns on the content-addressed page index
+        (see module docstring); without it every code path below is
+        byte-for-byte the pre-caching behavior."""
         self.num_layers = num_layers
         self.block_size = block_size
         if layout not in ("block", "token"):
             raise ValueError(f"unknown cache layout {layout!r}")
         self.layout = layout
         self.allocator = BlockAllocator(num_blocks)
+        self.enable_prefix_caching = bool(enable_prefix_caching)
         shape = ((num_blocks * block_size, kv_heads, head_dim)
                  if layout == "token"
                  else (num_blocks, kv_heads, block_size, head_dim))
@@ -119,22 +178,244 @@ class PagedKVCache:
                              for _ in range(num_layers)]
         self._pages: Dict[object, List[int]] = {}
         self._lengths: Dict[object, int] = {}
+        # prefix index: chained block hash -> physical page (and back),
+        # plus the LRU of parked pages (refcount held BY the LRU; park
+        # order == insertion order; a matched page leaves the LRU and
+        # its reference transfers to the leasing sequence)
+        self._hash_to_page: Dict[bytes, int] = {}
+        self._page_hash: Dict[int, bytes] = {}
+        self._lru: "collections.OrderedDict[int, bytes]" = \
+            collections.OrderedDict()
+        # per-live-sequence committed chain (incremental hashing)
+        self._seq_hashes: Dict[object, List[bytes]] = {}
+
+    # -- prefix index ------------------------------------------------------
+    @staticmethod
+    def _block_hash(parent: bytes, block_tokens) -> bytes:
+        """Chained content hash of one FULL token block: the parent
+        chain digest ⊕ this block's tokens — position in the prefix is
+        part of the identity, so equal blocks at different depths never
+        collide."""
+        raw = np.ascontiguousarray(block_tokens, np.int32).tobytes()
+        return hashlib.sha256(parent + raw).digest()
+
+    def block_hashes(self, tokens) -> List[bytes]:
+        """The full chained-hash sequence for `tokens`' matchable
+        blocks ((len-1)//block_size of them). Deterministic in the
+        tokens alone — the engine memoizes it per waiting request so a
+        request blocked at the queue head doesn't re-hash its prompt on
+        every scheduler step."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        out: List[bytes] = []
+        h = b""
+        for i in range(max(0, len(tokens) - 1) // bs):
+            h = self._block_hash(h, tokens[i * bs:(i + 1) * bs])
+            out.append(h)
+        return out
+
+    def match_prefix(self, tokens,
+                     hashes: Optional[List[bytes]] = None
+                     ) -> Tuple[int, List[int]]:
+        """Longest cached page-aligned prefix of `tokens` (peek — no
+        refcounts change). Capped at len(tokens)-1: at least one token
+        is always left to prefill so the engine can sample the first
+        output from real logits. `hashes` may carry a precomputed
+        block_hashes(tokens) chain. Returns (ncached_tokens, pages)."""
+        if not self.enable_prefix_caching:
+            return 0, []
+        if hashes is None:
+            hashes = self.block_hashes(tokens)
+        pages: List[int] = []
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        return len(pages) * self.block_size, pages
+
+    def prefix_plan(self, tokens, total_tokens: int,
+                    hashes: Optional[List[bytes]] = None
+                    ) -> Tuple[int, bool, List[int]]:
+        """Admission feasibility under prefix caching: (ncached_tokens,
+        feasible, matched_pages). Fresh pages needed = total pages −
+        matched pages; matched pages that are currently PARKED don't
+        count as evictable headroom (leasing them removes them from the
+        LRU). The returned pages can be handed straight to
+        `add_sequence(match=...)` so admission hashes the prompt once."""
+        need = -(-total_tokens // self.block_size)
+        if not self.enable_prefix_caching or tokens is None:
+            return 0, need <= self.allocator.num_free, []
+        ncached, pages = self.match_prefix(tokens, hashes)
+        parked_matched = sum(1 for p in pages if p in self._lru)
+        avail = (self.allocator.num_free + len(self._lru)
+                 - parked_matched)
+        return ncached, need - len(pages) <= avail, pages
+
+    def _lease_prefix(self, tokens, match=None):
+        """match_prefix + take the references: parked pages leave the
+        LRU (their reference transfers to the caller), active pages
+        gain one. `match`: a (ncached, pages) pair from an immediately
+        preceding peek (same cache state), to skip re-hashing."""
+        ncached, pages = (self.match_prefix(tokens) if match is None
+                          else match)
+        hashes: List[bytes] = [self._page_hash[p] for p in pages]
+        for p in pages:
+            if p in self._lru:
+                del self._lru[p]            # ref ownership transfers
+            else:
+                self.allocator.ref([p])
+        return ncached, pages, hashes
+
+    def _release_pages(self, pages: List[int]) -> None:
+        """Drop one reference per page. A page this sequence was the
+        last holder of is PARKED in the LRU when it is hash-indexed
+        (prefix caching retention); otherwise it returns to the free
+        list. Non-parked pages free in ONE native call — with caching
+        off this is exactly the old single batched pba_free."""
+        if not self.enable_prefix_caching:
+            self.allocator.free(pages)
+            return
+        unref = []
+        for p in pages:
+            h = self._page_hash.get(p)
+            if h is not None and self.allocator.refcount(p) == 1:
+                self._lru[p] = h            # LRU inherits the ref
+            else:
+                unref.append(p)
+        self.allocator.free(unref)
+
+    def _alloc(self, n: int) -> List[int]:
+        """Allocate n blocks, evicting least-recently-parked cached
+        pages only when the free list alone cannot satisfy the request
+        — under pressure the pool behaves exactly as without caching."""
+        free = self.allocator.num_free
+        while free < n and self._lru:
+            page, h = self._lru.popitem(last=False)
+            del self._hash_to_page[h]
+            del self._page_hash[page]
+            self.allocator.free([page])
+            free += 1
+        return self.allocator.alloc(n)
+
+    def commit_prefix(self, seq_id, tokens, upto: Optional[int] = None
+                      ) -> None:
+        """Register this sequence's FULL, fully-written blocks in the
+        prefix index. `tokens` is the sequence's token array (prompt +
+        generated); `upto` caps how many leading tokens have valid KV
+        in the pool (defaults to all of `tokens`, bounded by the leased
+        length). Idempotent and incremental — already-committed blocks
+        are skipped via the per-sequence chain. First content writer
+        wins: a hash already mapped to another physical page is not
+        re-registered (the duplicate page stays private)."""
+        if not self.enable_prefix_caching:
+            return
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = len(tokens) if upto is None else min(int(upto), len(tokens))
+        n = min(n, self._lengths[seq_id])
+        pages = self._pages[seq_id]
+        hashes = self._seq_hashes.setdefault(seq_id, [])
+        bs = self.block_size
+        n_full = min(n // bs, len(pages))
+        for i in range(len(hashes), n_full):
+            parent = hashes[i - 1] if i else b""
+            h = self._block_hash(parent, tokens[i * bs:(i + 1) * bs])
+            hashes.append(h)
+            page = pages[i]
+            if h in self._hash_to_page or page in self._page_hash:
+                continue
+            self._hash_to_page[h] = page
+            self._page_hash[page] = h
+
+    def ensure_writable(self, seq_id, from_token: int) -> None:
+        """Copy-on-write guard: every page backing token positions
+        >= from_token must be exclusively owned and unindexed before
+        the engine scatters into it. A page other sequences still
+        reference is copied into a fresh block (device-level row copy
+        in every layer) and swapped into this sequence's page table; an
+        exclusively-owned but hash-indexed page is unindexed (the write
+        invalidates its content hash). Page-aligned prefix matching
+        makes this a no-op on the engine's normal paths — it exists so
+        ANY future write pattern stays refcount-correct."""
+        if not self.enable_prefix_caching:
+            return
+        pages = self._pages[seq_id]
+        start = max(0, int(from_token)) // self.block_size
+        hashes = self._seq_hashes.get(seq_id)
+        if hashes is not None:
+            del hashes[start:]      # chain diverges at the first write
+        for i in range(start, len(pages)):
+            p = pages[i]
+            if self.allocator.refcount(p) > 1:
+                (fresh,) = self._alloc(1)
+                self._copy_block(p, fresh)
+                self._release_pages([p])
+                pages[i] = fresh
+            elif p in self._page_hash:
+                h = self._page_hash.pop(p)
+                self._hash_to_page.pop(h, None)
+                self._lru.pop(p, None)
+
+    def _copy_block(self, src: int, dst: int) -> None:
+        bs = self.block_size
+        for caches in (self.key_caches, self.value_caches):
+            for li in range(self.num_layers):
+                arr = caches[li]
+                if self.layout == "token":
+                    caches[li] = arr.at[dst * bs:(dst + 1) * bs].set(
+                        arr[src * bs:(src + 1) * bs])
+                else:
+                    caches[li] = arr.at[dst].set(arr[src])
+
+    # -- capacity views ----------------------------------------------------
+    @property
+    def available_blocks(self) -> int:
+        """Blocks an alloc could obtain: truly free + evictable parked
+        pages. Equals allocator.num_free when prefix caching is off."""
+        return self.allocator.num_free + len(self._lru)
+
+    @property
+    def cached_pages(self) -> int:
+        """Pages currently hash-indexed (leased by sequences or parked)."""
+        return len(self._page_hash)
+
+    @property
+    def lru_pages(self) -> int:
+        """Parked cached-but-unreferenced pages awaiting reuse/eviction."""
+        return len(self._lru)
 
     # -- sequence lifecycle --
-    def add_sequence(self, seq_id, num_tokens: int = 0) -> None:
+    def add_sequence(self, seq_id, num_tokens: int = 0,
+                     tokens=None, match=None) -> int:
+        """Register a sequence and lease pages for `num_tokens`. With
+        prefix caching and `tokens` (the int32 context the pages will
+        hold), the longest cached page-aligned prefix is leased from
+        the index first and only the remainder is freshly allocated;
+        `match` may carry a (ncached, pages) result from an immediately
+        preceding `prefix_plan`/`match_prefix` on the same state to
+        avoid re-hashing. Returns the number of prefix tokens leased
+        from cache (0 without caching)."""
         if seq_id in self._pages:
             raise ValueError(f"sequence {seq_id!r} already exists")
-        self._pages[seq_id] = []
-        self._lengths[seq_id] = 0
-        if num_tokens:
+        ncached, leased, hashes = 0, [], []
+        if self.enable_prefix_caching and tokens is not None \
+                and num_tokens:
+            ncached, leased, hashes = self._lease_prefix(tokens, match)
+        self._pages[seq_id] = list(leased)
+        self._lengths[seq_id] = ncached
+        self._seq_hashes[seq_id] = list(hashes)
+        if num_tokens > ncached:
             try:
-                self.extend(seq_id, num_tokens)
+                self.extend(seq_id, num_tokens - ncached)
             except MemoryError:
                 # roll back the registration so the scheduler can retry
                 # the same seq_id once blocks free up
-                del self._pages[seq_id]
+                pages = self._pages.pop(seq_id)
                 del self._lengths[seq_id]
+                del self._seq_hashes[seq_id]
+                self._release_pages(pages)
                 raise
+        return ncached
 
     def extend(self, seq_id, num_tokens: int) -> None:
         """Lease enough pages for `num_tokens` more tokens."""
@@ -142,15 +423,21 @@ class PagedKVCache:
         new_len = self._lengths[seq_id] + num_tokens
         need = -(-new_len // self.block_size) - len(pages)
         if need > 0:
-            pages.extend(self.allocator.alloc(need))
+            pages.extend(self._alloc(need))
         self._lengths[seq_id] = new_len
 
     def free_sequence(self, seq_id) -> None:
-        self.allocator.free(self._pages.pop(seq_id))
+        pages = self._pages.pop(seq_id)
         del self._lengths[seq_id]
+        self._seq_hashes.pop(seq_id, None)
+        self._release_pages(pages)
 
     def length(self, seq_id) -> int:
         return self._lengths[seq_id]
+
+    def cached_prefix_len(self, seq_id) -> int:
+        """Committed-chain length in tokens (full blocks only)."""
+        return len(self._seq_hashes.get(seq_id, ())) * self.block_size
 
     def pages(self, seq_id) -> List[int]:
         """The physical block ids this sequence currently leases."""

@@ -349,9 +349,8 @@ class DataLoader:
                 f"DataLoader(num_workers={self.num_workers}): {detail} "
                 "— falling back to in-process thread workers (GIL-bound "
                 "for python transforms). Define the dataset and "
-                "collate_fn at module level (and return numpy, not "
-                "framework Tensors, from collate_fn) to enable process "
-                "workers.", UserWarning, stacklevel=4)
+                "collate_fn at module level to enable process workers.",
+                UserWarning, stacklevel=4)
             self._spawn_picklable_result = False
             return False
 
@@ -364,10 +363,12 @@ class DataLoader:
                 "dataset/collate_fn is not picklable for spawned worker "
                 f"processes ({type(e).__name__}: {e})")
         if custom is not None:
-            # the collate OUTPUT must survive the queue pickle too —
-            # framework Tensors (fine in the thread tier) define no
-            # pickle protocol, and that must demote to threads up
-            # front, not explode at runtime in a worker
+            # the collate OUTPUT must survive the queue pickle too.
+            # Framework Tensors are fine since they gained a pickle
+            # protocol (numpy roundtrip, Tensor.__reduce__): a worker-
+            # side Tensor re-materialises through the parent's jax
+            # runtime at unpickle time, so Tensor-returning collate_fns
+            # keep the process tier.
             from . import _process_worker as PW
             sample_out = None
             try:
@@ -392,12 +393,6 @@ class DataLoader:
                 pass    # dataset errors surface in the worker, with
                         # a real traceback — not the probe's business
             if sample_out is not None:
-                if PW._has_tensor_leaves(sample_out):
-                    return fallback(
-                        "collate_fn output contains framework Tensors, "
-                        "which the thread tier handles natively but a "
-                        "spawned worker would have to rebuild through "
-                        "its own jax runtime")
                 try:
                     pickle.dumps(PW._strip_ndarrays(sample_out))
                 except Exception as e:

@@ -92,22 +92,6 @@ def _pack(obj, segments):
     return obj
 
 
-def _has_tensor_leaves(obj) -> bool:
-    """True if a collate output contains framework Tensors (duck-typed
-    `_data` + `numpy`, keeping this module importable without
-    paddle_tpu/jax). The parent's probe demotes such loaders to the
-    thread tier: the thread tier handles Tensors natively, while a
-    spawned worker would have to materialise them through its own
-    full jax runtime just to re-serialise them."""
-    if hasattr(obj, "_data") and hasattr(obj, "numpy"):
-        return True
-    if isinstance(obj, (list, tuple)):
-        return any(_has_tensor_leaves(x) for x in obj)
-    if isinstance(obj, dict):
-        return any(_has_tensor_leaves(v) for v in obj.values())
-    return False
-
-
 def _strip_ndarrays(obj):
     """Replace ndarray leaves with None — what's left is what a batch
     payload would pickle onto the queue (ndarrays either ride a

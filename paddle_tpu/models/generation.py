@@ -152,16 +152,22 @@ def _family(model):
         "models a cached decode path in models/generation.py")
 
 
-def _pick_token(lf, key, do_sample, temperature, top_p):
-    """Greedy / temperature+top-p token selection — the ONE sampling
-    implementation shared by the eager path and the fused scan body (so
-    the fused/eager conformance property can't silently drift).
-    lf: [b, vocab] f32 logits. Returns (next_ids [b] int32, key')."""
+def _pick_token(lf, key, do_sample, temperature, top_p, top_k=0):
+    """Greedy / temperature+top-k+top-p token selection — the ONE
+    sampling implementation shared by the eager path, the fused scan
+    body, and the LLMEngine prefill/decode executables (so the
+    conformance properties can't silently drift).
+    lf: [b, vocab] f32 logits. top_k=0 disables the top-k filter;
+    top_k=1 is exactly greedy. Returns (next_ids [b] int32, key')."""
     b = lf.shape[0]
     if not do_sample:
         return jnp.argmax(lf, axis=-1).astype(jnp.int32), key
     key, sub = jax.random.split(key)
     lt = lf / max(temperature, 1e-6)
+    if top_k and 0 < top_k < lt.shape[-1]:
+        # mask everything below the k-th largest logit per row
+        kth = jax.lax.top_k(lt, int(top_k))[0][..., -1:]
+        lt = jnp.where(lt < kth, -jnp.inf, lt)
     probs = jax.nn.softmax(lt, axis=-1)
     if top_p < 1.0:
         _, picked = ops.top_p_sampling(
@@ -174,7 +180,7 @@ def _pick_token(lf, key, do_sample, temperature, top_p):
 
 
 def _build_fused_loop(model, fwd_fn, do_sample, temperature, top_p,
-                      eos_id, n_steps):
+                      eos_id, n_steps, top_k=0):
     """The ENTIRE decode loop as ONE jitted executable: a `lax.scan`
     whose body is the whole per-token step (embed -> all blocks -> head
     -> sample -> cache/out writeback), with the KV caches and the output
@@ -199,7 +205,7 @@ def _build_fused_loop(model, fwd_fn, do_sample, temperature, top_p,
                     model, Tensor._wrap(nxt[:, None]), caches, pos)
                 lf = logits._data[:, -1].astype(jnp.float32)
                 nxt_new, key2 = _pick_token(lf, key, do_sample,
-                                            temperature, top_p)
+                                            temperature, top_p, top_k)
                 if eos_id is not None:
                     finished = finished | (nxt == eos_id)
                     nxt_new = jnp.where(finished, eos_id, nxt_new)
@@ -233,15 +239,17 @@ def _build_fused_prefill(model, fwd_fn):
 
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
-             temperature=1.0, top_p=1.0, eos_token_id=None, seed=None,
-             use_fused_step=True):
+             temperature=1.0, top_p=1.0, top_k=0, eos_token_id=None,
+             seed=None, use_fused_step=True):
     """Greedy / nucleus-sampling decode for GPT-family causal LMs.
 
     input_ids: [b, prompt_len] int Tensor/array. Returns [b, prompt_len +
     max_new_tokens] int32 (positions after an eos stay eos).
-    use_fused_step=True runs each decode step as ONE donated-buffer
-    jitted executable (see _build_fused_loop); False keeps the per-op
-    eager path (used by the conformance test).
+    top_k > 0 keeps only the k highest logits before top-p/softmax
+    (top_k=1 reproduces greedy). use_fused_step=True runs each decode
+    step as ONE donated-buffer jitted executable (see
+    _build_fused_loop); False keeps the per-op eager path (used by the
+    conformance test).
     """
     cache_builder, fwd_fn, emb_dtype = _family(model)
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
@@ -286,7 +294,8 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             logits, caches = fwd_fn(model, Tensor._wrap(ids), caches, 0)
             logits_arr = logits._data
         nxt, key = _pick_token(logits_arr[:, -1].astype(jnp.float32),
-                               key, do_sample, temperature, top_p)
+                               key, do_sample, temperature, top_p,
+                               top_k)
 
         out = jnp.concatenate(
             [ids, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1)
@@ -305,14 +314,15 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             n_bucket = min(((n_real + 31) // 32) * 32,
                            max_len - prompt_len)
             ck = (do_sample, float(temperature), float(top_p),
-                  eos_token_id, n_bucket)
+                  int(top_k), eos_token_id, n_bucket)
             steps = model.__dict__.setdefault("_fused_decode_steps", {})
             if ck not in steps:
                 if len(steps) >= 8:      # LRU-bound the loop cache
                     steps.pop(next(iter(steps)))
                 steps[ck] = _build_fused_loop(model, fwd_fn, do_sample,
                                               temperature, top_p,
-                                              eos_token_id, n_bucket)
+                                              eos_token_id, n_bucket,
+                                              top_k)
             else:
                 steps[ck] = steps.pop(ck)    # refresh recency
             fused, tensors = steps[ck]
@@ -332,7 +342,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                     model, Tensor._wrap(nxt[:, None]), caches, pos)
                 nxt, key = _pick_token(
                     logits._data[:, -1].astype(jnp.float32), key,
-                    do_sample, temperature, top_p)
+                    do_sample, temperature, top_p, top_k)
                 if eos_token_id is not None:
                     nxt = jnp.where(finished, eos_token_id, nxt)
                 out = out.at[:, prompt_len + step].set(nxt)

@@ -54,8 +54,9 @@ def test_engine_greedy_matches_generate(tiny_gpt):
         assert r.finish_reason == "length"
     # max_batch=2 with 4 prompts forces queueing + slot reuse
     assert eng.stats["prefills"] >= 4
-    # every page went back to the pool (only the trash page stays out)
-    assert eng.cache.allocator.num_free == eng.cache.allocator.num_blocks - 1
+    # every page is back in circulation — free, or parked reusable in
+    # the prefix-cache LRU (only the trash page stays leased)
+    assert eng.cache.available_blocks == eng.cache.allocator.num_blocks - 1
 
 
 def test_engine_llama_family(tiny_llama):
@@ -91,7 +92,7 @@ def test_engine_preemption_recovers(tiny_gpt):
         np.testing.assert_array_equal(r.output_ids,
                                       _oracle(model, p, n_new))
     assert eng.stats["preemptions"] >= 1
-    assert eng.cache.allocator.num_free == eng.cache.allocator.num_blocks - 1
+    assert eng.cache.available_blocks == eng.cache.allocator.num_blocks - 1
 
 
 def test_engine_admission_control(tiny_gpt):

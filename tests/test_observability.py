@@ -399,6 +399,7 @@ class TestEngineInstrumentation:
         assert sorted(eng.stats) == [
             "deadline_expired", "decode_chunks", "decode_tokens",
             "failed_requests", "preemptions", "prefills",
+            "prefix_cache_hit_tokens", "prefix_cache_miss_tokens",
             "rejected_requests"]
         # nothing leaked into the (disabled) registry
         ev = _series("paddle_tpu_engine_events_total")

@@ -34,9 +34,13 @@ class TestBlockAllocator:
         a = BlockAllocator(4)
         blks = a.alloc(2)
         assert a.free(blks) == 2
-        assert a.free(blks) == 0        # second free is a no-op
-        assert a.free([99, -1]) == 0    # out-of-range rejected
-        assert a.num_free == 4
+        with pytest.raises(ValueError, match="invalid free"):
+            a.free(blks)                # double free raises...
+        with pytest.raises(ValueError, match="invalid free"):
+            a.free([99])                # ...as does out-of-range...
+        with pytest.raises(ValueError, match="invalid free"):
+            a.free([-1])
+        assert a.num_free == 4          # ...with the free list intact
 
     def test_concurrent_alloc_free(self):
         import threading

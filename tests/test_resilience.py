@@ -140,7 +140,7 @@ class TestEngineResilience:
         assert r.ok and len(r.output_ids) == 20
         np.testing.assert_array_equal(r.output_ids,
                                       _oracle(tiny_gpt, prompt, 20))
-        assert eng.cache.allocator.num_free == 5
+        assert eng.cache.available_blocks == 5
 
     def test_poisoned_decode_isolated(self, tiny_gpt):
         """Injected OOM at decode: the poisoned request is failed and
@@ -162,7 +162,7 @@ class TestEngineResilience:
             np.testing.assert_array_equal(
                 done[k].output_ids, _oracle(tiny_gpt, prompts[k], 8))
         # the failed request's pages went back to the pool
-        assert eng.cache.allocator.num_free == \
+        assert eng.cache.available_blocks == \
             eng.cache.allocator.num_blocks - 1
         assert eng.stats["failed_requests"] == 1
 
@@ -181,7 +181,7 @@ class TestEngineResilience:
         assert done["good"].ok
         np.testing.assert_array_equal(done["good"].output_ids,
                                       _oracle(tiny_gpt, pg, 6))
-        assert eng.cache.allocator.num_free == \
+        assert eng.cache.available_blocks == \
             eng.cache.allocator.num_blocks - 1
 
     def test_deadline_evicted_while_neighbor_finishes(self, tiny_gpt):
@@ -203,7 +203,7 @@ class TestEngineResilience:
         assert done["neighbor"].ok
         np.testing.assert_array_equal(done["neighbor"].output_ids,
                                       _oracle(tiny_gpt, pn, 8))
-        assert eng.cache.allocator.num_free == \
+        assert eng.cache.available_blocks == \
             eng.cache.allocator.num_blocks - 1
         assert eng.stats["deadline_expired"] == 1
 
@@ -393,8 +393,8 @@ class EnvGuardDs(ShmDs):
 
 def tensor_collate(batch):
     """Module-level (itself spawn-picklable) collate returning framework
-    Tensors — the OUTPUT probe must demote the loader to thread workers
-    up front instead of dragging a jax runtime into every worker."""
+    Tensors — Tensor.__reduce__ (numpy roundtrip) makes the OUTPUT
+    spawn-picklable, so the loader keeps the process tier."""
     xs, ys = zip(*batch)
     return (pt.to_tensor(np.stack(xs)), pt.to_tensor(np.asarray(ys)))
 
@@ -472,17 +472,27 @@ class TestSelfHealingDataLoader:
                                   num_workers=2))
         assert len(out) == 2
 
-    def test_tensor_collate_falls_back_to_threads(self):
+    def test_tensor_collate_stays_on_process_tier(self):
+        """Tensor-returning collate_fns used to demote to the thread
+        tier (Tensors had no pickle protocol); Tensor.__reduce__ lifted
+        that — the probe must accept them, spawn real workers, and the
+        batches must round-trip the worker->parent queue exactly."""
         ds = ShmDs(n=8)
         loader = DataLoader(ds, batch_size=4, num_workers=2,
                             collate_fn=tensor_collate)
-        with pytest.warns(UserWarning,
-                          match="collate_fn output contains framework"):
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
             out = _collect(loader)
+        assert not [w for w in caught
+                    if "falling back" in str(w.message)], \
+            "Tensor collate demoted to the thread tier"
+        assert loader._spawn_picklable_result is True
         assert len(out) == 2
-        serial = _collect(DataLoader(ds, batch_size=4, num_workers=0))
-        for (sx, _), (px, _) in zip(serial, out):
+        serial = _collect(DataLoader(ds, batch_size=4, num_workers=0,
+                                     collate_fn=tensor_collate))
+        for (sx, sy), (px, py) in zip(serial, out):
             np.testing.assert_array_equal(sx, px)
+            np.testing.assert_array_equal(sy, py)
 
 
 # ---------------------------------------------------------------------------

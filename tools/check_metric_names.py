@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Static check: every `paddle_tpu_*` observability series registered
+in the codebase follows the naming conventions (README "Observability")
+and is documented in the README series table.
+
+Conventions enforced:
+  * every series name starts with the `paddle_tpu_` prefix
+  * monotonic counters end in `_total`
+  * histograms carry a base unit suffix (`_seconds` or `_bytes`)
+  * gauges do NOT end in `_total` (that suffix promises monotonicity)
+  * every registered name appears VERBATIM in README.md (the
+    observability table lists full names, so operators can grep)
+
+Run from the repo root (or pass it):  python tools/check_metric_names.py
+Exit code 0 = clean; 1 = violations (printed one per line).
+Wired into tier-1 via tests/test_prefix_cache.py so a new series can't
+land undocumented or misnamed.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+# a registration is `<registry>.counter("name", ...)` etc. — the name
+# literal may sit on the following line (the codebase wraps at 72)
+_REG_RE = re.compile(
+    r'\.(counter|gauge|histogram)\(\s*"([A-Za-z0-9_]+)"')
+
+_UNIT_SUFFIXES = ("_seconds", "_bytes")
+
+
+def collect_series(root: str) -> List[Tuple[str, str, str]]:
+    """[(kind, name, relpath)] for every metric registration under
+    `root`/paddle_tpu (tests excluded — they register fixtures)."""
+    found = []
+    pkg = os.path.join(root, "paddle_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for kind, name in _REG_RE.findall(text):
+                found.append((kind, name,
+                              os.path.relpath(path, root)))
+    return sorted(set(found))
+
+
+def check(series: List[Tuple[str, str, str]],
+          readme_text: str) -> List[str]:
+    """Returns the list of violations (empty = clean)."""
+    problems = []
+    for kind, name, path in series:
+        where = f"{name} ({kind}, {path})"
+        if not name.startswith("paddle_tpu_"):
+            problems.append(
+                f"{where}: series must carry the paddle_tpu_ prefix")
+            continue
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"{where}: counters are monotonic and must end _total")
+        if kind == "gauge" and name.endswith("_total"):
+            problems.append(
+                f"{where}: gauges must NOT end _total (reserved for "
+                "monotonic counters)")
+        if kind == "histogram" and not name.endswith(_UNIT_SUFFIXES):
+            problems.append(
+                f"{where}: histograms must carry a base-unit suffix "
+                f"({' or '.join(_UNIT_SUFFIXES)})")
+        if name not in readme_text:
+            problems.append(
+                f"{where}: not documented in the README observability "
+                "table (add the FULL series name)")
+    return problems
+
+
+def main(root: str = None) -> int:
+    root = root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    series = collect_series(root)
+    if not series:
+        print("check_metric_names: found no registrations — wrong root?")
+        return 1
+    with open(os.path.join(root, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    problems = check(series, readme)
+    for p in problems:
+        print(f"VIOLATION: {p}")
+    if not problems:
+        kinds: Dict[str, int] = {}
+        for kind, _, _ in series:
+            kinds[kind] = kinds.get(kind, 0) + 1
+        detail = ", ".join(f"{v} {k}s" for k, v in sorted(kinds.items()))
+        print(f"check_metric_names: {len(series)} series clean ({detail})")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))
