@@ -38,6 +38,29 @@ def _device_lookup(device, table, default):
     return default
 
 
+def _request_latency_percentiles():
+    """Per-request TTFT/TPOT tail latency (ms) from the observability
+    registry — serving benches attach this so the perf trajectory
+    captures tails, not just throughput. None when observability is
+    off (--no-obs) or no request finished in this window. Cumulative
+    over the config's obs window (includes the warmup pass — the
+    steady-state tail is what serving cares about anyway)."""
+    from paddle_tpu import observability as obs
+    if not obs.enabled():
+        return None
+    hists = obs.summary().get("histograms", {})
+    out = {}
+    for key, name in (("ttft", "paddle_tpu_request_ttft_seconds"),
+                      ("tpot", "paddle_tpu_request_tpot_seconds")):
+        entry = hists.get(name)
+        if not entry:
+            continue
+        out[f"{key}_p50_ms"] = round(entry["p50"] * 1e3, 3)
+        out[f"{key}_p95_ms"] = round(entry["p95"] * 1e3, 3)
+        out[f"{key}_n"] = entry["count"]
+    return out or None
+
+
 def peak_flops(device) -> float:
     return _device_lookup(device, PEAK_BF16_FLOPS, 197e12)  # v5e default
 
@@ -597,6 +620,7 @@ def bench_decode_paged(on_tpu):
             "num_blocks": num_blocks, "block_size": block_size,
             "decode_chunk": chunk,
             "engine_stats": stats,
+            "request_latency": _request_latency_percentiles(),
         },
     }
 
@@ -687,6 +711,7 @@ def bench_prefix_serving(on_tpu):
             "max_batch": max_batch, "block_size": block_size,
             "num_blocks": eng_on.cache.allocator.num_blocks,
             "new_tokens": n_new,
+            "request_latency": _request_latency_percentiles(),
             "device": str(getattr(jax.devices()[0], "device_kind",
                                   jax.devices()[0].platform)),
         },

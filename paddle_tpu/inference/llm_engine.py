@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import flight as _fl
 from ..observability import metrics as _om
 from ..observability import tracing as _ot
 from ..resilience import faults
@@ -109,8 +110,79 @@ def _metrics():
                 "indexed = hash-addressable pages (leased or parked), "
                 "lru = parked cached-but-unreferenced pages",
                 ("state",)),
+            # -- request-scoped SLO series (one observation per
+            # request-lifecycle event; request identity stays in trace
+            # spans, never in labels) --
+            "ttft": r.histogram(
+                "paddle_tpu_request_ttft_seconds",
+                "per-request time to first token: enqueue -> first "
+                "sampled token (includes queue wait and prefill)"),
+            "tpot": r.histogram(
+                "paddle_tpu_request_tpot_seconds",
+                "per-request mean inter-token latency over the decode "
+                "phase, observed once per finished request"),
+            "queue_wait": r.histogram(
+                "paddle_tpu_request_queue_wait_seconds",
+                "time from (re)enqueue to admission into a batch slot "
+                "(observed per admission, incl. post-preemption "
+                "resumes)"),
+            "e2e": r.histogram(
+                "paddle_tpu_request_e2e_seconds",
+                "end-to-end latency of successfully finished requests "
+                "(enqueue -> eos/length)"),
+            "req_finished": r.counter(
+                "paddle_tpu_request_finished_total",
+                "terminal request outcomes by finish_reason",
+                ("reason",)),
+            # -- HBM telemetry (compile telemetry: the shared
+            # _om.compile_metrics() registration) --
+            "hbm_pool": r.gauge(
+                "paddle_tpu_hbm_page_pool_bytes",
+                "paged KV pool HBM after a step: reserved = the whole "
+                "pool allocation, used = currently leased pages",
+                ("state",)),
+            "hbm_live": r.gauge(
+                "paddle_tpu_hbm_live_array_bytes",
+                "total bytes of live jax arrays in the process, "
+                "sampled at engine step boundaries (throttled to at "
+                "most one walk per second)"),
         }
+        _METRICS["compiles"], _METRICS["compile_time"] = \
+            _om.compile_metrics()
     return _METRICS
+
+
+class _CompileTimed:
+    """First-call timing shim around a freshly built jit executable:
+    jax traces+compiles synchronously on the first invocation, so that
+    call's wall time IS the compile cost (one async-dispatched
+    execution rides along). Records compile count + wall time by
+    executable family, once; afterwards the shim is one attribute
+    check per call."""
+
+    __slots__ = ("fn", "family", "pending")
+
+    def __init__(self, fn, family: str):
+        self.fn = fn
+        self.family = family
+        self.pending = True
+
+    def __call__(self, *args):
+        if not self.pending:
+            return self.fn(*args)
+        t0 = time.perf_counter()
+        out = self.fn(*args)
+        # cleared only on success: a first call that raises (watchdog,
+        # injected fault) leaves the compile un-recorded, and the
+        # retry — which pays the compile again or hits jax's cache —
+        # records it instead of losing the count
+        self.pending = False
+        if _om._ENABLED:
+            m = _metrics()
+            m["compiles"].labels(family=self.family).inc()
+            m["compile_time"].labels(family=self.family).observe(
+                time.perf_counter() - t0)
+        return out
 
 
 class _EngineStats(dict):
@@ -159,6 +231,15 @@ class _Request:                         # ndarray prompts would make
     resume_out: List[int] = dataclasses.field(default_factory=list)
     deadline: Optional[float] = None         # absolute monotonic seconds
     hash_chain: Optional[list] = None        # memoized block_hashes()
+    # request-scoped observability: one trace per request lifetime —
+    # the ids and timestamps survive preemption/requeue so the resumed
+    # spans join the ORIGINAL trace and TTFT/e2e stay anchored at the
+    # first enqueue
+    trace_id: Optional[str] = None
+    root_span: Optional[str] = None
+    t_enq: float = 0.0                       # first enqueue (perf_counter)
+    t_queued: float = 0.0                    # latest (re)enqueue
+    t_first: Optional[float] = None          # first token landed
 
     @property
     def context_len(self) -> int:
@@ -168,7 +249,8 @@ class _Request:                         # ndarray prompts would make
 
 class _Seq:
     __slots__ = ("rid", "prompt", "max_new", "slot", "length", "out",
-                 "admit_seq", "deadline", "cached_len")
+                 "admit_seq", "deadline", "cached_len", "trace_id",
+                 "root_span", "t_enq", "t_first")
 
     def __init__(self, req: _Request, slot: int, admit_seq: int):
         self.rid = req.rid
@@ -180,6 +262,10 @@ class _Seq:
         self.admit_seq = admit_seq      # monotonic admission order
         self.deadline = req.deadline
         self.cached_len = 0             # prefix tokens leased from cache
+        self.trace_id = req.trace_id    # request trace (see _Request)
+        self.root_span = req.root_span
+        self.t_enq = req.t_enq
+        self.t_first = req.t_first
 
     @property
     def token_budget(self) -> int:
@@ -474,6 +560,12 @@ class LLMEngine:
         # the trash page: inactive batch rows point their whole block
         # table here so their (ignored) writes never touch live pages
         self._trash_page = self.cache.allocator.alloc(1)[0]
+        # pool HBM is fixed at construction (update() swaps buffers of
+        # identical shape/dtype) — computed once for the step gauges
+        self._pool_bytes = \
+            sum(k.nbytes for k in self.cache.key_caches) \
+            + sum(v.nbytes for v in self.cache.value_caches)
+        self._hbm_sampled_at = -1.0
         self._rope = (self.fam.rope_tables(self.max_model_len)
                       if self.fam.needs_rope else None)
 
@@ -500,12 +592,39 @@ class LLMEngine:
             prefix_cache_miss_tokens=0)
 
     # -- request lifecycle -------------------------------------------------
+    def _finish_obs(self, rid, reason: str, trace_id, root_span,
+                    t_enq: float, t_first, n_out: int) -> None:
+        """Terminal accounting every finish path funnels through:
+        outcome counter, e2e / TPOT observations (successful requests
+        only — failures would poison the latency SLOs), and the
+        request's ROOT span covering enqueue -> finish, which parents
+        every lifecycle event recorded along the way."""
+        if not (_om._ENABLED or _ot._ENABLED):
+            return
+        t_fin = time.perf_counter()
+        if _om._ENABLED:
+            m = _metrics()
+            m["req_finished"].labels(reason=reason).inc()
+            if reason in ("eos", "length"):
+                m["e2e"].observe(t_fin - t_enq)
+                if t_first is not None and n_out > 1:
+                    m["tpot"].observe((t_fin - t_first) / (n_out - 1))
+        if _ot._ENABLED and trace_id is not None:
+            _ot.add_event(
+                "request", t_enq * 1e6, (t_fin - t_enq) * 1e6,
+                trace=(trace_id, root_span, None),
+                args={"request_id": str(rid), "finish_reason": reason})
+
     def _reject(self, request_id, prompt, reason: str, exc_type=None):
         """Load-shedding admission: record a rejected result instead of
         crashing the caller (shed_load=True), or raise (legacy)."""
         if not self.shed_load:
             raise (exc_type or RuntimeError)(reason)
         self.stats["rejected_requests"] += 1
+        trace_id = _ot.new_trace_id() if _ot._ENABLED else None
+        root = _ot.new_span_id() if _ot._ENABLED else None
+        self._finish_obs(request_id, "rejected", trace_id, root,
+                         time.perf_counter(), None, 0)
         self._failed.append(GenerationResult(
             request_id=request_id, prompt_ids=prompt,
             output_ids=np.zeros((0,), np.int32),
@@ -543,9 +662,17 @@ class LLMEngine:
                 f"({self.max_waiting})", RuntimeError)
         deadline = (self._now() + deadline_s
                     if deadline_s is not None else None)
+        # one trace per request lifetime (ids only when tracing is on;
+        # the timestamps are two perf_counter reads either way — SLO
+        # accounting needs them if metrics get enabled mid-flight)
+        trace_id = _ot.new_trace_id() if _ot._ENABLED else None
+        root = _ot.new_span_id() if _ot._ENABLED else None
+        t_now = time.perf_counter()
         self.waiting.append(_Request(request_id, prompt,
                                      int(max_new_tokens),
-                                     deadline=deadline))
+                                     deadline=deadline,
+                                     trace_id=trace_id, root_span=root,
+                                     t_enq=t_now, t_queued=t_now))
 
     @property
     def has_unfinished(self) -> bool:
@@ -608,10 +735,23 @@ class LLMEngine:
             self.stats["prefix_cache_miss_tokens"] += \
                 req.context_len - ncached
             if _om._ENABLED:
-                pm = _metrics()["prefix"]
+                m = _metrics()
+                pm = m["prefix"]
                 if ncached:
                     pm.labels(outcome="hit").inc(ncached)
                 pm.labels(outcome="miss").inc(req.context_len - ncached)
+                m["queue_wait"].observe(
+                    time.perf_counter() - req.t_queued)
+            if _ot._ENABLED and req.trace_id is not None:
+                now = time.perf_counter()
+                _ot.add_event(
+                    "request.queue_wait", req.t_queued * 1e6,
+                    (now - req.t_queued) * 1e6,
+                    trace=(req.trace_id, _ot.new_span_id(),
+                           req.root_span),
+                    args={"request_id": str(req.rid),
+                          "resumed": bool(req.resume_out),
+                          "cached_tokens": ncached})
         return fresh
 
     def _preempt_one(self, exclude=None) -> bool:
@@ -629,9 +769,19 @@ class LLMEngine:
         self.stats["preemptions"] += 1
         self.cache.free_sequence(victim.rid)
         self.slots[victim.slot] = None
+        now = time.perf_counter()
+        if _ot._ENABLED and victim.trace_id is not None:
+            _ot.add_event(
+                "request.preempt", now * 1e6, 0.0,
+                trace=(victim.trace_id, _ot.new_span_id(),
+                       victim.root_span),
+                args={"request_id": str(victim.rid),
+                      "generated": len(victim.out)})
         self.waiting.appendleft(_Request(
             victim.rid, victim.prompt, victim.max_new,
-            resume_out=list(victim.out), deadline=victim.deadline))
+            resume_out=list(victim.out), deadline=victim.deadline,
+            trace_id=victim.trace_id, root_span=victim.root_span,
+            t_enq=victim.t_enq, t_queued=now, t_first=victim.t_first))
         return True
 
     def _grow(self, seq: _Seq, by: int) -> bool:
@@ -655,7 +805,21 @@ class LLMEngine:
         t0 = time.perf_counter()
         with _ot.span("engine.prefill", seqs=len(seqs)):
             out = self._run_prefills_impl(seqs)
-        _metrics()["prefill"].observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        _metrics()["prefill"].observe(t1 - t0)
+        if _ot._ENABLED:
+            # per-request attribution of the batched pass: each
+            # sequence gets a child event in ITS trace spanning the
+            # executable call it rode in
+            for s in seqs:
+                if s.trace_id is None or self.slots[s.slot] is not s:
+                    continue
+                _ot.add_event(
+                    "request.prefill", t0 * 1e6, (t1 - t0) * 1e6,
+                    trace=(s.trace_id, _ot.new_span_id(), s.root_span),
+                    args={"request_id": str(s.rid),
+                          "cached_tokens": s.cached_len,
+                          "prefill_tokens": s.length - s.cached_len})
         return out
 
     def _run_prefills_impl(self, seqs: List[_Seq]) -> List[int]:
@@ -844,7 +1008,8 @@ class LLMEngine:
                                      self.top_p, self.top_k)
                 return nxt, new_k, new_v
 
-        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        fn = _CompileTimed(jax.jit(prefill, donate_argnums=(1, 2)),
+                           "engine_prefill")
         self._prefill_fns[(sb, npb_pf)] = fn
         return fn
 
@@ -988,7 +1153,8 @@ class LLMEngine:
                                      self.top_p, self.top_k)
                 return nxt, new_k, new_v
 
-        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        fn = _CompileTimed(jax.jit(prefill, donate_argnums=(1, 2)),
+                           "engine_prefix_resume")
         self._prefill_fns[(sb, npb_pf, "prefix")] = fn
         return fn
 
@@ -1143,7 +1309,8 @@ class LLMEngine:
                     for li in range(L)]
                 return new_k, new_v, jnp.transpose(toks)   # [B, chunk]
 
-        fn = jax.jit(decode, donate_argnums=(1, 2))
+        fn = _CompileTimed(jax.jit(decode, donate_argnums=(1, 2)),
+                           "engine_decode")
         self._decode_fns[chunk] = fn
         return fn
 
@@ -1157,7 +1324,19 @@ class LLMEngine:
         with _ot.span("engine.decode_chunk"):
             out = self._run_decode_chunk_impl(only)
         if out:     # skip empty calls (no active slots)
-            _metrics()["decode"].observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            _metrics()["decode"].observe(t1 - t0)
+            if _ot._ENABLED:
+                for slot in out:
+                    s = self.slots[slot]
+                    if s is None or s.trace_id is None:
+                        continue
+                    _ot.add_event(
+                        "request.decode_chunk", t0 * 1e6,
+                        (t1 - t0) * 1e6,
+                        trace=(s.trace_id, _ot.new_span_id(),
+                               s.root_span),
+                        args={"request_id": str(s.rid)})
         return out
 
     def _run_decode_chunk_impl(self, only: Optional[_Seq] = None
@@ -1253,6 +1432,9 @@ class LLMEngine:
         self.stats["failed_requests"] += 1
         self.cache.free_sequence(seq.rid)
         self.slots[seq.slot] = None
+        self._finish_obs(seq.rid, finish_reason, seq.trace_id,
+                         seq.root_span, seq.t_enq, seq.t_first,
+                         len(seq.out))
         finished.append(GenerationResult(
             request_id=seq.rid, prompt_ids=seq.prompt,
             output_ids=np.asarray(seq.out, np.int32),
@@ -1268,6 +1450,13 @@ class LLMEngine:
             self.waiting.remove(req)
             self.stats["deadline_expired"] += 1
             self.stats["failed_requests"] += 1
+            self._finish_obs(req.rid, "deadline", req.trace_id,
+                             req.root_span, req.t_enq, req.t_first,
+                             len(req.resume_out))
+            if _fl._ARMED:
+                _fl.trigger("deadline_miss", detail={
+                    "request_id": str(req.rid), "where": "queued",
+                    "overrun_s": now - req.deadline})
             finished.append(GenerationResult(
                 request_id=req.rid, prompt_ids=req.prompt,
                 output_ids=np.asarray(req.resume_out, np.int32),
@@ -1277,6 +1466,10 @@ class LLMEngine:
         for seq in [s for s in self.slots if s is not None]:
             if seq.deadline is not None and now >= seq.deadline:
                 self.stats["deadline_expired"] += 1
+                if _fl._ARMED:
+                    _fl.trigger("deadline_miss", detail={
+                        "request_id": str(seq.rid), "where": "running",
+                        "overrun_s": now - seq.deadline})
                 self._fail_seq(seq, "deadline expired mid-generation",
                                "deadline", finished)
 
@@ -1313,22 +1506,58 @@ class LLMEngine:
         finished sequences. Returns results finished this step —
         including failed/rejected/expired ones (check `.ok`)."""
         t0 = time.perf_counter()
-        with _ot.span("engine.step"):
+        pre0 = self.stats["preemptions"] if _fl._ARMED else 0
+        with _ot.span("engine.step") as sp:
             finished = self._step_impl()
+        dt = time.perf_counter() - t0
         if _om._ENABLED:
             m = _metrics()
-            m["step"].observe(time.perf_counter() - t0)
+            m["step"].observe(dt)
             m["queue"].labels(queue="waiting").set(len(self.waiting))
             m["queue"].labels(queue="running").set(
                 sum(s is not None for s in self.slots))
             free = self.cache.allocator.num_free
+            nb = self.cache.allocator.num_blocks
             m["pool"].labels(state="free").set(free)
-            m["pool"].labels(state="used").set(
-                self.cache.allocator.num_blocks - free)
+            m["pool"].labels(state="used").set(nb - free)
             m["prefix_pages"].labels(state="indexed").set(
                 self.cache.cached_pages)
             m["prefix_pages"].labels(state="lru").set(
                 self.cache.lru_pages)
+            # HBM telemetry at the step boundary: the pool allocation
+            # is the engine's dominant persistent HBM, live-array bytes
+            # the whole process footprint (weights + pool + staging).
+            # The live-array walk is O(all buffers in the process), so
+            # it is throttled to one walk per second — the footprint
+            # moves far slower than the step cadence, and an every-step
+            # walk would skew the step-latency histogram it sits next to
+            m["hbm_pool"].labels(state="reserved").set(self._pool_bytes)
+            m["hbm_pool"].labels(state="used").set(
+                self._pool_bytes * (nb - free) // max(nb, 1))
+            now = time.perf_counter()
+            if now - self._hbm_sampled_at >= 1.0:
+                live = getattr(jax, "live_arrays", None)
+                if live is not None:
+                    m["hbm_live"].set(
+                        sum(getattr(a, "nbytes", 0) for a in live()))
+                self._hbm_sampled_at = now
+        if _fl._ARMED:
+            cfg = _fl.config()
+            thr = cfg.step_latency_threshold_s if cfg else None
+            storm = cfg.preempt_storm if cfg else None
+            if thr is not None and dt > thr:
+                _fl.trigger("step_latency", detail={
+                    "step_seconds": dt, "threshold_s": thr,
+                    "trace_id": sp.trace_id, "span_id": sp.span_id},
+                    extra={"engine_stats": dict(self.stats)})
+            elif storm and \
+                    self.stats["preemptions"] - pre0 >= storm:
+                _fl.trigger("preempt_storm", detail={
+                    "preemptions_in_step":
+                        self.stats["preemptions"] - pre0,
+                    "threshold": storm,
+                    "trace_id": sp.trace_id, "span_id": sp.span_id},
+                    extra={"engine_stats": dict(self.stats)})
         return finished
 
     def _step_impl(self) -> List[GenerationResult]:
@@ -1343,6 +1572,11 @@ class LLMEngine:
             for seq, first in self._safe_prefills(fresh, finished):
                 seq.out.append(first)
                 self.stats["decode_tokens"] += 1
+                if seq.t_first is None:     # resumed seqs keep theirs
+                    seq.t_first = time.perf_counter()
+                    if _om._ENABLED:
+                        _metrics()["ttft"].observe(
+                            seq.t_first - seq.t_enq)
                 self._maybe_finish(seq, finished)
         try:
             chunk_out = self._run_decode_chunk()
@@ -1410,10 +1644,13 @@ class LLMEngine:
         done_len = len(seq.out) >= seq.max_new
         if not (done_eos or done_len):
             return
+        reason = "eos" if done_eos else "length"
+        self._finish_obs(seq.rid, reason, seq.trace_id, seq.root_span,
+                         seq.t_enq, seq.t_first, len(seq.out))
         finished.append(GenerationResult(
             request_id=seq.rid, prompt_ids=seq.prompt,
             output_ids=np.asarray(seq.out, np.int32),
-            finish_reason="eos" if done_eos else "length"))
+            finish_reason=reason))
         self.cache.free_sequence(seq.rid)
         self.slots[seq.slot] = None
 

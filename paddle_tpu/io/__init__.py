@@ -19,6 +19,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..core.generator import default_generator
 from ..observability import metrics as _om
+from ..observability import tracing as _ot
 
 # process-global DataLoader metrics (handles cached: the disabled path
 # through any of them is one module-flag check inside inc/observe)
@@ -47,6 +48,18 @@ def _io_metrics():
                 "copied out of /dev/shm"),
         }
     return _IO_METRICS
+
+
+def _merge_farewell(payload) -> None:
+    """Fold a spawned worker's farewell observability payload into the
+    parent: metric snapshot merges additively, worker-side trace
+    events append to the parent ring verbatim (their pid distinguishes
+    them in exports; perf_counter is CLOCK_MONOTONIC on Linux, so the
+    timestamps interleave correctly)."""
+    if not payload:
+        return
+    _om.registry().merge(payload.get("metrics"))
+    _ot.ingest(payload.get("trace"))
 
 
 class Dataset:
@@ -453,9 +466,10 @@ class DataLoader:
         # env is deliberately NOT mutated here: a temporary
         # process-wide JAX_PLATFORMS=cpu would race any concurrent
         # first-time jax init in the parent and silently pin it to CPU.)
-        # workers inherit the parent's observability flag at spawn time
-        # and ship their metric snapshots back with the "done" farewell
-        obs_on = _om._ENABLED
+        # workers inherit the parent's observability flags at spawn
+        # time and ship their metric snapshots + trace events back
+        # with the "done" farewell
+        obs_on = (_om._ENABLED, _ot._ENABLED)
 
         def spawn(w, resume_from=0, attempt=0):
             p = ctx.Process(
@@ -548,9 +562,8 @@ class DataLoader:
                             f"DataLoader worker {tag} failed:\n{payload}")
                     if kind == "done":
                         # finished worker's farewell (its successor may
-                        # still owe batches): merge its metric snapshot
-                        if payload:
-                            _om.registry().merge(payload)
+                        # still owe batches): merge its metrics + trace
+                        _merge_farewell(payload)
                         continue
                     assert kind == "batch", (kind, tag, bi)
                     if tag < bi:    # stale duplicate after a restart
@@ -591,11 +604,11 @@ class DataLoader:
                         break
                     if kind == "batch":
                         PW.discard(payload)
-                    elif kind == "done" and payload:
+                    elif kind == "done":
                         # the common race: the worker's farewell (with
-                        # its metrics snapshot) lands after the parent
+                        # its metrics + trace) lands after the parent
                         # consumed the last batch — merge it here
-                        _om.registry().merge(payload)
+                        _merge_farewell(payload)
 
     def _iter_buffered(self):
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)

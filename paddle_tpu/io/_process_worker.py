@@ -196,10 +196,12 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
     — exposed in the fault context so a chaos kill can target only the
     first life (match={"bi": 2, "attempt": 0}) and let the respawn
     survive.
-    obs_enabled: the parent's observability flag at spawn time — when
-    set, this worker records its own produce-latency/batch metrics and
-    ships a registry snapshot back with its "done" farewell; the parent
-    merges it (worker metrics survive the spawn boundary the same way
+    obs_enabled: the parent's (metrics_on, tracing_on) observability
+    flags at spawn time (a bare bool means metrics only) — when set,
+    this worker records its own produce-latency/batch metrics and
+    per-batch trace events and ships {"metrics": snapshot, "trace":
+    events} back with its "done" farewell; the parent merges both
+    (worker observability survives the spawn boundary the same way
     fault specs cross it). A worker killed before its farewell loses
     its (partial) series — its replacement recounts the recomputed
     batches."""
@@ -213,20 +215,29 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
         dataset, collate_fn, worker_init_fn = pickle.loads(payload_bytes)
         from ..resilience import faults
         faults.install(fault_specs)
-        wm = None
-        if obs_enabled:
+        metrics_on, tracing_on = (
+            obs_enabled if isinstance(obs_enabled, tuple)
+            else (obs_enabled, False))
+        wm = wt = None
+        if metrics_on or tracing_on:
             from ..observability import metrics as _om
-            _om.enable()
-            r = _om.registry()
-            wm = {
-                "produce": r.histogram(
-                    "paddle_tpu_dataloader_worker_batch_seconds",
-                    "worker-side dataset load + collate + shm pack "
-                    "time per batch"),
-                "batches": r.counter(
-                    "paddle_tpu_dataloader_worker_batches_total",
-                    "batches produced by spawned DataLoader workers"),
-            }
+            from ..observability import tracing as _otr
+            if tracing_on:
+                _otr.enable()
+                wt = _otr
+            if metrics_on:
+                _om.enable()
+                r = _om.registry()
+                wm = {
+                    "produce": r.histogram(
+                        "paddle_tpu_dataloader_worker_batch_seconds",
+                        "worker-side dataset load + collate + shm pack "
+                        "time per batch"),
+                    "batches": r.counter(
+                        "paddle_tpu_dataloader_worker_batches_total",
+                        "batches produced by spawned DataLoader "
+                        "workers"),
+                }
         global _WORKER_INFO
         import types
         _WORKER_INFO = types.SimpleNamespace(
@@ -241,7 +252,7 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
                 return
             faults.fault_point("io.worker.batch", wid=wid, bi=bi,
                                attempt=attempt)
-            t_produce = _time.perf_counter() if wm else 0.0
+            t_produce = _time.perf_counter() if (wm or wt) else 0.0
             samples = [dataset[i] for i in idx_batches[bi]]
             batch = collate(samples)
             segments = []
@@ -261,6 +272,14 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
             if wm:
                 wm["produce"].observe(_time.perf_counter() - t_produce)
                 wm["batches"].inc()
+            if wt:
+                # trace event per produced batch, recorded IN this
+                # process (its pid); ships with the farewell
+                t_done = _time.perf_counter()
+                wt.add_event("io.worker.batch", t_produce * 1e6,
+                             (t_done - t_produce) * 1e6,
+                             args={"wid": wid, "bi": bi,
+                                   "attempt": attempt})
             placed = False
             while not stop_event.is_set():
                 try:
@@ -282,9 +301,13 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
         # instant it consumes the last batch, and that common race must
         # not drop the farewell (the parent's post-join drain merges it)
         snap = None
-        if wm is not None:
-            from ..observability import metrics as _om
-            snap = _om.registry().snapshot()
+        if wm is not None or wt is not None:
+            snap = {}
+            if wm is not None:
+                from ..observability import metrics as _om
+                snap["metrics"] = _om.registry().snapshot()
+            if wt is not None:
+                snap["trace"] = wt.events()
         while True:
             try:
                 out_queue.put(("done", wid, snap), timeout=0.2)

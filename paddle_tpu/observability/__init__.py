@@ -1,23 +1,35 @@
 """Unified observability: metrics registry + structured tracing +
-runtime instrumentation (see README "Observability").
+request-scoped lifecycle instrumentation (see README "Observability"
+and "Request tracing & SLOs").
 
 The subsystem is the connective tissue the serving/perf work reads its
 numbers from. Built-in instrumentation (recorded only while enabled):
 
 * `inference.LLMEngine` — step latency, prefill / decode-chunk timing
-  histograms, waiting/running queue-depth and page-pool gauges, and
-  every `engine.stats` counter mirrored as
-  `paddle_tpu_engine_events_total{event=...}`.
+  histograms, waiting/running queue-depth and page-pool gauges, every
+  `engine.stats` counter mirrored as
+  `paddle_tpu_engine_events_total{event=...}`, per-request
+  TTFT / TPOT / queue-wait / e2e latency histograms
+  (`paddle_tpu_request_*_seconds`), compile counters + wall-time by
+  executable family, and HBM gauges sampled at step boundaries. Every
+  request's admission → queue wait → prefill → decode chunks →
+  preemption/resume → finish forms ONE connected trace (shared
+  trace_id, parented to a per-request root span).
 * `io.DataLoader` — batch wait latency (consumer side), worker batch
-  produce latency + batch counts (recorded IN spawned workers and
-  merged into the parent registry when each worker finishes), worker
-  restarts, SharedMemory bytes transported / in flight.
+  produce latency + batch counts AND worker-side trace events
+  (recorded IN spawned workers and merged into the parent when each
+  worker finishes), worker restarts, SharedMemory bytes.
 * `distributed.checkpoint` — save/restore duration, shard bytes, torn
   checkpoints skipped/quarantined by `resume_latest`.
 * `optimizer` fused step — executable-cache hits / compiles (misses) /
-  eager fallbacks.
+  eager fallbacks, plus compile wall time.
 * `profiler.RecordEvent` — routed through the same trace ring buffer,
   so both exporters see one event stream.
+
+Sub-surfaces: `observability.slo` (declarative latency objectives
+evaluated from the registry), `observability.flight` (anomaly flight
+recorder — atomic metrics+trace bundles on slow steps, deadline
+misses, preemption storms, fault-point fires, SLO breaches).
 
 Quick start::
 
@@ -31,25 +43,29 @@ Quick start::
 submodules expose the flags separately for finer control
 (`obs.metrics.enable()`, `obs.tracing.enable()`). Everything is
 process-global; `snapshot()` / `merge()` carry metrics across spawn
-boundaries (the DataLoader does this automatically for its workers).
-"""
+boundaries (the DataLoader does this automatically for its workers,
+shipping trace events alongside)."""
 from __future__ import annotations
 
-from . import metrics, tracing  # noqa: F401
+from . import flight, metrics, slo, tracing  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, registry,
     DEFAULT_BUCKETS,
 )
 from .tracing import (  # noqa: F401
-    span, export_chrome_trace, export_jsonl,
+    span, current_trace, trace_context, export_chrome_trace,
+    export_jsonl,
 )
+from .slo import SLO  # noqa: F401
 
 __all__ = [
     "enable", "disable", "enabled", "registry", "snapshot", "merge",
-    "reset", "to_prometheus", "to_json", "span", "trace_events",
-    "trace_clear", "export_chrome_trace", "export_jsonl", "summary",
-    "metrics", "tracing", "Counter", "Gauge", "Histogram",
-    "MetricsRegistry", "DEFAULT_BUCKETS",
+    "reset", "to_prometheus", "to_json", "span", "current_trace",
+    "trace_context", "trace_events", "trace_clear",
+    "export_chrome_trace", "export_jsonl", "summary",
+    "metrics", "tracing", "slo", "flight", "SLO",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_BUCKETS",
 ]
 
 
@@ -77,7 +93,11 @@ def merge(snap: dict) -> None:
 
 
 def reset() -> None:
-    """Zero every metric series and drop buffered trace events."""
+    """Full observable-state reset: zero every metric series AND drop
+    every buffered trace event — the two stores move together so a
+    fresh measurement window never mixes old spans with new counters
+    (pinned by test_reset_clears_metrics_and_trace_ring). Use
+    `trace_clear()` for the narrow ring-only clear."""
     registry().reset()
     tracing.clear()
 
@@ -95,14 +115,17 @@ def trace_events() -> list:
 
 
 def trace_clear() -> None:
+    """Drop buffered trace events only (metrics keep counting)."""
     tracing.clear()
 
 
 def summary() -> dict:
     """Compact summary for machine consumers (bench.py attaches this to
     BENCH json): non-zero counters/gauges as flat `name{k=v}` keys and
-    per-histogram {count, sum, mean, min, max}. Small by construction —
-    bucket vectors stay out; use to_prometheus()/to_json() for those."""
+    per-histogram {count, sum, mean, min, max, p50, p95} — the
+    percentile estimates come from the bucket vectors
+    (metrics.quantile_from_buckets), which stay out of the summary
+    themselves; use to_prometheus()/to_json() for those."""
     out = {"counters": {}, "gauges": {}, "histograms": {}}
     for name, rec in snapshot().items():
         for key, val in sorted(rec["series"].items()):
@@ -110,13 +133,20 @@ def summary() -> dict:
                 f"{k}={v}" for k, v in zip(rec["labelnames"], key)) + "}"
             if rec["kind"] == "histogram":
                 if val["count"]:
-                    out["histograms"][lbl] = {
+                    entry = {
                         "count": val["count"],
                         "sum": round(val["sum"], 6),
                         "mean": round(val["sum"] / val["count"], 6),
                         "min": round(val["min"], 6),
                         "max": round(val["max"], 6),
                     }
+                    for pname, q in (("p50", 0.5), ("p95", 0.95)):
+                        est = metrics.quantile_from_buckets(
+                            rec["buckets"], val["buckets"], q,
+                            lo=val["min"], hi=val["max"])
+                        if est is not None:
+                            entry[pname] = round(est, 6)
+                    out["histograms"][lbl] = entry
             elif val:
                 out["counters" if rec["kind"] == "counter"
                     else "gauges"][lbl] = val

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "enable", "disable", "enabled", "DEFAULT_BUCKETS",
+    "quantile_from_buckets", "fraction_le",
 ]
 
 # module-global so instrumented call sites pay exactly one attribute
@@ -136,6 +137,16 @@ class _HistogramChild:
         if v > self._max:
             self._max = v
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile of the recorded values (bucket linear
+        interpolation clamped to the observed min/max; None when
+        empty). An ESTIMATE: resolution is the bucket grid — size the
+        buckets for the latencies you care about."""
+        if self._count == 0:
+            return None
+        return quantile_from_buckets(self._bounds, self._buckets, q,
+                                     lo=self._min, hi=self._max)
+
     @property
     def value(self) -> dict:
         return {
@@ -148,6 +159,73 @@ class _HistogramChild:
 
 _CHILD_FOR = {"counter": _CounterChild, "gauge": _GaugeChild,
               "histogram": _HistogramChild}
+
+
+# ---------------------------------------------------------------------------
+# bucket math: quantile / fraction estimators shared by
+# Histogram.quantile, obs.summary(), the SLO evaluator and tools that
+# work from exported snapshots (tools/obs_top.py). Prometheus
+# histogram_quantile semantics — linear interpolation inside the
+# containing bucket — tightened with the tracked min/max so estimates
+# never leave the observed range (and the +Inf bucket has a finite
+# answer).
+# ---------------------------------------------------------------------------
+def quantile_from_buckets(bounds, counts, q, lo=None, hi=None
+                          ) -> Optional[float]:
+    """Estimate the q-quantile from cumulative-izable bucket counts.
+    bounds: upper bucket bounds (len n); counts: per-bucket counts
+    (len n+1, last = +Inf overflow); lo/hi: observed min/max used to
+    clamp the interpolation. Returns None when there are no samples."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if acc + c >= rank:
+            b_lo = 0.0 if i == 0 else float(bounds[i - 1])
+            b_hi = float(bounds[i]) if i < len(bounds) else \
+                (hi if hi is not None else float(bounds[-1]))
+            if hi is not None:
+                b_hi = min(b_hi, hi)
+            if b_hi < b_lo:
+                b_hi = b_lo
+            frac = (rank - acc) / c
+            est = b_lo + (b_hi - b_lo) * min(max(frac, 0.0), 1.0)
+            if lo is not None:
+                est = max(est, lo)
+            if hi is not None:
+                est = min(est, hi)
+            return est
+        acc += c
+    return hi if hi is not None else float(bounds[-1])
+
+
+def fraction_le(bounds, counts, v, hi=None) -> Optional[float]:
+    """Estimated fraction of observations <= v (the SLO attainment
+    read): exact at bucket bounds, linearly interpolated inside the
+    containing bucket. hi: the observed max — lets a v past it count
+    the +Inf overflow bucket as fully attained instead of
+    conservatively exceeded. None when there are no samples."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    v = float(v)
+    acc = 0.0
+    for i, c in enumerate(counts):
+        b_lo = 0.0 if i == 0 else float(bounds[i - 1])
+        b_hi = float(bounds[i]) if i < len(bounds) else math.inf
+        if v >= b_hi or (b_hi == math.inf
+                         and hi is not None and v >= hi):
+            acc += c
+            continue
+        if v > b_lo and b_hi != math.inf:
+            acc += c * (v - b_lo) / (b_hi - b_lo)
+        return min(acc / total, 1.0)
+    return min(acc / total, 1.0)
 
 
 # ---------------------------------------------------------------------------
@@ -205,6 +283,11 @@ class _Metric:
 
     def observe(self, v: float):
         self._require_default().observe(v)
+
+    def quantile(self, q: float):
+        """Histogram only: q-quantile estimate of the default
+        (unlabeled) series; use .labels(...).quantile(q) per series."""
+        return self._require_default().quantile(q)
 
     def _require_default(self):
         if self._default is None:
@@ -413,3 +496,25 @@ def registry() -> MetricsRegistry:
     """The process-global registry every built-in instrumentation
     records into."""
     return _GLOBAL
+
+
+def compile_metrics() -> Tuple[Counter, Histogram]:
+    """(counter, histogram) parents for the process-wide executable
+    compile telemetry, labeled by family. ONE registration site shared
+    by every reporter (LLMEngine bucket caches, the fused optimizer
+    step) — the registry dedups on name but compares only
+    kind/labels/buckets, so duplicated help literals would drift
+    silently."""
+    return (
+        _GLOBAL.counter(
+            "paddle_tpu_compile_total",
+            "XLA executables compiled, by executable family (engine "
+            "bucket caches, fused optimizer); entries beyond the "
+            "steady-state bucket set are recompiles",
+            ("family",)),
+        _GLOBAL.histogram(
+            "paddle_tpu_compile_seconds",
+            "wall time of each executable's compiling first call "
+            "(trace + XLA compile dominated), by family",
+            ("family",)),
+    )

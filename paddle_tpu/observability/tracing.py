@@ -12,6 +12,25 @@ Events are stored directly in chrome-trace "complete event" shape —
 microseconds on the monotonic `time.perf_counter_ns` clock — so export
 is a dump, not a conversion.
 
+Trace context (request-scoped observability): every recorded span
+carries three IDs — `trace_id` (one per causal tree, 16 hex chars),
+`span_id` (one per span, 8 hex chars) and `parent_id` (the enclosing
+span's span_id, absent at the root). Propagation is contextvar-based,
+so nesting works across threads-with-context and plain call stacks
+alike: a span opened inside another span joins its trace automatically;
+a span opened at top level starts a fresh trace. `span(...,
+request_id=...)` stamps the request attribution into the event args
+(IDs are for structure, args for attribution — per-request cardinality
+never becomes a metric label). `current_trace()` exposes the ambient
+(trace_id, span_id) so non-span events can be attributed to the live
+trace, and `trace_context(trace_id, span_id)` adopts an EXISTING trace
+— how the LLMEngine stitches one request's admission / prefill /
+decode / preemption / finish events into a single connected tree even
+though they happen in different engine steps. `ingest()` appends
+events recorded in another process (the DataLoader farewell ships
+worker rings to the parent; perf_counter is CLOCK_MONOTONIC on Linux,
+so child timestamps order correctly against the parent's).
+
 Cost model: `span()` returns a shared no-op singleton when tracing is
 disabled (zero allocation on the hot path); when enabled, one small
 object + one dict per finished span, into a deque bounded at
@@ -19,6 +38,7 @@ object + one dict per finished span, into a deque bounded at
 from __future__ import annotations
 
 import collections
+import contextvars
 import json
 import os
 import threading
@@ -28,13 +48,20 @@ from typing import List, Optional
 __all__ = [
     "span", "add_event", "events", "clear", "enable", "disable",
     "enabled", "set_capacity", "capacity", "export_chrome_trace",
-    "export_jsonl",
+    "export_jsonl", "current_trace", "trace_context", "new_trace_id",
+    "new_span_id", "ingest",
 ]
 
 _ENABLED = False
 _DEFAULT_CAPACITY = 65536
 _LOCK = threading.Lock()
 _RING: collections.deque = collections.deque(maxlen=_DEFAULT_CAPACITY)
+
+# ambient trace context: (trace_id, span_id) of the innermost open
+# span, or None at top level. contextvars (not a plain global) so
+# threads that copy_context() and async frameworks propagate correctly.
+_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "paddle_tpu_trace_ctx", default=None)
 
 
 def enable() -> None:
@@ -67,19 +94,90 @@ def clear() -> None:
         _RING.clear()
 
 
+def new_trace_id() -> str:
+    """Fresh 64-bit trace id (16 hex chars)."""
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """Fresh 32-bit span id (8 hex chars)."""
+    return os.urandom(4).hex()
+
+
+def current_trace() -> Optional[dict]:
+    """{"trace_id", "span_id"} of the innermost open span, or None."""
+    cur = _CTX.get()
+    if cur is None:
+        return None
+    return {"trace_id": cur[0], "span_id": cur[1]}
+
+
+class _TraceContext:
+    """Adopt an existing trace: spans/events opened inside join
+    (trace_id, span_id) as their parent instead of starting fresh.
+    Used by instrumentation that attributes work to a long-lived
+    logical trace (one serving request) across separate call stacks."""
+
+    __slots__ = ("_trace_id", "_span_id", "_token")
+
+    def __init__(self, trace_id, span_id):
+        self._trace_id = trace_id
+        self._span_id = span_id
+        self._token = None
+
+    def __enter__(self):
+        self._token = _CTX.set((self._trace_id, self._span_id))
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            _CTX.reset(self._token)
+        except ValueError:      # reset from a different context: drop
+            _CTX.set(None)
+        return False
+
+
+def trace_context(trace_id: str, span_id: Optional[str] = None):
+    """Context manager adopting an existing trace (see _TraceContext).
+    No-op singleton when tracing is disabled."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _TraceContext(trace_id, span_id)
+
+
 def add_event(name: str, ts_us: float, dur_us: float,
               pid: Optional[int] = None, tid: Optional[int] = None,
-              args: Optional[dict] = None) -> None:
+              args: Optional[dict] = None,
+              trace: Optional[tuple] = None) -> None:
     """Append one complete event to the ring. ts_us must come from the
     perf_counter clock (microseconds) so events from different
-    recording APIs order consistently."""
+    recording APIs order consistently. trace: optional
+    (trace_id, span_id, parent_id_or_None) attached as top-level keys
+    (span() passes these automatically; manual events may stitch
+    themselves into a trace the same way)."""
     ev = {"name": name, "ph": "X",
           "pid": os.getpid() if pid is None else pid,
           "tid": threading.get_ident() if tid is None else tid,
           "ts": ts_us, "dur": dur_us}
+    if trace is not None:
+        ev["trace_id"], ev["span_id"] = trace[0], trace[1]
+        if trace[2] is not None:
+            ev["parent_id"] = trace[2]
     if args:
         ev["args"] = args
     _RING.append(ev)      # deque.append is atomic under the GIL
+
+
+def ingest(evs) -> None:
+    """Append events recorded elsewhere (another process's ring, a
+    bundle) verbatim — pid/tid/ts/ids are preserved. Bypasses the
+    enabled flag for the same reason metrics merge() does: the child
+    only has events to ship because recording was on when it
+    mattered."""
+    if not evs:
+        return
+    with _LOCK:
+        _RING.extend(evs)
 
 
 def events() -> List[dict]:
@@ -91,6 +189,9 @@ def events() -> List[dict]:
 class _NullSpan:
     """Shared disabled-mode span: no state, no allocation."""
     __slots__ = ()
+    trace_id = None
+    span_id = None
+    parent_id = None
 
     def __enter__(self):
         return self
@@ -106,14 +207,26 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "args", "_t0")
+    __slots__ = ("name", "args", "_t0", "trace_id", "span_id",
+                 "parent_id", "_token")
 
-    def __init__(self, name, args):
+    def __init__(self, name, args, trace_id=None):
         self.name = name
         self.args = args
         self._t0 = None
+        self.trace_id = trace_id        # explicit adoption, else ambient
+        self.span_id = None
+        self.parent_id = None
+        self._token = None
 
     def __enter__(self):
+        cur = _CTX.get()
+        if self.trace_id is None:
+            self.trace_id = cur[0] if cur else new_trace_id()
+        if cur is not None and cur[0] == self.trace_id:
+            self.parent_id = cur[1]
+        self.span_id = new_span_id()
+        self._token = _CTX.set((self.trace_id, self.span_id))
         self._t0 = time.perf_counter_ns()
         return self
 
@@ -122,26 +235,40 @@ class _Span:
         t0, self._t0 = self._t0, None
         if t0 is None:
             return
+        if self._token is not None:
+            try:
+                _CTX.reset(self._token)
+            except ValueError:  # ended from a different context: drop
+                _CTX.set(None)
+            self._token = None
         t1 = time.perf_counter_ns()
         add_event(self.name, t0 / 1000.0, (t1 - t0) / 1000.0,
-                  args=self.args)
+                  args=self.args,
+                  trace=(self.trace_id, self.span_id, self.parent_id))
 
     def __exit__(self, *exc):
         self.end()
         return False
 
 
-def span(name: str, **attrs) -> object:
+def span(name: str, request_id=None, trace_id: Optional[str] = None,
+         **attrs) -> object:
     """Nestable timing context:
 
         with tracing.span("engine.step", batch=8):
             ...
 
     Records one complete event on exit when tracing is enabled; returns
-    a shared no-op context when disabled."""
+    a shared no-op context when disabled. The event carries trace
+    context IDs: a span opened inside another span becomes its child
+    (same trace_id, parent_id = enclosing span_id); at top level a
+    fresh trace starts. request_id= stamps request attribution into the
+    event args; trace_id= adopts an existing trace explicitly."""
     if not _ENABLED:
         return _NULL_SPAN
-    return _Span(name, attrs or None)
+    if request_id is not None:
+        attrs["request_id"] = request_id
+    return _Span(name, attrs or None, trace_id=trace_id)
 
 
 # ---------------------------------------------------------------------------
@@ -150,7 +277,9 @@ def span(name: str, **attrs) -> object:
 def export_chrome_trace(path: str, extra_events: Optional[list] = None
                         ) -> str:
     """Write the ring buffer as a chrome://tracing / Perfetto-loadable
-    JSON object. Returns the path written."""
+    JSON object (trace/span/parent ids ride along as top-level keys —
+    the viewers ignore unknown keys, jq/scripts can join on them).
+    Returns the path written."""
     evs = events()
     if extra_events:
         evs = evs + list(extra_events)
@@ -162,7 +291,9 @@ def export_chrome_trace(path: str, extra_events: Optional[list] = None
 
 def export_jsonl(path: str) -> str:
     """Write the ring buffer as one JSON object per line (stream-
-    friendly: cat/grep/jq-able, appendable across runs)."""
+    friendly: cat/grep/jq-able, appendable across runs). Each line
+    carries the trace context ids, so `jq 'select(.trace_id == ...)'`
+    reconstructs one request's span tree."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "w") as f:
         for ev in events():

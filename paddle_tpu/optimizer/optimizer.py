@@ -19,6 +19,7 @@ from ..observability import metrics as _om
 from .lr import LRScheduler
 
 _FUSED_COUNTER = None
+_COMPILE_METRICS = None
 
 
 def _fused_counter(outcome: str) -> None:
@@ -33,6 +34,20 @@ def _fused_counter(outcome: str) -> None:
             "fused optimizer-step executable cache outcomes",
             ("outcome",))
     _FUSED_COUNTER.labels(outcome=outcome).inc()
+
+
+def _fused_compile_time(seconds: float) -> None:
+    """The fused step's contribution to the process-wide compile
+    telemetry (same shared series the LLMEngine executable caches
+    report into — registered once in observability.metrics). Caches
+    the PARENT metrics and resolves .labels() per use: reset()
+    replaces child objects, so a cached child would go orphaned."""
+    global _COMPILE_METRICS
+    if _COMPILE_METRICS is None:
+        _COMPILE_METRICS = _om.compile_metrics()
+    c, h = _COMPILE_METRICS
+    c.labels(family="optimizer_fused").inc()
+    h.labels(family="optimizer_fused").observe(seconds)
 
 
 class Optimizer:
@@ -270,6 +285,8 @@ class Optimizer:
             # guard and propagate — after donation the eager fallback
             # would dereference deleted param/state buffers.
             lr32 = jnp.asarray(lr, jnp.float32)
+            import time as _time
+            t_compile = _time.perf_counter()
             try:
                 entry = jax.jit(fused, donate_argnums=(1, 3)).lower(
                     lr32, work, garrs, states).compile()
@@ -281,6 +298,7 @@ class Optimizer:
             cache[key] = entry
             if _om._ENABLED:
                 _fused_counter("compile")
+                _fused_compile_time(_time.perf_counter() - t_compile)
         lr32 = jnp.asarray(lr, jnp.float32)
         new_w, new_s, casts = entry(lr32, work, garrs, states)
         for (p, _, has_mw), nw, ns, cast in zip(infos, new_w, new_s,

@@ -66,7 +66,7 @@ import time
 from typing import Dict, Optional
 
 __all__ = ["inject", "clear", "clear_all", "fault_point", "fired",
-           "snapshot", "install", "FaultSpec"]
+           "snapshot", "install", "set_on_fire", "FaultSpec"]
 
 
 # reentrant: fault_point() evaluates user `when=` predicates under the
@@ -77,6 +77,12 @@ _LOCK = threading.RLock()
 # one truthiness check
 _ACTIVE: Dict[str, "FaultSpec"] = {}
 _FIRED: Dict[str, int] = {}
+# observer called as cb(name, ctx) right after a fault fires, BEFORE
+# its effect (delay/exit/raise) — the flight recorder hooks in here so
+# the pre-crash state is on disk even for exit_code faults. Survives
+# clear_all(): the observer belongs to whoever installed it, not to
+# the armed specs.
+_ON_FIRE = None
 
 
 class FaultSpec:
@@ -181,6 +187,11 @@ def fault_point(name: str, **ctx) -> None:
             if spec.times <= 0:
                 _ACTIVE.pop(name, None)
         _FIRED[name] = _FIRED.get(name, 0) + 1
+    if _ON_FIRE is not None:
+        try:
+            _ON_FIRE(name, ctx)
+        except Exception:
+            pass        # an observer must never mask the fault itself
     if spec.delay:
         time.sleep(spec.delay)
     if spec.exit_code is not None:
@@ -189,6 +200,14 @@ def fault_point(name: str, **ctx) -> None:
     if spec.exc is not None:
         exc = spec.exc() if isinstance(spec.exc, type) else spec.exc
         raise exc
+
+
+def set_on_fire(cb) -> None:
+    """Install (or with None, remove) the fire observer — cb(name,
+    ctx) runs after a spec fires and before its effect. One observer;
+    the flight recorder's capture_faults owns it when armed."""
+    global _ON_FIRE
+    _ON_FIRE = cb
 
 
 def snapshot() -> list:

@@ -452,27 +452,32 @@ class TestMetricNameChecker:
         # isolating one violation per case
         readme = ("paddle_tpu_bad_count paddle_tpu_depth_total "
                   "paddle_tpu_lat paddle_tpu_good_total "
-                  "paddle_tpu_lat_seconds")
+                  "paddle_tpu_lat_seconds paddle_tpu_nohelp_total")
         bad = [
-            ("counter", "paddle_tpu_bad_count", "x.py"),   # no _total
-            ("gauge", "paddle_tpu_depth_total", "x.py"),   # gauge _total
-            ("histogram", "paddle_tpu_lat", "x.py"),       # no unit
-            ("counter", "engine_total", "x.py"),           # no prefix
-            ("counter", "paddle_tpu_undoc_total", "x.py"),  # not in README
+            ("counter", "paddle_tpu_bad_count", "h", "x.py"),  # no _total
+            ("gauge", "paddle_tpu_depth_total", "h", "x.py"),  # gauge _total
+            ("histogram", "paddle_tpu_lat", "h", "x.py"),      # no unit
+            ("counter", "engine_total", "h", "x.py"),          # no prefix
+            ("counter", "paddle_tpu_undoc_total", "h", "x.py"),  # not in README
+            ("counter", "paddle_tpu_nohelp_total", "", "x.py"),  # empty help
         ]
         problems = tool.check(bad, readme)
-        assert len(problems) == 5
+        assert len(problems) == 6
         for frag in ("must end _total", "must NOT end _total",
                      "base-unit suffix", "paddle_tpu_ prefix",
-                     "not documented"):
+                     "not documented", "help"):
             assert any(frag in p for p in problems), frag
-        good = [("counter", "paddle_tpu_good_total", "x.py"),
-                ("histogram", "paddle_tpu_lat_seconds", "x.py")]
+        good = [("counter", "paddle_tpu_good_total", "h", "x.py"),
+                ("histogram", "paddle_tpu_lat_seconds", "h", "x.py")]
         assert tool.check(good, readme) == []
 
     def test_collects_real_registrations(self):
         tool, root = self._tool()
         series = tool.collect_series(root)
-        names = {n for _, n, _ in series}
+        names = {n for _, n, _, _ in series}
         assert "paddle_tpu_engine_prefix_cache_tokens_total" in names
+        assert "paddle_tpu_request_ttft_seconds" in names
         assert "paddle_tpu_engine_step_seconds" in names
+        # the regex sees through line wraps: every registration's
+        # FIRST help fragment must be non-empty
+        assert all(h.strip() for _, _, h, _ in series)

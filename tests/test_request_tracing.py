@@ -1,0 +1,561 @@
+"""Request-scoped observability (ISSUE 4): trace-context propagation
+with IDs, per-request SLO accounting (TTFT/TPOT/queue-wait/e2e +
+quantile estimation + declarative SLO rules), the anomaly flight
+recorder, and compile/HBM telemetry — including the chaos/latency
+acceptance run driving LLMEngine with prefix caching + preemption +
+an injected slow step."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.observability import flight, metrics, slo, tracing
+from paddle_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Every test starts and ends disabled with empty series/ring, no
+    SLO rules and a disarmed flight recorder (all process-global)."""
+    obs.disable()
+    obs.reset()
+    slo.clear()
+    flight.disarm()
+    cap = tracing.capacity()
+    yield
+    obs.disable()
+    obs.reset()
+    slo.clear()
+    flight.disarm()
+    tracing.set_capacity(cap)
+    faults.clear_all()
+
+
+def _series(name):
+    return obs.snapshot()[name]["series"]
+
+
+# ---------------------------------------------------------------------------
+# trace context: IDs, propagation, adoption, exports
+# ---------------------------------------------------------------------------
+class TestTraceContext:
+    def test_nested_spans_share_trace_and_parent(self):
+        obs.enable()
+        with obs.span("outer") as so:
+            assert obs.current_trace() == {"trace_id": so.trace_id,
+                                           "span_id": so.span_id}
+            with obs.span("inner") as si:
+                pass
+        assert obs.current_trace() is None
+        inner, outer = tracing.events()
+        assert inner["trace_id"] == outer["trace_id"] == so.trace_id
+        assert inner["parent_id"] == outer["span_id"]
+        assert "parent_id" not in outer
+        assert inner["span_id"] == si.span_id != outer["span_id"]
+
+    def test_sibling_top_level_spans_get_fresh_traces(self):
+        obs.enable()
+        with obs.span("a"):
+            pass
+        with obs.span("b"):
+            pass
+        a, b = tracing.events()
+        assert a["trace_id"] != b["trace_id"]
+
+    def test_trace_context_adoption(self):
+        obs.enable()
+        tid, root = tracing.new_trace_id(), tracing.new_span_id()
+        with obs.trace_context(tid, root):
+            with obs.span("child"):
+                pass
+        (ev,) = tracing.events()
+        assert ev["trace_id"] == tid
+        assert ev["parent_id"] == root
+
+    def test_request_id_lands_in_args(self):
+        obs.enable()
+        with obs.span("s", request_id="req-7", extra=1):
+            pass
+        (ev,) = tracing.events()
+        assert ev["args"] == {"request_id": "req-7", "extra": 1}
+
+    def test_disabled_span_has_no_ids_and_no_context(self):
+        s = obs.span("x", request_id="r")
+        with s:
+            assert obs.current_trace() is None
+        assert s.trace_id is None and s.span_id is None
+        assert tracing.events() == []
+
+    @pytest.mark.obs
+    def test_jsonl_export_carries_ids(self, tmp_path):
+        obs.enable()
+        with obs.span("a"):
+            with obs.span("b"):
+                pass
+        path = obs.export_jsonl(str(tmp_path / "t.jsonl"))
+        lines = [json.loads(l) for l in open(path)]
+        assert all({"trace_id", "span_id"} <= set(e) for e in lines)
+
+    def test_ingest_appends_foreign_events(self):
+        obs.enable()
+        foreign = [{"name": "w", "ph": "X", "pid": 99999, "tid": 1,
+                    "ts": 1.0, "dur": 2.0, "trace_id": "aa",
+                    "span_id": "bb"}]
+        tracing.ingest(foreign)
+        assert tracing.events() == foreign
+
+
+# ---------------------------------------------------------------------------
+# quantile estimation + summary percentiles
+# ---------------------------------------------------------------------------
+class TestQuantiles:
+    def test_histogram_quantile_interpolates(self):
+        obs.enable()
+        h = obs.registry().histogram("t_qtl_seconds", "h",
+                                     buckets=(0.1, 0.2, 0.4))
+        for v in (0.05, 0.15, 0.15, 0.3, 0.35, 0.5):
+            h.observe(v)
+        assert h.quantile(0.0) == pytest.approx(0.05)
+        assert h.quantile(0.5) == pytest.approx(0.2)
+        assert h.quantile(1.0) == pytest.approx(0.5)
+        assert 0.2 < h.quantile(0.75) <= 0.4
+
+    def test_quantile_empty_and_clamped(self):
+        obs.enable()
+        h = obs.registry().histogram("t_qtl2_seconds", "h",
+                                     buckets=(1.0,))
+        assert h.quantile(0.5) is None
+        h.observe(5.0)              # lands in +Inf bucket
+        # clamped to the observed max, not unbounded
+        assert h.quantile(0.99) == pytest.approx(5.0)
+
+    def test_fraction_le(self):
+        bounds, counts = (0.1, 0.2), [2, 2, 1]     # +Inf overflow: 1
+        assert metrics.fraction_le(bounds, counts, 0.1) == \
+            pytest.approx(0.4)
+        assert metrics.fraction_le(bounds, counts, 0.15) == \
+            pytest.approx(0.6)     # half of the (0.1, 0.2] bucket
+        # past the last bound, the +Inf bucket counts as exceeded
+        # unless the observed max says otherwise
+        assert metrics.fraction_le(bounds, counts, 99.0) == \
+            pytest.approx(0.8)
+        assert metrics.fraction_le(bounds, counts, 99.0, hi=5.0) == 1.0
+        assert metrics.fraction_le(bounds, [0, 0, 0], 0.1) is None
+
+    def test_summary_reports_percentiles(self):
+        obs.enable()
+        h = obs.registry().histogram("t_sum_seconds", "h",
+                                     buckets=(0.1, 0.2))
+        for v in (0.05, 0.15, 0.25):
+            h.observe(v)
+        entry = obs.summary()["histograms"]["t_sum_seconds"]
+        assert {"p50", "p95", "count", "mean"} <= set(entry)
+        assert entry["p50"] <= entry["p95"] <= entry["max"]
+
+
+# ---------------------------------------------------------------------------
+# the reset contract (satellite fix, pinned)
+# ---------------------------------------------------------------------------
+class TestResetContract:
+    def test_reset_clears_metrics_and_trace_ring(self):
+        """obs.reset() is the FULL observable-state reset: series AND
+        ring together; trace_clear() stays the narrow ring-only
+        call."""
+        obs.enable()
+        c = obs.registry().counter("t_rst_total", "h")
+        c.inc(3)
+        with obs.span("s"):
+            pass
+        assert tracing.events()
+        obs.reset()
+        assert _series("t_rst_total")[()] == 0
+        assert tracing.events() == []
+        # trace_clear: ring only — metrics keep their values
+        c.inc(2)
+        with obs.span("s2"):
+            pass
+        obs.trace_clear()
+        assert tracing.events() == []
+        assert _series("t_rst_total")[()] == 2
+
+
+# ---------------------------------------------------------------------------
+# SLO rules
+# ---------------------------------------------------------------------------
+class TestSLO:
+    def _hist(self, name="t_slo_seconds"):
+        h = obs.registry().histogram(name, "h", buckets=(0.1, 0.5))
+        return h
+
+    def test_evaluate_pass_and_breach(self):
+        obs.enable()
+        h = self._hist()
+        for v in (0.05, 0.05, 0.05, 0.3):   # 75% under 0.1
+            h.observe(v)
+        slo.add(slo.SLO("loose", "t_slo_seconds", threshold_s=0.5,
+                        objective=0.9))
+        slo.add(slo.SLO("tight", "t_slo_seconds", threshold_s=0.1,
+                        objective=0.9))
+        res = {r.name: r for r in slo.evaluate()}
+        assert res["loose"].ok and res["loose"].attained == 1.0
+        assert not res["tight"].ok
+        assert res["tight"].attained == pytest.approx(0.75)
+        assert _series("paddle_tpu_slo_breaches_total")[("tight",)] == 1
+        assert ("loose",) not in \
+            _series("paddle_tpu_slo_breaches_total")
+
+    def test_empty_metric_passes_vacuously(self):
+        obs.enable()
+        self._hist("t_slo2_seconds")
+        slo.add(slo.SLO("empty", "t_slo2_seconds", threshold_s=0.1,
+                        objective=0.99))
+        (r,) = slo.evaluate()
+        assert r.ok and r.attained is None and r.count == 0
+        assert not r.missing        # registered, just no traffic yet
+
+    def test_unknown_metric_flagged_missing(self):
+        """A typo'd metric name must be DETECTABLE, not an eternal
+        vacuous pass."""
+        obs.enable()
+        slo.add(slo.SLO("typo", "t_slo_nope_seconds", threshold_s=0.1,
+                        objective=0.99))
+        (r,) = slo.evaluate()
+        assert r.ok and r.missing
+        assert "MISSING-METRIC" in repr(r)
+
+    def test_invalid_rules_rejected(self):
+        with pytest.raises(ValueError, match="objective"):
+            slo.SLO("x", "m", threshold_s=1.0, objective=1.5)
+        with pytest.raises(ValueError, match="threshold"):
+            slo.SLO("x", "m", threshold_s=0.0, objective=0.9)
+
+    @pytest.mark.obs
+    def test_breach_drops_flight_bundle(self, tmp_path):
+        obs.enable()
+        h = self._hist("t_slo3_seconds")
+        h.observe(9.0)
+        slo.add(slo.SLO("burnt", "t_slo3_seconds", threshold_s=0.1,
+                        objective=0.5))
+        flight.arm(str(tmp_path))
+        slo.evaluate()
+        (b,) = flight.bundles()
+        assert "slo_breach" in os.path.basename(b)
+        assert flight.load_bundle(b)["meta"]["detail"]["name"] == \
+            "burnt"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder mechanics
+# ---------------------------------------------------------------------------
+@pytest.mark.obs
+class TestFlightRecorder:
+    def test_bundle_contents_and_counter(self, tmp_path):
+        obs.enable()
+        obs.registry().counter("t_fl_total", "h").inc(4)
+        with obs.span("engine.step"):
+            pass
+        flight.arm(str(tmp_path), retention=4)
+        path = flight.trigger("manual", detail={"why": "test"})
+        assert path and os.path.basename(path).endswith("_manual")
+        b = flight.load_bundle(path)
+        assert b["meta"]["reason"] == "manual"
+        assert b["meta"]["detail"] == {"why": "test"}
+        assert b["metrics"]["t_fl_total"]["series"][0]["value"] == 4
+        assert any(e["name"] == "engine.step" for e in b["trace"])
+        assert _series("paddle_tpu_flight_bundles_total")[
+            ("manual",)] == 1
+
+    def test_retention_and_cooldown(self, tmp_path):
+        flight.arm(str(tmp_path), retention=2)
+        for _ in range(5):
+            flight.trigger("manual")
+        assert len(flight.bundles()) == 2
+        flight.disarm()
+        flight.arm(str(tmp_path), retention=8, min_interval_s=3600.0)
+        assert flight.trigger("manual") is not None
+        assert flight.trigger("manual") is None     # inside cooldown
+
+    def test_disarmed_is_inert(self, tmp_path):
+        assert flight.trigger("manual") is None
+        assert not flight.armed()
+
+    def test_rearm_resumes_numbering_and_sweeps_tmp(self, tmp_path):
+        """A postmortem tool restarts by definition: re-arming over a
+        directory with bundles from a previous incarnation must not
+        collide names (a collision makes the rename fail and silently
+        drops the next dump), and half-written .tmp_ dirs from a crash
+        mid-dump are swept."""
+        flight.arm(str(tmp_path))
+        first = flight.trigger("manual")
+        first_seq = int(os.path.basename(first).split("_")[1])
+        flight.disarm()
+        import paddle_tpu.observability.flight as fl
+        fl._SEQ = 0                       # simulate a fresh process
+        os.makedirs(str(tmp_path / ".tmp_bundle_000009_manual"))
+        flight.arm(str(tmp_path))
+        p = flight.trigger("manual")
+        assert p is not None
+        assert int(os.path.basename(p).split("_")[1]) == first_seq + 1
+        assert len(flight.bundles()) == 2
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.startswith(".tmp_")]
+
+    def test_fault_point_capture(self, tmp_path):
+        flight.arm(str(tmp_path), capture_faults=True)
+        with pytest.raises(RuntimeError):
+            with faults.inject("engine.step", exc=RuntimeError("x"),
+                               times=1):
+                faults.fault_point("engine.step")
+        (b,) = flight.bundles()
+        assert "fault_point" in os.path.basename(b)
+        assert flight.load_bundle(b)["meta"]["detail"]["fault"] == \
+            "engine.step"
+        flight.disarm()
+        assert faults._ON_FIRE is None      # hook released
+
+
+# ---------------------------------------------------------------------------
+# check_metric_names: help-string enforcement (satellite)
+# ---------------------------------------------------------------------------
+class TestMetricNameAudit:
+    def test_empty_help_rejected(self):
+        import sys
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(root, "tools"))
+        try:
+            import check_metric_names as cmn
+        finally:
+            sys.path.pop(0)
+        bad = [("counter", "paddle_tpu_bad_total", "", "x.py")]
+        good = [("counter", "paddle_tpu_ok_total", "help", "x.py")]
+        readme = "paddle_tpu_bad_total paddle_tpu_ok_total"
+        probs = cmn.check(bad + good, readme)
+        assert len(probs) == 1 and "help" in probs[0]
+
+
+# ---------------------------------------------------------------------------
+# engine: the chaos/latency acceptance run
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import gpt_tiny
+    pt.seed(0)
+    return GPTForCausalLM(gpt_tiny())
+
+
+def _preempting_engine(model):
+    """The test_prefix_cache preemption config tightened by one block
+    (7 usable): two shared-prefix requests through a pool too small
+    for both EVEN when the warm prefix cache shares their 2 prefix
+    pages (2 shared + 3 + 3 unique > 7), so every pass — cold or warm
+    — preempts and resumes through the prefix cache."""
+    from paddle_tpu.inference import LLMEngine
+    return LLMEngine(model, max_batch=2, block_size=8, num_blocks=8,
+                     decode_chunk=4, prompt_quantum=16,
+                     max_model_len=64, enable_prefix_caching=True)
+
+
+def _prompts():
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(0, 1024, (16,)).astype(np.int32)
+    return [np.concatenate(
+        [prefix, rng.integers(0, 1024, (t,)).astype(np.int32)])
+        for t in (1, 2)]
+
+
+def _run(eng, prompts, tag, n_new=20):
+    for i, p in enumerate(prompts):
+        eng.add_request(f"{tag}{i}", p, max_new_tokens=n_new)
+    done = {}
+    while eng.has_unfinished:
+        for r in eng.step():
+            done[r.request_id] = r
+    return done
+
+
+def _request_events(rid):
+    return [e for e in tracing.events()
+            if e.get("args", {}).get("request_id") == rid]
+
+
+@pytest.mark.obs
+class TestEngineRequestTracing:
+    def test_chaos_latency_acceptance(self, tiny_gpt, tmp_path):
+        """The ISSUE 4 acceptance scenario in one run: prefix caching +
+        preemption, an injected slow step, connected per-request trace
+        trees, TTFT/TPOT percentiles in summary(), exactly one flight
+        bundle holding the triggering trace, and the compile counter
+        agreeing with the engine's executable caches."""
+        obs.enable()
+        eng = _preempting_engine(tiny_gpt)
+        prompts = _prompts()
+        # two identical warmup passes compile EVERY executable the
+        # measured pass needs (pass 2 admits through the prefix cache
+        # seeded by pass 1, which uses its own resume executable), so
+        # the armed pass is compile-free and only the injected delay
+        # can trip the latency trigger
+        _run(eng, prompts, "w")
+        assert eng.stats["preemptions"] >= 1
+        _run(eng, prompts, "x")
+        obs.trace_clear()       # measured pass gets a clean ring
+        pre_preempts = eng.stats["preemptions"]
+        pre_hits = eng.stats["prefix_cache_hit_tokens"]
+
+        flight.arm(str(tmp_path), step_latency_threshold_s=1.5)
+        with faults.inject("engine.step", delay=2.0, times=1):
+            done = _run(eng, prompts, "c")
+        flight.disarm()
+
+        assert sorted(done) == ["c0", "c1"]
+        assert all(r.ok for r in done.values())
+        # preemption + prefix-cache resume happened in the MEASURED run
+        assert eng.stats["preemptions"] > pre_preempts
+        assert eng.stats["prefix_cache_hit_tokens"] > pre_hits
+
+        # -- every finished request: one CONNECTED single-trace tree --
+        preempted = set()
+        for rid in ("c0", "c1"):
+            evs = _request_events(rid)
+            names = [e["name"] for e in evs]
+            assert "request" in names          # root span present
+            assert "request.queue_wait" in names
+            assert "request.prefill" in names
+            (root,) = [e for e in evs if e["name"] == "request"]
+            assert root["args"]["finish_reason"] == "length"
+            assert "parent_id" not in root
+            tids = {e["trace_id"] for e in evs}
+            assert tids == {root["trace_id"]}  # ONE trace
+            for e in evs:
+                if e is not root:
+                    assert e["parent_id"] == root["span_id"]
+            if "request.preempt" in names:
+                preempted.add(rid)
+                # resumed lifecycle stays in the SAME trace: a second
+                # admission (queue_wait) and a second prefill
+                assert names.count("request.queue_wait") >= 2
+                assert names.count("request.prefill") >= 2
+        assert preempted                       # chaos actually bit
+
+        # -- SLO accounting is live --
+        sm = obs.summary()["histograms"]
+        for name in ("paddle_tpu_request_ttft_seconds",
+                     "paddle_tpu_request_tpot_seconds",
+                     "paddle_tpu_request_queue_wait_seconds",
+                     "paddle_tpu_request_e2e_seconds"):
+            assert {"p50", "p95"} <= set(sm[name]), name
+        fin = _series("paddle_tpu_request_finished_total")
+        assert fin[("length",)] >= 6           # all three passes
+
+        # -- exactly ONE flight bundle, holding the triggering trace --
+        (bundle,) = flight.bundles(str(tmp_path))
+        assert "step_latency" in os.path.basename(bundle)
+        b = flight.load_bundle(bundle)
+        assert b["meta"]["detail"]["step_seconds"] > 1.5
+        slow = [e for e in b["trace"]
+                if e.get("span_id") == b["meta"]["detail"]["span_id"]]
+        assert len(slow) == 1 and slow[0]["name"] == "engine.step"
+        assert slow[0]["dur"] >= 1.5e6         # µs
+        # stats snapshot is AT trigger time (mid-run), not end state
+        assert pre_preempts <= \
+            b["meta"]["extra"]["engine_stats"]["preemptions"] <= \
+            eng.stats["preemptions"]
+
+        # -- compile telemetry agrees with the dispatch caches --
+        comp = _series("paddle_tpu_compile_total")
+        engine_compiles = sum(
+            v for (fam,), v in comp.items() if fam.startswith("engine"))
+        assert engine_compiles == \
+            len(eng._prefill_fns) + len(eng._decode_fns)
+        # prefix caching + preemption means the resume family compiled
+        assert comp[("engine_prefix_resume",)] >= 1
+        ct = _series("paddle_tpu_compile_seconds")
+        assert sum(v["count"] for v in ct.values()) == engine_compiles
+
+        # -- HBM gauges sampled at the step boundary --
+        hbm = _series("paddle_tpu_hbm_page_pool_bytes")
+        assert hbm[("reserved",)] > 0
+        assert 0 <= hbm[("used",)] <= hbm[("reserved",)]
+        assert _series("paddle_tpu_hbm_live_array_bytes")[()] > 0
+
+    def test_deadline_miss_drops_bundle(self, tiny_gpt, tmp_path):
+        obs.enable()
+        eng = _preempting_engine(tiny_gpt)
+        flight.arm(str(tmp_path))
+        eng.add_request("late", _prompts()[0], max_new_tokens=4,
+                        deadline_s=0.0)        # expired on arrival
+        (r,) = eng.step()
+        assert r.finish_reason == "deadline"
+        (b,) = flight.bundles(str(tmp_path))
+        assert "deadline_miss" in os.path.basename(b)
+        meta = flight.load_bundle(b)["meta"]
+        assert meta["detail"]["request_id"] == "late"
+        fin = _series("paddle_tpu_request_finished_total")
+        assert fin[("deadline",)] == 1
+
+    def test_disabled_mode_no_allocation_growth(self, tiny_gpt):
+        """The acceptance overhead guard, extended over the NEW hot
+        paths: request_id spans, the flight-armed check, and the
+        request histograms — all one flag check when off."""
+        import tracemalloc
+        h = obs.registry().histogram("t_ov2_seconds", "h")
+        c = obs.registry().counter("t_ov2_total", "h")
+        assert not obs.enabled() and not flight.armed()
+        for _ in range(16):
+            with obs.span("t.ov2", request_id="r"):
+                pass
+            h.observe(0.1)
+            c.inc()
+            if flight._ARMED:
+                pytest.fail("armed")
+        tracemalloc.start()
+        base = tracemalloc.get_traced_memory()[0]
+        for _ in range(5000):
+            with obs.span("t.ov2", request_id="r"):
+                pass
+            h.observe(0.1)
+            c.inc()
+            if flight._ARMED:
+                pytest.fail("armed")
+        grown = tracemalloc.get_traced_memory()[0] - base
+        tracemalloc.stop()
+        assert grown < 2048, f"disabled-mode ops leaked {grown}B"
+        assert tracing.events() == []
+
+
+# ---------------------------------------------------------------------------
+# worker-side spans survive the spawn boundary
+# ---------------------------------------------------------------------------
+class SpawnTraceDs(Dataset):
+    """Module-level (spawn-picklable) tiny dataset."""
+
+    def __init__(self, n=12):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.full((4,), i, np.float32)
+
+    def __len__(self):
+        return self.n
+
+
+class TestSpawnBoundaryTraces:
+    def test_worker_spans_merge_into_parent_ring(self):
+        obs.enable()
+        out = list(DataLoader(SpawnTraceDs(n=12), batch_size=4,
+                              num_workers=2))
+        assert len(out) == 3
+        worker_evs = [e for e in tracing.events()
+                      if e["name"] == "io.worker.batch"]
+        assert len(worker_evs) == 3
+        # recorded IN the spawned processes, not re-stamped here
+        assert all(e["pid"] != os.getpid() for e in worker_evs)
+        assert {e["args"]["bi"] for e in worker_evs} == {0, 1, 2}
+        # and the metric snapshot still merges alongside (PR 2 path)
+        assert _series(
+            "paddle_tpu_dataloader_worker_batches_total")[()] == 3

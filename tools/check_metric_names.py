@@ -8,6 +8,8 @@ Conventions enforced:
   * monotonic counters end in `_total`
   * histograms carry a base unit suffix (`_seconds` or `_bytes`)
   * gauges do NOT end in `_total` (that suffix promises monotonicity)
+  * every registration carries a NON-EMPTY help string literal (the
+    exposition's # HELP line is an operator's first documentation)
   * every registered name appears VERBATIM in README.md (the
     observability table lists full names, so operators can grep)
 
@@ -23,18 +25,22 @@ import re
 import sys
 from typing import Dict, List, Tuple
 
-# a registration is `<registry>.counter("name", ...)` etc. — the name
-# literal may sit on the following line (the codebase wraps at 72)
+# a registration is `<registry>.counter("name", "help...", ...)` etc.
+# — the name/help literals may sit on following lines (the codebase
+# wraps at 72; help strings use implicit concatenation, so capturing
+# the FIRST fragment is enough to prove the help is non-empty)
 _REG_RE = re.compile(
-    r'\.(counter|gauge|histogram)\(\s*"([A-Za-z0-9_]+)"')
+    r'\.(counter|gauge|histogram)\(\s*"([A-Za-z0-9_]+)"'
+    r'(?:\s*,\s*"((?:[^"\\]|\\.)*)")?')
 
 _UNIT_SUFFIXES = ("_seconds", "_bytes")
 
 
-def collect_series(root: str) -> List[Tuple[str, str, str]]:
-    """[(kind, name, relpath)] for every metric registration under
-    `root`/paddle_tpu (tests excluded — they register fixtures)."""
-    found = []
+def collect_series(root: str) -> List[Tuple[str, str, str, str]]:
+    """[(kind, name, help_fragment_or_None, relpath)] for every metric
+    registration under `root`/paddle_tpu (tests excluded — they
+    register fixtures)."""
+    found = {}
     pkg = os.path.join(root, "paddle_tpu")
     for dirpath, _, files in os.walk(pkg):
         if "__pycache__" in dirpath:
@@ -45,17 +51,20 @@ def collect_series(root: str) -> List[Tuple[str, str, str]]:
             path = os.path.join(dirpath, fn)
             with open(path, encoding="utf-8") as f:
                 text = f.read()
-            for kind, name in _REG_RE.findall(text):
-                found.append((kind, name,
-                              os.path.relpath(path, root)))
-    return sorted(set(found))
+            for kind, name, help_frag in _REG_RE.findall(text):
+                key = (kind, name, os.path.relpath(path, root))
+                # re.findall yields "" for a missing optional group;
+                # keep the best (non-empty) help seen for the site
+                found[key] = max(found.get(key, ""), help_frag,
+                                 key=len)
+    return sorted((k, n, h, p) for (k, n, p), h in found.items())
 
 
-def check(series: List[Tuple[str, str, str]],
+def check(series: List[Tuple[str, str, str, str]],
           readme_text: str) -> List[str]:
     """Returns the list of violations (empty = clean)."""
     problems = []
-    for kind, name, path in series:
+    for kind, name, help_frag, path in series:
         where = f"{name} ({kind}, {path})"
         if not name.startswith("paddle_tpu_"):
             problems.append(
@@ -72,6 +81,10 @@ def check(series: List[Tuple[str, str, str]],
             problems.append(
                 f"{where}: histograms must carry a base-unit suffix "
                 f"({' or '.join(_UNIT_SUFFIXES)})")
+        if not help_frag.strip():
+            problems.append(
+                f"{where}: empty or missing help string (the # HELP "
+                "line is required documentation)")
         if name not in readme_text:
             problems.append(
                 f"{where}: not documented in the README observability "
@@ -93,7 +106,7 @@ def main(root: str = None) -> int:
         print(f"VIOLATION: {p}")
     if not problems:
         kinds: Dict[str, int] = {}
-        for kind, _, _ in series:
+        for kind, _, _, _ in series:
             kinds[kind] = kinds.get(kind, 0) + 1
         detail = ", ".join(f"{v} {k}s" for k, v in sorted(kinds.items()))
         print(f"check_metric_names: {len(series)} series clean ({detail})")

@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Terminal observability dashboard over the `paddle_tpu` metric
+export: tok/s, queue depths, prefix-cache hit rate, TTFT/TPOT
+percentiles, compile counts, HBM — the SRE's one-screen answer to
+"what is the engine doing right now".
+
+Data sources (exactly one):
+    --json FILE     a file containing `obs.to_json()` output,
+                    re-read every --interval seconds (a serving
+                    process that periodically rewrites the file makes
+                    this a live dashboard; rates are computed between
+                    frames)
+    --bundle DIR    a flight-recorder bundle (renders its
+                    metrics.json; implies a single frame unless the
+                    bundle is being rewritten)
+    --demo          run a short synthetic LLMEngine workload in
+                    process and render ONE frame from the live
+                    registry (the workload ends before the frame, so
+                    there is nothing to watch — --demo implies --once)
+
+    python tools/obs_top.py --demo --once
+    python tools/obs_top.py --json /run/paddle_tpu_metrics.json
+    python tools/obs_top.py --bundle /var/log/flight/bundle_000001_* --once
+
+--once prints one frame and exits (scriptable); without it the screen
+refreshes until Ctrl-C. Percentiles are estimated from the exported
+bucket vectors (observability.metrics.quantile_from_buckets), so the
+dashboard needs no live registry access."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.observability.metrics import quantile_from_buckets  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# doc accessors over the to_json() shape:
+#   {name: {kind, help, series: [{labels: {...}, value: v}], buckets?}}
+# ---------------------------------------------------------------------------
+def _series(doc, name):
+    rec = doc.get(name)
+    return (rec or {}).get("series", [])
+
+
+def _value(doc, name, **labels):
+    for s in _series(doc, name):
+        if s["labels"] == labels:
+            return s["value"]
+    return None
+
+
+def _counter_sum(doc, name, **labels):
+    total = 0.0
+    for s in _series(doc, name):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+def _hist_quantiles(doc, name, qs=(0.5, 0.95)):
+    rec = doc.get(name)
+    if not rec or rec.get("kind") != "histogram":
+        return None
+    for s in rec["series"]:
+        if s["labels"]:
+            continue
+        v = s["value"]
+        if not v["count"]:
+            return None
+        return {
+            "count": v["count"],
+            **{f"p{int(q * 100)}": quantile_from_buckets(
+                rec["buckets"], v["buckets"], q,
+                lo=v["min"], hi=v["max"]) for q in qs},
+        }
+    return None
+
+
+def _ms(x):
+    return "-" if x is None else f"{x * 1e3:8.2f}ms"
+
+
+def render(doc, prev=None, dt=None) -> str:
+    """One dashboard frame from a to_json() document. prev/dt: the
+    previous frame's doc + seconds between reads, for rates."""
+    lines = []
+
+    def rate(name, **labels):
+        if prev is None or not dt:
+            return None
+        d = _counter_sum(doc, name, **labels) - \
+            _counter_sum(prev, name, **labels)
+        return d / dt
+
+    ev = "paddle_tpu_engine_events_total"
+    toks = _counter_sum(doc, ev, event="decode_tokens")
+    tps = rate(ev, event="decode_tokens")
+    lines.append("== engine ==")
+    lines.append(
+        f"  tokens out   {int(toks):>10}"
+        + (f"   ({tps:8.1f} tok/s)" if tps is not None else ""))
+    for k in ("prefills", "decode_chunks", "preemptions",
+              "failed_requests", "rejected_requests",
+              "deadline_expired"):
+        n = _counter_sum(doc, ev, event=k)
+        if n:
+            lines.append(f"  {k:<12} {int(n):>10}")
+    qd = "paddle_tpu_engine_queue_depth"
+    wait = _value(doc, qd, queue="waiting")
+    run = _value(doc, qd, queue="running")
+    if wait is not None or run is not None:
+        lines.append(f"  queues       waiting={int(wait or 0)} "
+                     f"running={int(run or 0)}")
+    pool = "paddle_tpu_engine_page_pool_blocks"
+    free = _value(doc, pool, state="free")
+    used = _value(doc, pool, state="used")
+    if free is not None:
+        lines.append(f"  page pool    used={int(used or 0)} "
+                     f"free={int(free)}")
+
+    pre = "paddle_tpu_engine_prefix_cache_tokens_total"
+    hit = _counter_sum(doc, pre, outcome="hit")
+    miss = _counter_sum(doc, pre, outcome="miss")
+    if hit + miss:
+        lines.append(f"  prefix hit   {hit / (hit + miss):6.1%}  "
+                     f"({int(hit)} of {int(hit + miss)} prompt tokens)")
+
+    lines.append("== requests ==")
+    fin = "paddle_tpu_request_finished_total"
+    outcomes = {s["labels"]["reason"]: int(s["value"])
+                for s in _series(doc, fin)}
+    if outcomes:
+        lines.append("  finished     " + "  ".join(
+            f"{k}={v}" for k, v in sorted(outcomes.items())))
+    for label, name in (
+            ("TTFT", "paddle_tpu_request_ttft_seconds"),
+            ("TPOT", "paddle_tpu_request_tpot_seconds"),
+            ("queue wait", "paddle_tpu_request_queue_wait_seconds"),
+            ("e2e", "paddle_tpu_request_e2e_seconds")):
+        qv = _hist_quantiles(doc, name)
+        if qv:
+            lines.append(f"  {label:<12} p50={_ms(qv['p50'])}  "
+                         f"p95={_ms(qv['p95'])}  n={qv['count']}")
+    br = _series(doc, "paddle_tpu_slo_breaches_total")
+    if br:
+        lines.append("  SLO breaches " + "  ".join(
+            f"{s['labels']['slo']}={int(s['value'])}" for s in br))
+
+    comp = _series(doc, "paddle_tpu_compile_total")
+    if comp:
+        lines.append("== compiles ==")
+        for s in sorted(comp, key=lambda s: s["labels"]["family"]):
+            lines.append(f"  {s['labels']['family']:<20} "
+                         f"{int(s['value']):>4}")
+
+    hbm_pool = _series(doc, "paddle_tpu_hbm_page_pool_bytes")
+    hbm_live = _value(doc, "paddle_tpu_hbm_live_array_bytes")
+    if hbm_pool or hbm_live is not None:
+        lines.append("== hbm ==")
+        for s in hbm_pool:
+            lines.append(f"  pool {s['labels']['state']:<9} "
+                         f"{s['value'] / 1e6:10.2f} MB")
+        if hbm_live is not None:
+            lines.append(f"  live arrays    {hbm_live / 1e6:10.2f} MB")
+
+    fl = _series(doc, "paddle_tpu_flight_bundles_total")
+    if fl:
+        lines.append("== flight bundles ==")
+        for s in fl:
+            lines.append(f"  {s['labels']['reason']:<16} "
+                         f"{int(s['value']):>4}")
+    return "\n".join(lines)
+
+
+def _load(args):
+    if args.json:
+        with open(args.json) as f:
+            return json.load(f)
+    if args.bundle:
+        with open(os.path.join(args.bundle, "metrics.json")) as f:
+            return json.load(f)
+    from paddle_tpu import observability as obs
+    return json.loads(obs.to_json())
+
+
+def _run_demo():
+    """Tiny synthetic workload so --demo has numbers to show."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    obs.enable()
+    obs.reset()
+    pt.seed(0)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    rng = np.random.default_rng(0)
+    eng = LLMEngine(model, max_batch=2, block_size=16, decode_chunk=4,
+                    prompt_quantum=16, max_model_len=64)
+    prompts = [rng.integers(0, 1024, (int(n),)).astype(np.int32)
+               for n in rng.integers(4, 20, 6)]
+    eng.generate(prompts, max_new_tokens=10)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--json", help="obs.to_json() export file")
+    src.add_argument("--bundle", help="flight-recorder bundle dir")
+    src.add_argument("--demo", action="store_true",
+                     help="run a synthetic workload, render one frame")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    ap.add_argument("--interval", type=float, default=2.0)
+    args = ap.parse_args()
+    if not (args.json or args.bundle or args.demo):
+        ap.error("pick a source: --json FILE, --bundle DIR or --demo")
+
+    if args.demo:
+        _run_demo()
+    prev = t_prev = None
+    while True:
+        doc = _load(args)
+        now = time.perf_counter()
+        frame = render(doc, prev,
+                       None if t_prev is None else now - t_prev)
+        if args.once or args.demo:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev, t_prev = doc, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
