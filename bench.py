@@ -718,6 +718,125 @@ def bench_prefix_serving(on_tpu):
     }
 
 
+def bench_spec_decode(on_tpu):
+    """Speculative decoding on the workload it exists for: repetitive
+    prompts (templated/few-shot-shaped traffic) decoded through
+    LLMEngine with n-gram self-drafting ON vs OFF at EQUAL cache HBM
+    (same pool, same blocks; per-row verify leases cover only the live
+    1+drafts window, capped at each request's admission-validated
+    token budget, and the reported peak is the engine's IN-STEP
+    post-lease high-water — `peak_used_blocks` — not the post-rollback
+    residue). Prefix caching is off for BOTH runs so the
+    measurement isolates multi-token-per-step decode (the
+    spec-x-prefix-cache composition is conformance-tested, and bench
+    repetition would legitimately short-circuit prefill). Both engines
+    are warmed first (compiles prefill/decode/verify executables),
+    then timed. vs_baseline = spec tok/s over chunked tok/s; extra
+    carries the headline accepted-tokens-per-step, acceptance rate,
+    and per-step peak pool usage for both runs."""
+    import jax
+    from paddle_tpu.inference import LLMEngine, SpeculativeConfig
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if on_tpu:
+        kw = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                  num_heads=16, max_position_embeddings=2048,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        n_req, max_batch, block_size, chunk = 16, 8, 64, 16
+        pat_len, reps, n_new, spec_k = 16, 8, 128, 7
+        quantum = 128
+    else:
+        kw = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                  num_heads=4, max_position_embeddings=256,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        n_req, max_batch, block_size, chunk = 6, 2, 16, 4
+        pat_len, reps, n_new, spec_k = 8, 5, 32, 7
+        quantum = 16
+    cfg = GPTConfig(**kw)
+    model = GPTForCausalLM(cfg).bfloat16() if on_tpu else \
+        GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    # repetitive prompts: a per-request token pattern tiled `reps`
+    # times — the n-gram proposer drafts the continuation of the last
+    # match, which repetition makes an excellent guess
+    prompts = [np.tile(rng.integers(0, cfg.vocab_size,
+                                    (pat_len,)).astype(np.int32), reps)
+               for _ in range(n_req)]
+
+    def make(spec):
+        return LLMEngine(
+            model, max_batch=max_batch, block_size=block_size,
+            decode_chunk=chunk, prompt_quantum=quantum,
+            max_model_len=cfg.max_position_embeddings,
+            enable_prefix_caching=False,
+            speculative_config=SpeculativeConfig(
+                proposer="ngram",
+                num_speculative_tokens=spec_k) if spec else None)
+
+    def run(eng):
+        before = dict(eng.stats)
+        eng.peak_used_blocks = 0
+        for i, p in enumerate(prompts):
+            eng.add_request(i, p, max_new_tokens=n_new)
+        done = 0
+        t0 = time.perf_counter()
+        while eng.has_unfinished:
+            for r in eng.step():
+                done += len(r.output_ids)
+        dt = time.perf_counter() - t0
+        delta = {k: eng.stats[k] - before.get(k, 0) for k in eng.stats}
+        return done, dt, delta, eng.peak_used_blocks
+
+    def best_of(eng, windows=3):
+        # best window is the honest steady state (the box is shared —
+        # same convention as _timed_steps); counters are per-run
+        # deltas, identical across windows by construction
+        best = None
+        for _ in range(windows):
+            tokens, dt, delta, peak = run(eng)
+            if best is None or dt < best[1]:
+                best = (tokens, dt, delta, peak)
+        return best
+
+    eng_on, eng_off = make(True), make(False)
+    run(eng_on)                 # compile prefill + verify executables
+    run(eng_off)                # compile prefill + decode executables
+    tokens_on, t_on, d_on, peak_on = best_of(eng_on)
+    tokens_off, t_off, d_off, peak_off = best_of(eng_off)
+    tps_on = tokens_on / t_on
+    tps_off = tokens_off / t_off
+    drafted = d_on["spec_drafted_tokens"]
+    accepted = d_on["spec_accepted_tokens"]
+    steps_on = d_on["spec_steps"]
+    return {
+        "metric": "spec_decode_serving_tokens_per_sec",
+        "value": round(tps_on, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps_on / tps_off, 4),
+        "extra": {
+            "chunked_tokens_per_sec": round(tps_off, 1),
+            "accepted_tokens_per_step": round(
+                accepted / max(steps_on, 1), 3),
+            "acceptance_rate": round(accepted / max(drafted, 1), 4),
+            "drafted_tokens": int(drafted),
+            "accepted_tokens": int(accepted),
+            "verify_steps": int(steps_on),
+            "peak_pool_blocks_spec": int(peak_on),
+            "peak_pool_blocks_chunked": int(peak_off),
+            "requests": n_req, "max_batch": max_batch,
+            "prompt_len": pat_len * reps, "new_tokens": n_new,
+            "num_speculative_tokens": spec_k,
+            "decode_chunk": chunk, "block_size": block_size,
+            "num_blocks": eng_on.cache.allocator.num_blocks,
+            "request_latency": _request_latency_percentiles(),
+            "device": str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform)),
+        },
+    }
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2_small,
     "gpt1p3b": bench_gpt_1p3b,
@@ -727,6 +846,7 @@ CONFIGS = {
     "decode": bench_decode,
     "decode_paged": bench_decode_paged,
     "prefix_serving": bench_prefix_serving,
+    "spec_decode": bench_spec_decode,
 }
 
 

@@ -142,3 +142,8 @@ from .paged_cache import BlockAllocator, PagedKVCache  # noqa: E402,F401
 # continuous-batching serving engine over the paged runtime
 from .llm_engine import (LLMEngine, GenerationResult,  # noqa: E402,F401
                          calibrate_kv_scales)
+# speculative decoding: draft proposers + config for
+# LLMEngine(speculative_config=...)
+from .speculative import (SpeculativeConfig,  # noqa: E402,F401
+                          DraftProposer, NgramProposer,
+                          DraftModelProposer)

@@ -58,6 +58,7 @@ from ..observability import metrics as _om
 from ..observability import tracing as _ot
 from ..resilience import faults
 from .paged_cache import PagedKVCache
+from .speculative import accept_drafts
 
 __all__ = ["LLMEngine", "GenerationResult"]
 
@@ -104,6 +105,22 @@ def _metrics():
                 "prompt tokens served from the prefix cache (hit) vs "
                 "prefilled from scratch (miss), counted at admission",
                 ("outcome",)),
+            "spec": r.counter(
+                "paddle_tpu_engine_spec_tokens_total",
+                "speculative draft tokens by verification outcome: "
+                "accepted = matched the target model's greedy pick "
+                "and committed in bulk, rejected = rolled back (KV "
+                "truncated, pages unref'd)",
+                ("outcome",)),
+            "spec_rate": r.gauge(
+                "paddle_tpu_engine_spec_acceptance_ratio",
+                "cumulative fraction of drafted tokens accepted by "
+                "verification (accepted / drafted), updated after "
+                "every verify step"),
+            "verify": r.histogram(
+                "paddle_tpu_engine_verify_seconds",
+                "one speculative verify executable call (k+1 "
+                "positions per row) incl. host prep"),
             "prefix_pages": r.gauge(
                 "paddle_tpu_engine_prefix_cache_pages",
                 "prefix-cache page index occupancy after a step: "
@@ -196,10 +213,13 @@ class _EngineStats(dict):
     they already land on the dedicated
     `paddle_tpu_engine_prefix_cache_tokens_total{outcome=}` counter, and
     double-exporting them would let token volumes swamp the event
-    series."""
+    series. The speculative-decoding token tallies are unmirrored for
+    the same reason (dedicated
+    `paddle_tpu_engine_spec_tokens_total{outcome=}` counter)."""
 
     _UNMIRRORED = frozenset(
-        ("prefix_cache_hit_tokens", "prefix_cache_miss_tokens"))
+        ("prefix_cache_hit_tokens", "prefix_cache_miss_tokens",
+         "spec_drafted_tokens", "spec_accepted_tokens"))
 
     def __setitem__(self, key, value):
         if _om._ENABLED and key not in self._UNMIRRORED:
@@ -506,14 +526,23 @@ class LLMEngine:
                  shed_load: bool = False,
                  max_waiting: Optional[int] = None,
                  step_timeout_s: Optional[float] = None,
-                 enable_prefix_caching: bool = True):
+                 enable_prefix_caching: bool = True,
+                 speculative_config=None):
         """enable_prefix_caching (default on): full prompt blocks are
         hash-indexed so requests sharing a page-aligned prefix (system
         prompts, few-shot templates, multi-turn history) lease the
         already-computed KV pages and prefill only their tail; pages of
         finished sequences are retained in an LRU evicted only under
         pool pressure. Greedy outputs are unchanged either way — set
-        False to force every request to prefill from scratch."""
+        False to force every request to prefill from scratch.
+
+        speculative_config: an `inference.SpeculativeConfig` turns on
+        speculative decoding — a draft proposer guesses up to k tokens
+        per sequence per step, one batched verify executable scores all
+        k+1 positions, the matching prefix commits in bulk, and the
+        first mismatch rolls the KV lease back. Greedy outputs stay
+        bit-identical with speculation on or off (greedy decoding
+        only: do_sample=True is refused)."""
         cfg = model.config
         self.model = model
         self.fam = _family_for(model)
@@ -583,13 +612,34 @@ class LLMEngine:
         self.step_timeout_s = step_timeout_s
         self._failed: List[GenerationResult] = []   # drained by step()
         self._now = time.monotonic                  # stubbable clock
+        # speculative decoding (inference/speculative.py): drafts are
+        # verified by a batched greedy pass, so sampling must be off —
+        # greedy verification preserves outputs bit-exactly, while
+        # sampled verification would change the output distribution
+        self.speculative_config = speculative_config
+        self._proposer = None
+        self._spec_k = 0
+        if speculative_config is not None:
+            if self.do_sample:
+                raise ValueError(
+                    "speculative_config requires greedy decoding "
+                    "(do_sample=False); sampled verification is not "
+                    "supported")
+            self._proposer = speculative_config.build_proposer()
+            self._spec_k = int(
+                speculative_config.num_speculative_tokens)
         # backward-compatible per-engine view; writes mirror onto the
         # observability registry (see _EngineStats)
         self.stats = _EngineStats(
             preemptions=0, prefills=0, decode_chunks=0,
             decode_tokens=0, failed_requests=0, rejected_requests=0,
             deadline_expired=0, prefix_cache_hit_tokens=0,
-            prefix_cache_miss_tokens=0)
+            prefix_cache_miss_tokens=0, spec_steps=0,
+            spec_drafted_tokens=0, spec_accepted_tokens=0,
+            spec_proposer_errors=0, spec_step_errors=0)
+        # in-step pool-occupancy high-water (pages off the free list
+        # at the post-lease peak); plain attribute, reset at will
+        self.peak_used_blocks = 0
 
     # -- request lifecycle -------------------------------------------------
     def _finish_obs(self, rid, reason: str, trace_id, root_span,
@@ -1013,15 +1063,29 @@ class LLMEngine:
         self._prefill_fns[(sb, npb_pf)] = fn
         return fn
 
-    def _prefill_prefix_fn(self, sb: int, npb_pf: int):
+    def _prefill_prefix_fn(self, sb: int, npb_pf: int,
+                           all_positions: bool = False):
         """Prefix-resume prompt pass: each row starts at its per-row
         cached offset `pstart` (page-aligned). The suffix's q/k/v are
         computed fresh and its self-attention stays in registers
         (exactly the legacy prefill); attention over the cached prefix
         reads the POOL through the per-row block-ownership map, the
         same masked whole-pool pattern decode uses. Rows with
-        pstart=0 reduce to the legacy math."""
-        hit = self._prefill_fns.get((sb, npb_pf, "prefix"))
+        pstart=0 reduce to the legacy math.
+
+        all_positions=True builds the SPECULATIVE VERIFY variant of
+        the same executable: the suffix is a row's [last committed
+        token + k drafts] window (pstart = tokens in the cache — not
+        page-aligned here, which is fine: the ownership map masks by
+        exact position, and `ensure_writable` guarded the write
+        range), and tokens are sampled at EVERY suffix position
+        instead of only the last — one weight/pool stream scores all
+        k+1 positions, which is the entire speedup of speculative
+        decoding over one-token-per-stream decode. The per-position
+        math is the prefix-resume math verbatim, the same family of
+        executables the bit-identity oracle tests pin."""
+        fkey = (sb, npb_pf, "verify" if all_positions else "prefix")
+        hit = self._prefill_fns.get(fkey)
         if hit is not None:
             return hit
         from ..jit import _functional_params
@@ -1144,6 +1208,17 @@ class LLMEngine:
                                      o.astype(x._data.dtype))
                     x = fam.mlp(layer, x)
                 x = fam.final(x)
+                if all_positions:
+                    # verify: greedy targets at every suffix position
+                    # (j scores the token AFTER j committed/drafted
+                    # tokens); dead rows/positions are ignored by the
+                    # host-side acceptance
+                    lg = fam.logits(x)._data         # [B, sb, vocab]
+                    nxt, _ = _pick_token(
+                        lg.reshape(B * sb, -1).astype(jnp.float32),
+                        key, self.do_sample, self.temperature,
+                        self.top_p, self.top_k)
+                    return nxt.reshape(B, sb), new_k, new_v
                 last_idx = jnp.maximum(slen - 1, 0)          # [B]
                 last = jnp.take_along_axis(
                     x._data, last_idx[:, None, None], axis=1)  # [B,1,h]
@@ -1154,8 +1229,9 @@ class LLMEngine:
                 return nxt, new_k, new_v
 
         fn = _CompileTimed(jax.jit(prefill, donate_argnums=(1, 2)),
-                           "engine_prefix_resume")
-        self._prefill_fns[(sb, npb_pf, "prefix")] = fn
+                           "engine_verify" if all_positions
+                           else "engine_prefix_resume")
+        self._prefill_fns[fkey] = fn
         return fn
 
     def _decode_fn(self, chunk: int):
@@ -1373,6 +1449,7 @@ class LLMEngine:
                   if s is not None and (only is None or s is only)]
         if not active:
             return {}
+        self._note_pool_highwater()
         B = self.max_batch
         NB = self.cache.allocator.num_blocks
         active_slots = {s.slot for s in active}
@@ -1416,6 +1493,254 @@ class LLMEngine:
 
     def _last_token(self, seq: _Seq) -> int:
         return int(seq.out[-1]) if seq.out else int(seq.prompt[-1])
+
+    def _note_pool_highwater(self) -> None:
+        """Track the pool's true in-step occupancy high-water (pages
+        off the free list right after a lease, BEFORE any rollback
+        releases them) — `available_blocks` after a step can't see the
+        transient verify/decode lease, and peak usage is exactly what
+        the spec-vs-chunked equal-HBM comparison is about."""
+        used = self.cache.allocator.num_blocks \
+            - self.cache.allocator.num_free
+        if used > self.peak_used_blocks:
+            self.peak_used_blocks = used
+
+    # -- speculative decoding ---------------------------------------------
+    def _propose_drafts(self, active: List[_Seq]):
+        """Host-side drafting: {slot: int32 drafts} plus the step's
+        verify width k. Each row's draft budget is clamped so drafted
+        tokens stay inside the accounting the scheduler already
+        enforces — the model-length headroom (the verify window writes
+        k+1 positions) and the row's remaining generation budget (a
+        draft the row could never commit is never verified), so
+        speculation can't push a lease past what add_request validated
+        or starve deadline/shed-load checks of steps."""
+        drafts: Dict[int, np.ndarray] = {}
+        ctxs: Dict[int, np.ndarray] = {}
+        k_step = 0
+        for s in active:
+            kmax = min(self._spec_k,
+                       self.max_model_len - s.length - 1,
+                       s.max_new - len(s.out) - 1)
+            d = np.zeros((0,), np.int32)
+            ctx = self._merged_tokens(s)
+            ctxs[s.slot] = ctx
+            if kmax > 0:
+                try:
+                    d = np.asarray(self._proposer.propose(
+                        ctx, int(kmax)),
+                        np.int32).reshape(-1)[:kmax]
+                except Exception:
+                    # drafting is best-effort by contract: a proposer
+                    # that chokes on one request's context must not
+                    # take the step (or the batch) down — that row
+                    # simply decodes without drafts this step
+                    self.stats["spec_proposer_errors"] += 1
+            drafts[s.slot] = d
+            k_step = max(k_step, len(d))
+        return drafts, ctxs, k_step
+
+    def _run_spec_step(self, finished: List[GenerationResult]) -> bool:
+        """One speculative decode step for every active slot: propose
+        drafts, lease the k+1-token verify window (preempting under
+        pressure, capped at each row's token budget), run ONE batched
+        verify executable over all k+1 positions, commit the longest
+        matching prefix + the bonus token, and roll the KV lease back
+        to the accepted length (truncate staged writes, unref pages).
+        Returns False when no row drafted anything — the caller falls
+        back to the chunked decode path, which amortizes host sync
+        better when nothing is predictable."""
+        active = [s for s in self.slots if s is not None]
+        if not active:
+            return False
+        drafts, ctxs, k_step = self._propose_drafts(active)
+        # a mostly-undrafted batch decodes faster on the chunked path:
+        # a verify step advances an undrafted row by ONE token where a
+        # decode chunk advances it by `decode_chunk` — only take the
+        # spec path when at least half the batch is drafting (all-or-
+        # nothing per step; both paths are oracle-exact, so the policy
+        # only moves throughput)
+        drafting = sum(1 for d in drafts.values() if len(d))
+        if k_step <= 0 or 2 * drafting < len(active):
+            return False
+        # the verify width is FIXED at the configured k (+1), not the
+        # step's max draft length: draft lengths vary step to step
+        # (n-gram hits are as long as the matched continuation), and a
+        # per-length executable would pay an unpredictable mid-serving
+        # compile per new length — dead positions are masked and their
+        # writes dropped, so padding costs only compute
+        n = self._spec_k + 1
+        try:
+            tgt, active = self._spec_device_phase(active, drafts, n,
+                                                  k_step)
+        except Exception:
+            # a failure raised by the donated verify call itself is
+            # fatal (the cache buffers are consumed — same rule as
+            # the decode path); anything else — a fault injection, a
+            # watchdog trip, a lease MemoryError, a host-prep bug —
+            # degrades THIS step to the chunked decode path, which
+            # carries the per-sequence poisoned-request isolation.
+            # Any pages the verify lease took stay delta-accounted
+            # and return at finish/preemption. Nothing has been
+            # committed yet, so the fallback re-decodes from exactly
+            # the pre-step state.
+            if any(getattr(k, "is_deleted", lambda: False)()
+                   for k in self.cache.key_caches):
+                raise
+            self.stats["spec_step_errors"] += 1
+            return False
+        if active is None:
+            return True                 # everything preempted mid-lease
+        # ---- point of no return: device results are in host hands.
+        # Host-side failures below (truncate invariants, prefix
+        # commits) would leave s.out extended without matching KV —
+        # falling back to chunked decode from that state would
+        # silently diverge from the greedy oracle, so they surface
+        # loudly instead.
+        self.stats["spec_steps"] += 1
+        step_drafted = step_accepted = 0
+        for s in active:
+            b = s.slot
+            d = drafts[b]
+            a = accept_drafts(d, tgt[b])
+            committed = tgt[b, :a + 1]      # accepted drafts + bonus
+            n_before = len(s.out)
+            for t in committed:
+                if len(s.out) >= s.max_new:
+                    break
+                s.out.append(int(t))
+                self.stats["decode_tokens"] += 1
+                if (self.eos_token_id is not None
+                        and int(t) == self.eos_token_id):
+                    break
+            n_app = len(s.out) - n_before
+            # KV rollback: the cache holds valid KV exactly for the
+            # committed tokens (positions start..start+n_app-1 were
+            # written from the last committed token + accepted
+            # drafts); rejected positions' staged writes fall past the
+            # truncated lease — pages unref'd, never hash-indexed
+            new_len = s.length + n_app
+            self.cache.truncate(s.rid, new_len)
+            s.length = new_len
+            # accepted = drafts that COMMITTED (the counter's
+            # contract): a draft that matched the target but fell past
+            # an eos/max_new clamp was rolled back like a mismatch,
+            # and counts as rejected
+            a = min(a, n_app)
+            step_drafted += len(d)
+            step_accepted += a
+            self.stats["spec_drafted_tokens"] += len(d)
+            self.stats["spec_accepted_tokens"] += a
+            if _ot._ENABLED and s.trace_id is not None:
+                _ot.add_event(
+                    "request.verify", self._t_verify0 * 1e6,
+                    (self._t_verify1 - self._t_verify0) * 1e6,
+                    trace=(s.trace_id, _ot.new_span_id(), s.root_span),
+                    args={"request_id": str(s.rid),
+                          "drafted": int(len(d)),
+                          "accepted": int(a),
+                          "committed": int(n_app)})
+            if self.cache.enable_prefix_caching:
+                # identical to the decode-chunk path: only fully
+                # ACCEPTED full blocks can reach the hash index (the
+                # lease was truncated first, and commit_prefix caps at
+                # the leased length). The pre-step context + this
+                # step's commits IS _merged_tokens(s), rebuilt-free
+                ntok = min(s.length, len(s.prompt) + len(s.out))
+                if self.cache.cached_prefix_len(s.rid) \
+                        + self.block_size <= ntok:
+                    merged = np.concatenate(
+                        [ctxs[b], np.asarray(s.out[n_before:],
+                                             np.int32)])
+                    self.cache.commit_prefix(s.rid, merged, upto=ntok)
+            self._maybe_finish(s, finished)
+        if _om._ENABLED:
+            m = _metrics()
+            if step_accepted:
+                m["spec"].labels(outcome="accepted").inc(step_accepted)
+            if step_drafted - step_accepted:
+                m["spec"].labels(outcome="rejected").inc(
+                    step_drafted - step_accepted)
+            if self.stats["spec_drafted_tokens"]:
+                m["spec_rate"].set(self.stats["spec_accepted_tokens"]
+                                   / self.stats["spec_drafted_tokens"])
+        return True
+
+    def _spec_device_phase(self, active, drafts, n, k_step):
+        """Lease + batched verify call for `_run_spec_step`. Returns
+        (targets [B, n] np.int32, surviving active list) — or
+        (None, None) when preemption during leasing emptied the batch.
+        Everything in here may fail WITHOUT having mutated host-side
+        sequence state, which is what makes the caller's degrade-to-
+        chunked-decode fallback safe."""
+        # lease each row's LIVE verify window up front (preempting if
+        # needed): only the row's own 1+len(drafts) positions ever
+        # write (dead padding scatters out of bounds), and the lease
+        # is capped at the sequence's remaining token budget exactly
+        # like the chunked decode path — a rejected draft can never
+        # hold pages past the budget add_request validated, and the
+        # delta-based lease never double-leases on retry
+        for s in list(active):
+            if self.slots[s.slot] is not s:     # got preempted meanwhile
+                continue
+            faults.fault_point("engine.verify.seq", rid=s.rid)
+            live = 1 + len(drafts.get(s.slot, ()))
+            want = min(s.length + live, max(s.token_budget, s.length))
+            by = want - self.cache.length(s.rid)
+            if by > 0 and not self._grow(s, by):
+                raise MemoryError(
+                    "paged pool too small for even one sequence's "
+                    "verify window — enlarge num_blocks")
+            self.cache.ensure_writable(s.rid, s.length)
+        active = [s for s in self.slots if s is not None]
+        if not active:
+            return None, None
+        self._note_pool_highwater()
+        B = self.max_batch
+        NB = self.cache.allocator.num_blocks
+        # operand layout is the prefix-resume prefill's: each row's
+        # "suffix" is its verify window [last committed token, k
+        # drafts] at absolute positions length..length+k, the cached
+        # context is read from the pool through the ownership map.
+        # Inactive/padded positions are dead (>= row plen): their
+        # writes scatter out of bounds and drop
+        ids = np.zeros((B, n), np.int32)
+        pstart = np.zeros((B,), np.int32)
+        plen = np.zeros((B,), np.int32)
+        tbl = np.full((B, self.npb_full), -1, np.int32)
+        off = np.full((B, NB), -1, np.int32)
+        for s in active:
+            b = s.slot
+            d = drafts.get(b, np.zeros((0,), np.int32))
+            drafts[b] = d
+            ids[b, 0] = self._last_token(s)
+            ids[b, 1:1 + len(d)] = d
+            pstart[b] = s.length
+            plen[b] = s.length + 1 + len(d)
+            pages = self.cache.pages(s.rid)
+            tbl[b, :len(pages)] = pages
+            off[b, pages] = np.arange(len(pages), dtype=np.int32) \
+                * self.block_size
+        fn = self._prefill_prefix_fn(n, self.npb_full,
+                                     all_positions=True)
+        kcs, vcs = self.cache.key_caches, self.cache.value_caches
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
+        with _ot.span("engine.verify", rows=len(active), k=k_step):
+            with self._step_watchdog("engine verify step"):
+                tgt, kcs, vcs = fn(
+                    [t._data for t in self._tensors], kcs, vcs,
+                    jnp.asarray(ids), jnp.asarray(pstart),
+                    jnp.asarray(plen), jnp.asarray(tbl),
+                    jnp.asarray(off), sub)
+                tgt = jax.block_until_ready(tgt)
+        t1 = time.perf_counter()
+        for i in range(self.cache.num_layers):
+            self.cache.update(i, kcs[i], vcs[i])
+        self._t_verify0, self._t_verify1 = t0, t1
+        if _om._ENABLED:
+            _metrics()["verify"].observe(t1 - t0)
+        return np.asarray(tgt), active      # [B, n] greedy targets
 
     def _step_watchdog(self, what: str):
         """Hang detector around a device step (step_timeout_s)."""
@@ -1578,6 +1903,12 @@ class LLMEngine:
                         _metrics()["ttft"].observe(
                             seq.t_first - seq.t_enq)
                 self._maybe_finish(seq, finished)
+        if self._proposer is not None and self._run_spec_step(finished):
+            # speculative step committed tokens, rolled back the KV
+            # lease, and retired finished sequences itself (its device
+            # phase degrades to the chunked path below on failure; see
+            # _run_spec_step)
+            return finished
         try:
             chunk_out = self._run_decode_chunk()
         except Exception:

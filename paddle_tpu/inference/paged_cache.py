@@ -426,6 +426,40 @@ class PagedKVCache:
             pages.extend(self._alloc(need))
         self._lengths[seq_id] = new_len
 
+    def truncate(self, seq_id, num_tokens: int) -> int:
+        """KV rollback for speculative decoding: shrink the sequence's
+        leased length to `num_tokens`, unref'ing every page past the
+        new length. The engine leases k+1 tokens of headroom for a
+        verify step and rolls the lease back to the accepted length —
+        rejected positions' staged writes land beyond `num_tokens`, so
+        truncating the lease discards them (they are masked out of all
+        attention and overwritten before ever becoming readable).
+
+        `num_tokens` may not cut below the committed prefix chain:
+        committed blocks are content-addressed pool state other
+        sequences may already be leasing, and the engine only ever
+        commits ACCEPTED tokens, so rollback by construction stays
+        above them. Returns the number of pages released."""
+        new_len = int(num_tokens)
+        cur_len = self._lengths[seq_id]
+        if new_len > cur_len:
+            raise ValueError(
+                f"truncate({seq_id!r}, {new_len}): sequence only "
+                f"holds {cur_len} tokens (use extend to grow)")
+        if new_len < self.cached_prefix_len(seq_id):
+            raise ValueError(
+                f"truncate({seq_id!r}, {new_len}): cannot roll back "
+                f"below the committed prefix "
+                f"({self.cached_prefix_len(seq_id)} tokens) — "
+                "committed blocks are shared prefix-cache state")
+        pages = self._pages[seq_id]
+        keep = -(-new_len // self.block_size) if new_len else 0
+        dropped = pages[keep:]
+        del pages[keep:]
+        self._lengths[seq_id] = new_len
+        self._release_pages(dropped)
+        return len(dropped)
+
     def free_sequence(self, seq_id) -> None:
         pages = self._pages.pop(seq_id)
         del self._lengths[seq_id]

@@ -112,46 +112,59 @@ class AdaptiveAvgPool3D(Layer):
 
 
 class AdaptiveMaxPool1D(Layer):
+    """return_mask=True returns (out, indices): int32 argmax positions
+    along L, the unpool contract (ref: nn/layer/pooling.py)."""
+
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
-        if return_mask:
-            raise NotImplementedError(
-                "AdaptiveMaxPool1D(return_mask=True) is not supported "
-                "yet; use max_pool2d_with_index for index-producing "
-                "pooling")
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return ops.adaptive_max_pool1d(x, self.output_size)
+        return ops.adaptive_max_pool1d(x, self.output_size,
+                                       return_mask=self.return_mask)
 
 
 class AdaptiveMaxPool3D(Layer):
+    """return_mask=True returns (out, indices): int32 argmax indices
+    flat into the input's D*H*W volume (max_pool3d_with_index
+    contract; feeds unpool3d)."""
+
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
-        if return_mask:
-            raise NotImplementedError(
-                "AdaptiveMaxPool3D(return_mask=True) is not supported "
-                "yet")
         self.output_size = output_size
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return ops.adaptive_max_pool3d(x, self.output_size)
+        return ops.adaptive_max_pool3d(x, self.output_size,
+                                       return_mask=self.return_mask)
 
 
 class MaxUnPool2D(Layer):
-    """ref: nn/layer/pooling.py MaxUnPool2D over the unpool op."""
+    """ref: nn/layer/pooling.py MaxUnPool2D over the unpool op.
+    data_format NCHW or NHWC; indices are flat H*W positions per
+    (batch, channel) either way (the max_pool2d_with_index contract),
+    so the NHWC path transposes around the same scatter."""
 
     def __init__(self, kernel_size, stride=None, padding=0,
                  data_format="NCHW", output_size=None, name=None):
         super().__init__()
-        if data_format != "NCHW":
-            raise NotImplementedError(
-                "MaxUnPool2D currently supports NCHW only")
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(
+                f"MaxUnPool2D data_format must be NCHW or NHWC, got "
+                f"{data_format!r}")
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
+        self.data_format = data_format
         self.output_size = output_size
 
     def forward(self, x, indices):
+        if self.data_format == "NHWC":
+            out = ops.unpool(ops.transpose(x, [0, 3, 1, 2]),
+                             ops.transpose(indices, [0, 3, 1, 2]),
+                             self.kernel_size, self.stride,
+                             self.padding, self.output_size)
+            return ops.transpose(out, [0, 2, 3, 1])
         return ops.unpool(x, indices, self.kernel_size, self.stride,
                           self.padding, self.output_size)

@@ -1163,15 +1163,24 @@ def bilinear(x1, x2, weight, bias=None):
 
 
 @register_op("adaptive_max_pool1d")
-def adaptive_max_pool1d(x, output_size):
-    """ref: max_pool2d_with_index family, 1-D adaptive variant."""
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    """ref: max_pool2d_with_index family, 1-D adaptive variant.
+    return_mask=True also returns the int32 argmax positions along L
+    (indices into the unpadded input, the unpool contract)."""
     L = x.shape[-1]
     o = output_size if isinstance(output_size, int) else output_size[0]
-    cols = []
+    cols, idxs = [], []
     for i in range(o):
         lo, hi = (i * L) // o, -(-((i + 1) * L) // o)
-        cols.append(jnp.max(x[..., lo:hi], axis=-1))
-    return jnp.stack(cols, axis=-1)
+        win = x[..., lo:hi]
+        cols.append(jnp.max(win, axis=-1))
+        if return_mask:
+            idxs.append(jnp.argmax(win, axis=-1).astype(jnp.int32)
+                        + lo)
+    out = jnp.stack(cols, axis=-1)
+    if return_mask:
+        return out, jnp.stack(idxs, axis=-1)
+    return out
 
 
 @register_op("adaptive_avg_pool3d")
@@ -1180,8 +1189,46 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
 
 
 @register_op("adaptive_max_pool3d")
-def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
-    return _adaptive_pool3d(x, output_size, jnp.max, data_format)
+def adaptive_max_pool3d(x, output_size, data_format="NCDHW",
+                        return_mask=False):
+    """return_mask=True also returns int32 argmax indices FLAT into
+    the input's D*H*W spatial volume (the reference
+    max_pool3d_with_index contract; feeds unpool3d). Mask output is
+    NCDHW-only, matching the reference layer surface."""
+    if not return_mask:
+        return _adaptive_pool3d(x, output_size, jnp.max, data_format)
+    if data_format[-1] == "C":
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) supports NCDHW "
+            "only (the reference AdaptiveMaxPool3D has no "
+            "data_format)")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    N, C, D, H, W = x.shape
+    od, oh, ow = output_size
+    planes, idxp = [], []
+    for i in range(od):
+        d0, d1 = (i * D) // od, -(-((i + 1) * D) // od)
+        rows, idxr = [], []
+        for j in range(oh):
+            h0, h1 = (j * H) // oh, -(-((j + 1) * H) // oh)
+            cols, idxc = [], []
+            for k in range(ow):
+                w0, w1 = (k * W) // ow, -(-((k + 1) * W) // ow)
+                win = x[:, :, d0:d1, h0:h1, w0:w1]
+                flat = win.reshape(N, C, -1)
+                arg = jnp.argmax(flat, axis=-1)
+                cols.append(jnp.max(flat, axis=-1))
+                hh, ww = h1 - h0, w1 - w0
+                ld, rem = arg // (hh * ww), arg % (hh * ww)
+                g = ((ld + d0) * H + (rem // ww + h0)) * W \
+                    + (rem % ww + w0)
+                idxc.append(g.astype(jnp.int32))
+            rows.append(jnp.stack(cols, axis=-1))
+            idxr.append(jnp.stack(idxc, axis=-1))
+        planes.append(jnp.stack(rows, axis=-2))
+        idxp.append(jnp.stack(idxr, axis=-2))
+    return (jnp.stack(planes, axis=-3), jnp.stack(idxp, axis=-3))
 
 
 def _adaptive_pool3d(x, output_size, reducer, data_format):

@@ -163,9 +163,57 @@ class TestReviewFixes:
         with pytest.raises(ValueError, match="unreachable"):
             layer(x, output_size=(20, 20, 20))
 
-    def test_return_mask_refused(self):
-        with pytest.raises(NotImplementedError):
-            nn.AdaptiveMaxPool3D(2, return_mask=True)
+    def test_adaptive_max_pool1d_return_mask(self):
+        """Oracle: torch return_indices — indices are positions along
+        the unpadded L axis (the unpool contract)."""
+        x = t(np.random.default_rng(7).standard_normal((2, 3, 10))
+              .astype(np.float32))
+        out, idx = nn.AdaptiveMaxPool1D(4, return_mask=True)(x)
+        ref, ridx = TF.adaptive_max_pool1d(torch.tensor(x.numpy()), 4,
+                                           return_indices=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), ridx.numpy())
+        # mask actually addresses the max: gather reproduces the output
+        g = np.take_along_axis(x.numpy(), idx.numpy(), axis=-1)
+        np.testing.assert_allclose(g, out.numpy())
+
+    def test_adaptive_max_pool3d_return_mask(self):
+        """Oracle: torch return_indices — indices flat into D*H*W."""
+        x = t(np.random.default_rng(8).standard_normal((1, 2, 4, 6, 8))
+              .astype(np.float32))
+        out, idx = nn.AdaptiveMaxPool3D((2, 3, 4),
+                                        return_mask=True)(x)
+        ref, ridx = TF.adaptive_max_pool3d(torch.tensor(x.numpy()),
+                                           (2, 3, 4),
+                                           return_indices=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), ridx.numpy())
+        flat = x.numpy().reshape(1, 2, -1)
+        g = np.take_along_axis(flat, idx.numpy().reshape(1, 2, -1),
+                               axis=-1)
+        np.testing.assert_allclose(
+            g.reshape(out.numpy().shape), out.numpy())
+
+    def test_max_unpool2d_nhwc(self):
+        """NHWC MaxUnPool2D: same flat-H*W index contract as NCHW,
+        scatter transposed around the same op; oracle = the NCHW
+        path on the transposed tensors (itself torch-oracled via
+        max_pool2d_with_index round-trip tests)."""
+        rng = np.random.default_rng(9)
+        xc = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        out_c, idx_c = ops.max_pool2d_with_index(t(xc), 2, 2)
+        up_c = nn.MaxUnPool2D(2, 2)(out_c, idx_c)
+        up_h = nn.MaxUnPool2D(2, 2, data_format="NHWC")(
+            ops.transpose(out_c, [0, 2, 3, 1]),
+            ops.transpose(idx_c, [0, 2, 3, 1]))
+        np.testing.assert_allclose(
+            up_h.numpy(), np.transpose(up_c.numpy(), (0, 2, 3, 1)))
+        tref = TF.max_unpool2d(torch.tensor(out_c.numpy()),
+                               torch.tensor(idx_c.numpy().astype(
+                                   np.int64)), 2, 2)
+        np.testing.assert_allclose(up_c.numpy(), tref.numpy())
+        with pytest.raises(ValueError):
+            nn.MaxUnPool2D(2, 2, data_format="NDHWC")
 
     def test_clip_delegation_single_impl(self):
         import paddle_tpu.nn.clip as clipmod

@@ -1,0 +1,521 @@
+"""Speculative decoding for the paged serving engine
+(inference/speculative.py + the LLMEngine verify path + PagedKVCache
+rollback).
+
+The load-bearing property is ORACLE EXACTNESS: greedy engine outputs
+with speculative_config set must be bit-identical to speculation off
+and to the dense generate() baseline — including with prefix caching
+under LRU eviction pressure, under mid-generation preemption, on the
+LLaMA (rope) family, and on int8 pools. Rollback must be leak-free:
+rejected drafts return their pages (strict allocator validation stays
+on throughout), and only fully ACCEPTED blocks ever enter the
+prefix-cache hash index."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import (DraftModelProposer, DraftProposer,
+                                  LLMEngine, NgramProposer, PagedKVCache,
+                                  SpeculativeConfig)
+from paddle_tpu.inference.speculative import accept_drafts
+from paddle_tpu.models import GPTForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.models.gpt import gpt_tiny
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    pt.seed(0)
+    return GPTForCausalLM(gpt_tiny())
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    pt.seed(0)
+    return LlamaForCausalLM(llama_tiny())
+
+
+def _oracle(model, prompt, n_new):
+    out = generate(model, pt.to_tensor(np.asarray(prompt, np.int32)[None]),
+                   max_new_tokens=n_new).numpy()[0]
+    return out[len(prompt):]
+
+
+def _spec(k=3, **kw):
+    return SpeculativeConfig(num_speculative_tokens=k, **kw)
+
+
+def _engine(model, spec=None, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("prompt_quantum", 16)
+    kw.setdefault("max_model_len", 64)
+    return LLMEngine(model, speculative_config=spec, **kw)
+
+
+def _drain(eng):
+    done = {}
+    while eng.has_unfinished:
+        for r in eng.step():
+            done[r.request_id] = r
+    return done
+
+
+def _repetitive_prompt(rng, pat_len=8, reps=4):
+    return np.tile(rng.integers(0, 1024, (pat_len,)).astype(np.int32),
+                   reps)
+
+
+class _WrongProposer(DraftProposer):
+    """Adversarial drafts: always propose token ids the tiny models
+    essentially never emit — every draft verifies as rejected, so each
+    step exercises the full KV-rollback path."""
+
+    def propose(self, context, k):
+        return np.full((k,), 1023, np.int32)
+
+
+# ---------------------------------------------------------------------------
+# proposers (host-side units)
+# ---------------------------------------------------------------------------
+class TestNgramProposer:
+    def test_matches_most_recent_continuation(self):
+        p = NgramProposer(1, 3)
+        ctx = np.array([1, 2, 3, 4, 1, 2, 3], np.int32)
+        np.testing.assert_array_equal(p.propose(ctx, 3), [4, 1, 2])
+
+    def test_no_match_is_empty(self):
+        p = NgramProposer(2, 4)
+        assert p.propose(np.arange(10, dtype=np.int32), 4).size == 0
+
+    def test_k_clamps_and_zero_k(self):
+        p = NgramProposer(1, 2)
+        ctx = np.array([7, 8, 9, 7, 8, 9, 7, 8], np.int32)
+        assert len(p.propose(ctx, 2)) == 2
+        assert p.propose(ctx, 0).size == 0
+
+    def test_prefers_match_with_full_continuation(self):
+        """Two occurrences of the suffix bigram: when the most recent
+        match's continuation would be truncated below k, the earlier
+        (full-k) match wins so the drafts fill the verify window;
+        when the recent match has k tokens of continuation, recency
+        wins (it tracks the current phase of a repetition)."""
+        p = NgramProposer(1, 2)
+        ctx = np.array([5, 6, 11, 12, 13, 14, 5, 6, 1, 5, 6], np.int32)
+        # k=4: the late match (pos 6) has only 3 follow-up tokens ->
+        # the early match supplies the full window
+        np.testing.assert_array_equal(p.propose(ctx, 4),
+                                      [11, 12, 13, 14])
+        # k=3 fits after the late match -> recency wins
+        np.testing.assert_array_equal(p.propose(ctx, 3), [1, 5, 6])
+
+    def test_min_n_respected(self):
+        # suffix unigram matches, but min_n=2 needs a bigram match
+        p = NgramProposer(2, 3)
+        ctx = np.array([4, 9, 1, 4], np.int32)
+        assert p.propose(ctx, 2).size == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            NgramProposer(3, 2)
+        with pytest.raises(ValueError):
+            SpeculativeConfig(num_speculative_tokens=0)
+        with pytest.raises(ValueError):
+            SpeculativeConfig(proposer="draft_model").build_proposer()
+        with pytest.raises(ValueError):
+            SpeculativeConfig(proposer="nope").build_proposer()
+
+
+class TestAcceptance:
+    def test_longest_matching_prefix(self):
+        assert accept_drafts([1, 2, 3], [1, 2, 3, 9]) == 3
+        assert accept_drafts([1, 2, 3], [1, 9, 3, 4]) == 1
+        assert accept_drafts([5], [4, 4]) == 0
+        assert accept_drafts([], [7]) == 0
+
+
+# ---------------------------------------------------------------------------
+# oracle exactness: spec on == spec off == dense generate()
+# ---------------------------------------------------------------------------
+class TestSpecBitIdentity:
+    def test_gpt_matches_oracle_and_spec_off(self, tiny_gpt):
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+                   for n in (5, 9, 13)] + [_repetitive_prompt(rng)]
+        n_new = 12
+        on = _engine(tiny_gpt, _spec())
+        off = _engine(tiny_gpt)
+        res_on = on.generate(prompts, max_new_tokens=n_new)
+        res_off = off.generate(prompts, max_new_tokens=n_new)
+        for p, a, b in zip(prompts, res_on, res_off):
+            want = _oracle(tiny_gpt, p, n_new)
+            np.testing.assert_array_equal(a.output_ids, want)
+            np.testing.assert_array_equal(b.output_ids, want)
+            assert len(a.output_ids) == n_new     # no overshoot past
+            assert a.finish_reason == "length"    # max_new from drafts
+        assert on.stats["spec_steps"] > 0
+        assert on.cache.available_blocks == \
+            on.cache.allocator.num_blocks - 1
+
+    def test_exact_under_prefix_cache_lru_pressure(self, tiny_gpt):
+        """Speculation composes with prefix caching under a pool so
+        small that parked pages MUST be LRU-evicted mid-run."""
+        rng = np.random.default_rng(2)
+        shared = rng.integers(0, 1024, (16,)).astype(np.int32)
+        prompts = [
+            np.concatenate([shared,
+                            rng.integers(0, 1024, (4,)).astype(np.int32)]),
+            rng.integers(0, 1024, (20,)).astype(np.int32),
+            rng.integers(0, 1024, (20,)).astype(np.int32),
+            np.concatenate([shared,
+                            rng.integers(0, 1024, (6,)).astype(np.int32)]),
+        ]
+        n_new = 12
+        on = _engine(tiny_gpt, _spec(), max_batch=1, block_size=8,
+                     num_blocks=8)
+        outs_on = []
+        for i, p in enumerate(prompts):
+            on.add_request(i, p, max_new_tokens=n_new)
+            outs_on.append(_drain(on)[i].output_ids)
+        for p, a in zip(prompts, outs_on):
+            np.testing.assert_array_equal(a, _oracle(tiny_gpt, p, n_new))
+        assert on.cache.available_blocks == \
+            on.cache.allocator.num_blocks - 1
+
+    def test_exact_under_preemption(self, tiny_gpt):
+        """A pool too small for both sequences forces mid-generation
+        preemption while speculation is committing multi-token steps;
+        recompute-resume + speculation must still be oracle-exact."""
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+                   for n in (17, 18)]
+        n_new = 20
+        eng = _engine(tiny_gpt, _spec(), block_size=8, num_blocks=9)
+        results = eng.generate(prompts, max_new_tokens=n_new)
+        assert eng.stats["preemptions"] >= 1
+        for p, r in zip(prompts, results):
+            np.testing.assert_array_equal(r.output_ids,
+                                          _oracle(tiny_gpt, p, n_new))
+        assert eng.cache.available_blocks == \
+            eng.cache.allocator.num_blocks - 1
+
+    def test_llama_family_rope(self, tiny_llama):
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+                   for n in (6, 11)] + [_repetitive_prompt(rng, 6, 3)]
+        eng = _engine(tiny_llama, _spec())
+        for p, r in zip(prompts, eng.generate(prompts,
+                                              max_new_tokens=8)):
+            np.testing.assert_array_equal(r.output_ids,
+                                          _oracle(tiny_llama, p, 8))
+
+    def test_int8_pool_matches_spec_off(self, tiny_gpt):
+        """int8 engines aren't comparable to the fp oracle (quantised
+        cache), so the oracle is the spec-OFF int8 engine — the verify
+        executable must dequantise exactly like decode does."""
+        from paddle_tpu.inference import calibrate_kv_scales
+        import jax.numpy as jnp
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+                   for n in (8,)] + [_repetitive_prompt(rng, 6, 3)]
+        scales = calibrate_kv_scales(tiny_gpt, prompts[0][None])
+        ref = _engine(tiny_gpt, kv_quant_scales=scales)
+        on = _engine(tiny_gpt, _spec(), kv_quant_scales=scales)
+        assert on.cache.key_caches[0].dtype == jnp.int8
+        ref_out = [r.output_ids for r in ref.generate(prompts, 8)]
+        for a, b in zip([r.output_ids
+                         for r in on.generate(prompts, 8)], ref_out):
+            np.testing.assert_array_equal(a, b)
+
+    def test_sampling_refused(self, tiny_gpt):
+        with pytest.raises(ValueError, match="greedy"):
+            _engine(tiny_gpt, _spec(), do_sample=True)
+
+
+# ---------------------------------------------------------------------------
+# acceptance accounting
+# ---------------------------------------------------------------------------
+class TestAcceptanceCounters:
+    def test_same_model_draft_accepts_everything(self, tiny_gpt):
+        """Self-drafting with the TARGET model is the acceptance
+        oracle: its greedy continuation IS the verify target, so every
+        drafted token must be accepted (acceptance rate exactly 1.0)."""
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+                   for n in (5, 9)]
+        eng = _engine(tiny_gpt, _spec(
+            proposer=DraftModelProposer(tiny_gpt)))
+        for p, r in zip(prompts, eng.generate(prompts,
+                                              max_new_tokens=12)):
+            np.testing.assert_array_equal(r.output_ids,
+                                          _oracle(tiny_gpt, p, 12))
+        st = eng.stats
+        assert st["spec_drafted_tokens"] > 0
+        assert st["spec_accepted_tokens"] == st["spec_drafted_tokens"]
+
+    def test_ngram_accepts_on_repetitive_prompt_deterministically(
+            self, tiny_gpt):
+        """The headline self-drafting property: on a repetitive prompt
+        the n-gram proposer must land accepted drafts (>0), and the
+        counters are a pure function of (model, prompts) — two fresh
+        engines agree exactly."""
+        def run():
+            rng = np.random.default_rng(7)
+            prompts = [_repetitive_prompt(rng), _repetitive_prompt(rng)]
+            eng = _engine(tiny_gpt, _spec())
+            eng.generate(prompts, max_new_tokens=16)
+            return dict(eng.stats)
+        a, b = run(), run()
+        assert a["spec_accepted_tokens"] > 0
+        assert a["spec_steps"] > 0
+        # the acceptance-criteria bar: on repetitive traffic the mean
+        # accepted drafts per verify step must beat 1.0 (each step
+        # then commits >2 tokens incl. the bonus)
+        assert a["spec_accepted_tokens"] / a["spec_steps"] > 1.0
+        for k in ("spec_steps", "spec_drafted_tokens",
+                  "spec_accepted_tokens", "decode_tokens"):
+            assert a[k] == b[k], (k, a[k], b[k])
+
+    def test_metrics_spans_and_gauge(self, tiny_gpt):
+        from paddle_tpu.observability import tracing
+        obs.enable()
+        rng = np.random.default_rng(8)
+        prompts = [_repetitive_prompt(rng)]
+        eng = _engine(tiny_gpt, _spec())
+        eng.generate(prompts, max_new_tokens=16)
+        snap = obs.snapshot()
+        tok = snap["paddle_tpu_engine_spec_tokens_total"]["series"]
+        accepted = tok.get(("accepted",), 0)
+        rejected = tok.get(("rejected",), 0)
+        st = eng.stats
+        assert accepted == st["spec_accepted_tokens"] > 0
+        assert accepted + rejected == st["spec_drafted_tokens"]
+        gauge = snap["paddle_tpu_engine_spec_acceptance_ratio"]["series"]
+        assert gauge[()] == pytest.approx(
+            st["spec_accepted_tokens"] / st["spec_drafted_tokens"])
+        # drafted/accepted per step ride the request's trace
+        ev = [e for e in tracing.events() if e["name"] == "request.verify"]
+        assert ev and all("trace_id" in e for e in ev)
+        assert sum(e["args"]["drafted"] for e in ev) == \
+            st["spec_drafted_tokens"]
+        assert sum(e["args"]["accepted"] for e in ev) == \
+            st["spec_accepted_tokens"]
+
+    def test_obs_top_renders_acceptance_line(self, tiny_gpt):
+        import json
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        try:
+            import obs_top
+        finally:
+            sys.path.pop(0)
+        obs.enable()
+        rng = np.random.default_rng(9)
+        eng = _engine(tiny_gpt, _spec())
+        eng.generate([_repetitive_prompt(rng)], max_new_tokens=12)
+        frame = obs_top.render(json.loads(obs.to_json()))
+        assert "spec accept" in frame
+
+
+# ---------------------------------------------------------------------------
+# rollback invariants: no leaks, no partial blocks in the hash index
+# ---------------------------------------------------------------------------
+class TestRollbackInvariants:
+    def test_truncate_releases_pages_and_guards(self):
+        cache = PagedKVCache(num_layers=1, num_blocks=8, kv_heads=1,
+                             block_size=4, head_dim=8, layout="token")
+        cache.add_sequence("s", 10)          # 3 pages
+        assert len(cache.pages("s")) == 3
+        freed = cache.truncate("s", 5)       # back to 2 pages
+        assert freed == 1
+        assert cache.length("s") == 5
+        assert len(cache.pages("s")) == 2
+        assert cache.allocator.num_free == 6
+        assert cache.truncate("s", 5) == 0   # idempotent at same len
+        with pytest.raises(ValueError):
+            cache.truncate("s", 6)           # growth is extend()'s job
+        cache.free_sequence("s")
+        assert cache.allocator.num_free == 8
+
+    def test_truncate_refuses_cutting_committed_prefix(self):
+        cache = PagedKVCache(num_layers=1, num_blocks=8, kv_heads=1,
+                             block_size=4, head_dim=8, layout="token",
+                             enable_prefix_caching=True)
+        toks = np.arange(10, dtype=np.int32)
+        cache.add_sequence("s", 10, tokens=toks)
+        cache.commit_prefix("s", toks)       # 2 full blocks committed
+        with pytest.raises(ValueError, match="committed prefix"):
+            cache.truncate("s", 7)
+        cache.truncate("s", 9)               # above the chain: fine
+        cache.free_sequence("s")
+
+    def test_all_rejected_drafts_leak_nothing(self, tiny_gpt):
+        """Every step drafts garbage, every draft is rejected, every
+        step rolls back: outputs stay oracle-exact, the strict
+        allocator never sees an invalid free, and the pool is fully
+        recovered."""
+        rng = np.random.default_rng(10)
+        prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+                   for n in (5, 9, 13)]
+        n_new = 10
+        eng = _engine(tiny_gpt, _spec(proposer=_WrongProposer()))
+        for p, r in zip(prompts, eng.generate(prompts,
+                                              max_new_tokens=n_new)):
+            np.testing.assert_array_equal(r.output_ids,
+                                          _oracle(tiny_gpt, p, n_new))
+        st = eng.stats
+        assert st["spec_drafted_tokens"] > 0
+        assert st["spec_accepted_tokens"] == 0
+        assert eng.cache.available_blocks == \
+            eng.cache.allocator.num_blocks - 1
+
+    def test_block_accounting_conserved_every_step(self, tiny_gpt):
+        """Mid-flight invariant, checked after EVERY scheduler step:
+        free + parked + leased == num_blocks (+ trash), and no
+        sequence ever holds more pages than its admission-validated
+        token budget allows."""
+        rng = np.random.default_rng(11)
+        prompts = [_repetitive_prompt(rng),
+                   rng.integers(0, 1024, (9,)).astype(np.int32)]
+        n_new = 12
+        eng = _engine(tiny_gpt, _spec())
+        bs = eng.block_size
+        for i, p in enumerate(prompts):
+            eng.add_request(i, p, max_new_tokens=n_new)
+        while eng.has_unfinished:
+            eng.step()
+            nb = eng.cache.allocator.num_blocks
+            leased = sum(len(v) for v in eng.cache._pages.values())
+            parked = eng.cache.lru_pages
+            # leased includes the trash page's registration? (no — the
+            # trash page is allocator-held outside any sequence)
+            assert eng.cache.allocator.num_free + parked + leased \
+                == nb - 1
+            for s in eng.slots:
+                if s is None:
+                    continue
+                budget_pages = -(-s.token_budget // bs)
+                assert len(eng.cache.pages(s.rid)) <= budget_pages
+        assert eng.cache.available_blocks == \
+            eng.cache.allocator.num_blocks - 1
+
+    def test_rejected_blocks_never_enter_prefix_index(self, tiny_gpt):
+        """Prefix-cache poisoning check: with garbage drafts rejected
+        and rolled back every step, a SECOND identical request must
+        hit the index (committed blocks exist) and still be
+        oracle-exact — committed blocks hold only accepted KV."""
+        rng = np.random.default_rng(12)
+        prompt = rng.integers(0, 1024, (18,)).astype(np.int32)
+        n_new = 14
+        eng = _engine(tiny_gpt, _spec(proposer=_WrongProposer()),
+                      max_batch=1)
+        eng.add_request("a", prompt, max_new_tokens=n_new)
+        out1 = _drain(eng)["a"].output_ids
+        hits0 = eng.stats["prefix_cache_hit_tokens"]
+        eng.add_request("b", prompt, max_new_tokens=n_new)
+        out2 = _drain(eng)["b"].output_ids
+        want = _oracle(tiny_gpt, prompt, n_new)
+        np.testing.assert_array_equal(out1, want)
+        np.testing.assert_array_equal(out2, want)
+        assert eng.stats["prefix_cache_hit_tokens"] > hits0
+        # structural form of the same invariant: every hash-indexed
+        # page belongs to a fully committed (page-aligned) chain
+        assert eng.cache.cached_pages == len(eng.cache._hash_to_page)
+        assert set(eng.cache._page_hash.values()) == \
+            set(eng.cache._hash_to_page.keys())
+
+
+# ---------------------------------------------------------------------------
+# degradation: proposer/verify failures must not take the engine down
+# ---------------------------------------------------------------------------
+class _ExplodingProposer(DraftProposer):
+    def propose(self, context, k):
+        raise RuntimeError("proposer boom")
+
+
+class TestDegradation:
+    def test_raising_proposer_degrades_to_plain_decode(self, tiny_gpt):
+        """Drafting is best-effort: a proposer that raises costs its
+        drafts (that row decodes undrafted), never the step or the
+        batch — outputs stay oracle-exact."""
+        rng = np.random.default_rng(20)
+        prompts = [rng.integers(0, 1024, (n,)).astype(np.int32)
+                   for n in (5, 9)]
+        eng = _engine(tiny_gpt, _spec(proposer=_ExplodingProposer()))
+        for p, r in zip(prompts, eng.generate(prompts,
+                                              max_new_tokens=8)):
+            np.testing.assert_array_equal(r.output_ids,
+                                          _oracle(tiny_gpt, p, 8))
+        assert eng.stats["spec_proposer_errors"] > 0
+        assert eng.stats["spec_steps"] == 0        # nothing drafted
+        assert eng.cache.available_blocks == \
+            eng.cache.allocator.num_blocks - 1
+
+    def test_verify_fault_degrades_to_chunked_step(self, tiny_gpt):
+        """An injected fault inside the verify path degrades that step
+        to the (isolation-hardened) chunked decode path instead of
+        crashing step(); serving continues and stays oracle-exact."""
+        from paddle_tpu.resilience import faults
+        rng = np.random.default_rng(21)
+        prompt = _repetitive_prompt(rng)
+        eng = _engine(tiny_gpt, _spec())
+        try:
+            faults.inject("engine.verify.seq",
+                          exc=RuntimeError("verify boom"), times=1)
+            eng.add_request("a", prompt, max_new_tokens=12)
+            out = _drain(eng)["a"]
+        finally:
+            faults.clear_all()
+        np.testing.assert_array_equal(out.output_ids,
+                                      _oracle(tiny_gpt, prompt, 12))
+        assert eng.stats["spec_step_errors"] == 1
+        assert eng.stats["decode_chunks"] >= 1     # the degraded step
+        assert eng.stats["spec_steps"] >= 1        # later steps resume
+        assert eng.cache.available_blocks == \
+            eng.cache.allocator.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# scheduler accounting with speculation on
+# ---------------------------------------------------------------------------
+class TestSchedulerComposition:
+    def test_deadline_still_enforced(self, tiny_gpt):
+        eng = _engine(tiny_gpt, _spec())
+        t = [0.0]
+        eng._now = lambda: t[0]
+        rng = np.random.default_rng(13)
+        eng.add_request("slow", _repetitive_prompt(rng),
+                        max_new_tokens=16, deadline_s=5.0)
+        eng.step()                      # prefill + first verify
+        t[0] = 10.0                     # TTL elapses mid-generation
+        done = _drain(eng)
+        assert done["slow"].finish_reason == "deadline"
+        assert eng.cache.available_blocks == \
+            eng.cache.allocator.num_blocks - 1
+
+    def test_load_shedding_still_enforced(self, tiny_gpt):
+        eng = _engine(tiny_gpt, _spec(), shed_load=True, max_waiting=1)
+        rng = np.random.default_rng(14)
+        for i in range(4):
+            eng.add_request(i, rng.integers(0, 1024, (6,)).astype(
+                np.int32), max_new_tokens=4)
+        done = _drain(eng)
+        reasons = {r.finish_reason for r in done.values()}
+        assert "rejected" in reasons
+        oks = [r for r in done.values() if r.ok]
+        assert oks

@@ -132,6 +132,16 @@ def render(doc, prev=None, dt=None) -> str:
         lines.append(f"  prefix hit   {hit / (hit + miss):6.1%}  "
                      f"({int(hit)} of {int(hit + miss)} prompt tokens)")
 
+    sp = "paddle_tpu_engine_spec_tokens_total"
+    acc = _counter_sum(doc, sp, outcome="accepted")
+    rej = _counter_sum(doc, sp, outcome="rejected")
+    if acc + rej:
+        ar = rate(sp, outcome="accepted")
+        lines.append(
+            f"  spec accept  {acc / (acc + rej):6.1%}  "
+            f"({int(acc)} of {int(acc + rej)} drafted tokens)"
+            + (f"   ({ar:8.1f} acc tok/s)" if ar is not None else ""))
+
     lines.append("== requests ==")
     fin = "paddle_tpu_request_finished_total"
     outcomes = {s["labels"]["reason"]: int(s["value"])
