@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -837,8 +838,49 @@ def bench_spec_decode(on_tpu):
     }
 
 
+def bench_lint(on_tpu):
+    """Static-analysis trajectory: run graftlint over paddle_tpu/ +
+    tools/ against the checked-in baseline, write the full machine
+    report to graftlint_report.json, and put the finding counts on the
+    BENCH line — so the baselined burn-down count (and any new-finding
+    regression) is tracked round over round exactly like a perf
+    number. Pure host work: no device, no jax tracing."""
+    from tools.graftlint import core as gl
+
+    t0 = time.perf_counter()
+    baseline = gl.Baseline.load(gl.default_baseline_path())
+    root = gl.repo_root()
+    report = gl.run_paths([os.path.join(root, "paddle_tpu"),
+                           os.path.join(root, "tools")],
+                          root=root, baseline=baseline)
+    dur = time.perf_counter() - t0
+    out = os.path.abspath("graftlint_report.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(report.to_dict(), f, indent=1)
+    per_rule = {rid: dict(c) for rid, c in
+                sorted(report.per_rule().items())}
+    return {
+        "metric": "graftlint_new_findings",
+        "value": len(report.new),
+        "unit": "findings",
+        # clean = 1.0; any new finding (or parse error) fails the gate
+        "vs_baseline": 1.0 if not (report.new or report.parse_errors)
+                       else 0.0,
+        "extra": {
+            "files": report.files,
+            "baselined": len(report.baselined),
+            "total": len(report.findings),
+            "per_rule": per_rule,
+            "parse_errors": len(report.parse_errors),
+            "report": out,
+            "lint_seconds": round(dur, 3),
+        },
+    }
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2_small,
+    "lint": bench_lint,
     "gpt1p3b": bench_gpt_1p3b,
     "resnet50": bench_resnet50,
     "bert": bench_bert_base,

@@ -578,7 +578,11 @@ class StagedRegion:
         from ..ops.registry import dispatch
 
         layers = [v for v in vals if isinstance(v, Layer)]
-        lkey = tuple(id(L) for L in layers)
+        # identity IS the key here: the cache binds the exact Layer
+        # objects' live parameter tensors, so value-equal layers must
+        # NOT share an entry (id-reuse after a Layer is GC'd is an
+        # accepted hazard: regions are built once per program)
+        lkey = tuple(id(L) for L in layers)  # graftlint: disable=unstable-cache-key
         bound = self._bound_cache.get(lkey)
         if bound is None:
             ptensors, btensors = [], []

@@ -165,8 +165,10 @@ def _pick_token(lf, key, do_sample, temperature, top_p, top_k=0):
     key, sub = jax.random.split(key)
     lt = lf / max(temperature, 1e-6)
     if top_k and 0 < top_k < lt.shape[-1]:
-        # mask everything below the k-th largest logit per row
-        kth = jax.lax.top_k(lt, int(top_k))[0][..., -1:]
+        # mask everything below the k-th largest logit per row.
+        # int(top_k) coerces a STATIC config value (lax.top_k needs a
+        # python int), never a traced array
+        kth = jax.lax.top_k(lt, int(top_k))[0][..., -1:]  # graftlint: disable=host-sync-in-trace
         lt = jnp.where(lt < kth, -jnp.inf, lt)
     probs = jax.nn.softmax(lt, axis=-1)
     if top_p < 1.0:

@@ -17,6 +17,11 @@ import numpy as np
 from .registry import register_op
 from .nn_ops import _conv, _norm_tuple, _conv_padding
 
+# kBBoxClipDefault = log(1000/16) (ref generate_proposals_kernel.cu:41)
+# caps decoded box w/h; hoisted to module scope so the vmapped decode
+# body stays trace-pure (graftlint: host-sync-in-trace)
+_BBOX_CLIP_DEFAULT = float(np.log(1000.0 / 16.0))
+
 
 # ======================= conv variants =======================
 
@@ -677,10 +682,10 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         acy = anc_k[:, 1] + ah * 0.5
         cx = var_k[:, 0] * d_k[:, 0] * aw + acx
         cy = var_k[:, 1] * d_k[:, 1] * ah + acy
-        # kBBoxClipDefault = log(1000/16) (ref :41) caps decoded w/h
-        clip = float(np.log(1000.0 / 16.0))
-        bw = jnp.exp(jnp.minimum(var_k[:, 2] * d_k[:, 2], clip)) * aw
-        bh = jnp.exp(jnp.minimum(var_k[:, 3] * d_k[:, 3], clip)) * ah
+        bw = jnp.exp(jnp.minimum(var_k[:, 2] * d_k[:, 2],
+                                 _BBOX_CLIP_DEFAULT)) * aw
+        bh = jnp.exp(jnp.minimum(var_k[:, 3] * d_k[:, 3],
+                                 _BBOX_CLIP_DEFAULT)) * ah
         boxes = jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
                            cx + bw * 0.5 - off, cy + bh * 0.5 - off],
                           axis=1)

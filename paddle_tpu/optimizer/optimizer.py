@@ -22,6 +22,52 @@ _FUSED_COUNTER = None
 _COMPILE_METRICS = None
 
 
+def _stable_fp(v, _seen=None):
+    """Value-stable, hashable cache-key component for arbitrary hyper
+    values. Primitives and containers pass through structurally;
+    objects reduce to (module, qualname, fingerprinted __dict__) — so
+    two equal-valued instances (two `L2Decay(1e-4)`s) key IDENTICALLY
+    and a mutated one recompiles. Never repr(): the default object
+    repr embeds the memory address, which minted a fresh executable
+    per instance (graftlint: unstable-cache-key).
+
+    Degradation contract: a value this can't fingerprint structurally
+    keys by the VALUE itself when hashable (numpy scalars compare by
+    value, __slots__ objects by identity) and by instance identity as
+    the last resort — either way the failure mode is a spurious
+    recompile, NEVER two distinct-valued hypers silently sharing one
+    compiled executable."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if _seen is None:
+        _seen = set()
+    # the two id() calls below are the recursion CYCLE GUARD, not key
+    # material — no identity ever reaches the returned fingerprint
+    # through them
+    if id(v) in _seen:  # graftlint: disable=unstable-cache-key
+        return ("cycle",)
+    _seen.add(id(v))  # graftlint: disable=unstable-cache-key
+    if isinstance(v, (tuple, list)):
+        return ("seq",) + tuple(_stable_fp(x, _seen) for x in v)
+    if isinstance(v, dict):
+        return ("map",) + tuple(
+            (str(k), _stable_fp(x, _seen))
+            for k, x in sorted(v.items(), key=lambda kv: str(kv[0])))
+    tag = (type(v).__module__, type(v).__qualname__)
+    attrs = getattr(v, "__dict__", None)
+    if isinstance(attrs, dict) and attrs:
+        return tag + tuple((k, _stable_fp(x, _seen))
+                           for k, x in sorted(attrs.items()))
+    try:
+        hash(v)
+        return (tag, v)
+    except TypeError:
+        # unhashable and no inspectable state: per-instance key —
+        # stable for this object's lifetime inside the per-optimizer
+        # cache, and over-keying only costs a recompile
+        return tag + ("instance", id(v))  # graftlint: disable=unstable-cache-key
+
+
 def _fused_counter(outcome: str) -> None:
     """paddle_tpu_optimizer_fused_step_total{outcome=} — hit: cached
     executable reused; compile: traced+compiled fresh (a cache miss;
@@ -150,8 +196,7 @@ class Optimizer:
         ignored on the fused path while the eager path honors it.
         Override alongside `_update_rule`."""
         wd = getattr(self.weight_decay, "_coeff", self.weight_decay)
-        return (wd if isinstance(wd, (int, float, type(None)))
-                else repr(wd),)
+        return (_stable_fp(wd),)
 
     # -- public API --
     @no_grad()
@@ -244,14 +289,12 @@ class Optimizer:
             # group hypers are baked into the executable as constants;
             # fingerprinting them in the key means a mutated
             # weight_decay / per-group lr recompiles instead of being
-            # silently ignored
-            items = sorted((k, v) for k, v in grp.items()
-                           if k != "params")
-            try:
-                hash(tuple(items))
-                return tuple(items)
-            except TypeError:
-                return repr(items)
+            # silently ignored. _stable_fp keeps every component
+            # hashable AND value-stable (a fresh equal-valued decay
+            # object must hit, not recompile)
+            return tuple(sorted((k, _stable_fp(v))
+                                for k, v in grp.items()
+                                if k != "params"))
 
         # instance-level hypers (self.beta1/epsilon/rho/...) are traced
         # into the executable as constants exactly like group hypers —

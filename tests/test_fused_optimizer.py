@@ -157,3 +157,72 @@ def test_lookahead_modelaverage_over_fused_inner_steps():
         assert not np.allclose(lin.weight.numpy(), w0)
     finally:
         os.environ.pop("PADDLE_TPU_FUSED_OPT", None)
+
+
+def test_stable_fingerprint_contract():
+    """_stable_fp (the repr-free cache-key builder, graftlint
+    unstable-cache-key fix): equal-VALUED hyper objects key
+    identically, distinct values NEVER collide — the degradation
+    direction is always a spurious recompile, never silent reuse of
+    an executable compiled with the wrong constants."""
+    from paddle_tpu.optimizer.optimizer import _stable_fp
+
+    class Decay:                       # value object, default __repr__
+        def __init__(self, c):
+            self._coeff = c
+
+    assert _stable_fp(Decay(1e-4)) == _stable_fp(Decay(1e-4))
+    assert _stable_fp(Decay(1e-4)) != _stable_fp(Decay(5e-3))
+    # nested / unhashable state still fingerprints by value
+    assert _stable_fp(Decay([1, 2])) == _stable_fp(Decay([1, 2]))
+    assert _stable_fp(Decay([1, 2])) != _stable_fp(Decay([1, 3]))
+    # numpy scalars (no __dict__) key by VALUE, not type tag
+    assert _stable_fp(np.float32(0.1)) != _stable_fp(np.float32(0.9))
+    assert _stable_fp(np.float32(0.1)) == _stable_fp(np.float32(0.1))
+    # slots objects degrade to identity (recompile, never collide)
+    class S:
+        __slots__ = ("c",)
+        def __init__(self, c):
+            self.c = c
+    assert _stable_fp(S(1.0)) != _stable_fp(S(1.0))
+    # every fingerprint is hashable by construction (cache.get never
+    # raises), including cyclic object graphs
+    cyc = Decay(None)
+    cyc._coeff = cyc
+    for v in (Decay(1e-4), Decay([1, 2]), np.float32(0.1), S(1.0),
+              {"wd": Decay(1e-4)}, cyc):
+        hash(_stable_fp(v))
+
+
+def test_fused_step_hits_across_equal_valued_decay_instances():
+    """A FRESH equal-valued weight-decay object must hit the cached
+    fused executable (pre-fix: repr() fallback keyed per instance —
+    one silent recompile per object)."""
+    os.environ["PADDLE_TPU_FUSED_OPT"] = "1"
+    try:
+        class Decay:
+            def __init__(self, c):
+                self._coeff = c
+
+        pt.seed(0)
+        lin = pt.nn.Linear(8, 8)
+        x = pt.to_tensor(np.ones((4, 8), np.float32))
+        opt = SGD(learning_rate=1e-3, parameters=lin.parameters(),
+                  weight_decay=Decay(1e-4))
+
+        def step():
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        step()
+        assert len(opt._fused_step_cache) == 1
+        opt.weight_decay = Decay(1e-4)      # fresh EQUAL instance
+        step()
+        assert len(opt._fused_step_cache) == 1   # hit, no recompile
+        opt.weight_decay = Decay(5e-3)      # mutated value
+        step()
+        assert len(opt._fused_step_cache) == 2   # recompiled
+    finally:
+        os.environ.pop("PADDLE_TPU_FUSED_OPT", None)

@@ -1,0 +1,3 @@
+# `tools` is a package so `python -m tools.graftlint` resolves from the
+# repo root. The individual scripts here remain directly runnable
+# (`python tools/obs_top.py`); nothing in the library imports them.

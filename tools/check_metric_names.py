@@ -1,100 +1,41 @@
 #!/usr/bin/env python
-"""Static check: every `paddle_tpu_*` observability series registered
-in the codebase follows the naming conventions (README "Observability")
-and is documented in the README series table.
+"""Thin shim over graftlint's `metric-naming` rule (the historical
+entry point, kept so existing tier-1 wiring, docs and muscle memory
+keep working).
 
-Conventions enforced:
-  * every series name starts with the `paddle_tpu_` prefix
-  * monotonic counters end in `_total`
-  * histograms carry a base unit suffix (`_seconds` or `_bytes`)
-  * gauges do NOT end in `_total` (that suffix promises monotonicity)
-  * every registration carries a NON-EMPTY help string literal (the
-    exposition's # HELP line is an operator's first documentation)
-  * every registered name appears VERBATIM in README.md (the
-    observability table lists full names, so operators can grep)
+The audit itself — naming conventions + README-table completeness for
+every `paddle_tpu_*` series — now lives in
+`tools/graftlint/rules/observability.py` alongside the span-name,
+fault-point and engine.stats audits it grew into. This module
+re-exports the legacy API unchanged:
+
+  * ``collect_series(root) -> [(kind, name, help_frag, relpath)]``
+  * ``check(series, readme_text) -> [violation, ...]``
+  * ``main(root) -> exit code`` (prints one violation per line)
 
 Run from the repo root (or pass it):  python tools/check_metric_names.py
-Exit code 0 = clean; 1 = violations (printed one per line).
-Wired into tier-1 via tests/test_prefix_cache.py so a new series can't
-land undocumented or misnamed.
+Exit code 0 = clean; 1 = violations. Prefer
+``python -m tools.graftlint`` for the full rule suite.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict
 
-# a registration is `<registry>.counter("name", "help...", ...)` etc.
-# — the name/help literals may sit on following lines (the codebase
-# wraps at 72; help strings use implicit concatenation, so capturing
-# the FIRST fragment is enough to prove the help is non-empty)
-_REG_RE = re.compile(
-    r'\.(counter|gauge|histogram)\(\s*"([A-Za-z0-9_]+)"'
-    r'(?:\s*,\s*"((?:[^"\\]|\\.)*)")?')
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    # the historical import path is `sys.path += ["tools"]; import
+    # check_metric_names` — make the graftlint package reachable from
+    # there too
+    sys.path.insert(0, _ROOT)
 
-_UNIT_SUFFIXES = ("_seconds", "_bytes")
-
-
-def collect_series(root: str) -> List[Tuple[str, str, str, str]]:
-    """[(kind, name, help_fragment_or_None, relpath)] for every metric
-    registration under `root`/paddle_tpu (tests excluded — they
-    register fixtures)."""
-    found = {}
-    pkg = os.path.join(root, "paddle_tpu")
-    for dirpath, _, files in os.walk(pkg):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            for kind, name, help_frag in _REG_RE.findall(text):
-                key = (kind, name, os.path.relpath(path, root))
-                # re.findall yields "" for a missing optional group;
-                # keep the best (non-empty) help seen for the site
-                found[key] = max(found.get(key, ""), help_frag,
-                                 key=len)
-    return sorted((k, n, h, p) for (k, n, p), h in found.items())
-
-
-def check(series: List[Tuple[str, str, str, str]],
-          readme_text: str) -> List[str]:
-    """Returns the list of violations (empty = clean)."""
-    problems = []
-    for kind, name, help_frag, path in series:
-        where = f"{name} ({kind}, {path})"
-        if not name.startswith("paddle_tpu_"):
-            problems.append(
-                f"{where}: series must carry the paddle_tpu_ prefix")
-            continue
-        if kind == "counter" and not name.endswith("_total"):
-            problems.append(
-                f"{where}: counters are monotonic and must end _total")
-        if kind == "gauge" and name.endswith("_total"):
-            problems.append(
-                f"{where}: gauges must NOT end _total (reserved for "
-                "monotonic counters)")
-        if kind == "histogram" and not name.endswith(_UNIT_SUFFIXES):
-            problems.append(
-                f"{where}: histograms must carry a base-unit suffix "
-                f"({' or '.join(_UNIT_SUFFIXES)})")
-        if not help_frag.strip():
-            problems.append(
-                f"{where}: empty or missing help string (the # HELP "
-                "line is required documentation)")
-        if name not in readme_text:
-            problems.append(
-                f"{where}: not documented in the README observability "
-                "table (add the FULL series name)")
-    return problems
+from tools.graftlint.rules.observability import (  # noqa: E402,F401
+    collect_series, check)
 
 
 def main(root: str = None) -> int:
-    root = root or os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = root or _ROOT
     series = collect_series(root)
     if not series:
         print("check_metric_names: found no registrations — wrong root?")

@@ -1,0 +1,105 @@
+"""CLI: ``python -m tools.graftlint [paths...]``.
+
+Exit code 0 = zero NON-BASELINED findings (baselined ones are printed
+as a count, not failures); 1 = new findings (or parse errors)."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST-based static analysis of the repo's TPU "
+                    "invariants (donation safety, trace purity, "
+                    "recompile hazards, observability discipline).")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze (default: the "
+                         "repo's paddle_tpu/ and tools/, resolved "
+                         "against the repo root — not the cwd)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=core.default_baseline_path(),
+                    help="baseline file (default: tools/graftlint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding fails")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to absorb every current "
+                         "finding (carries per-entry notes forward)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule registry and exit")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: derived from this file)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(core.rules().items()):
+            print(f"{rid}  [{rule.family}/{rule.severity}]")
+            print(f"    invariant: {rule.invariant}")
+            print(f"    history:   {rule.history}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rule_ids - set(core.rules())
+        if unknown:
+            print(f"graftlint: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    root = args.root or core.repo_root()
+    paths = args.paths or [os.path.join(root, "paddle_tpu"),
+                           os.path.join(root, "tools")]
+    baseline = core.Baseline([]) if args.no_baseline else \
+        core.Baseline.load(args.baseline)
+    report = core.run_paths(paths, root=root,
+                            rule_ids=rule_ids, baseline=baseline)
+    if report.files == 0:
+        # a typo'd path or wrong cwd must never read as a green gate
+        print(f"graftlint: no .py files under {paths} — wrong path "
+              "or cwd?", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        notes = {rid: rule.baseline_note
+                 for rid, rule in core.rules().items()
+                 if getattr(rule, "baseline_note", "")}
+        entries = core.build_baseline(report.findings, previous=baseline,
+                                      default_notes=notes)
+        core.write_baseline(args.baseline, entries)
+        print(f"graftlint: baseline updated — {len(entries)} entries "
+              f"covering {len(report.findings)} findings "
+              f"-> {args.baseline}")
+        return 0
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        for f in report.new:
+            print(f"{f.path}:{f.line}: [{f.severity}] {f.rule}: "
+                  f"{f.message}")
+        for path, err in report.parse_errors:
+            print(f"{path}: [error] parse-error: {err}")
+        pr = report.per_rule()
+        detail = ", ".join(
+            f"{rid}={c['new']}+{c['baselined']}b"
+            for rid, c in sorted(pr.items()) if c["new"] or c["baselined"])
+        print(f"graftlint: {report.files} files, "
+              f"{len(report.new)} new finding(s), "
+              f"{len(report.baselined)} baselined"
+              + (f" ({detail})" if detail else ""))
+    return 1 if (report.new or report.parse_errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,380 @@
+"""graftlint core: the rule registry, per-file analysis context,
+inline-suppression handling, the checked-in-baseline mechanism, and the
+path runner the CLI / tests / bench drive.
+
+Design:
+
+* A **rule** is a class with an ``id``, a ``severity``, a one-line
+  ``invariant`` (what must hold) and a ``history`` line (the shipped
+  regression the invariant encodes). Rules are registered into a flat
+  registry; the CLI can select subsets by id.
+* Analysis is **AST-based and per-file** (``Module`` wraps one parsed
+  source file); rules that need repo-wide context (the README tables)
+  read it off the shared ``Project``. graftlint imports NOTHING from
+  paddle_tpu and never imports jax — it must stay runnable in any
+  environment, instantly, with ``JAX_PLATFORMS`` irrelevant.
+* **Suppression** is per-line: a trailing ``# graftlint:
+  disable=<rule>[,<rule>...]`` (or ``disable=all``) silences findings
+  REPORTED ON exactly that physical line — one line, not a region, so
+  a suppression can never silently swallow a new neighbour violation.
+  Multi-line statements report on their first line; put the comment
+  there.
+* The **baseline** grandfathers pre-existing findings: entries are
+  keyed ``(rule, path, normalized snippet)`` with an occurrence
+  ``count``, so they survive unrelated line shifts but a NEW violation
+  — different line content, or one more copy of the same content —
+  still fails. ``--update-baseline`` regenerates the file, carrying
+  forward the per-entry ``note`` justification lines.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning")
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str           # repo-root-relative, forward slashes
+    line: int           # 1-based
+    message: str
+    snippet: str        # whitespace-normalized source line
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        """Line-number-free identity: survives shifts, pins content."""
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _normalize(line: str) -> str:
+    return " ".join(line.split())
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+class Rule:
+    """Base rule. Subclasses set the metadata and implement check()."""
+
+    id: str = ""
+    family: str = ""
+    severity: str = "error"
+    invariant: str = ""
+    history: str = ""
+    # default justification stamped on --update-baseline entries that
+    # don't carry a hand-written note yet
+    baseline_note: str = ""
+
+    def check(self, mod: "Module") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # helper so rules emit uniformly
+    def finding(self, mod: "Module", line: int, message: str) -> Finding:
+        return Finding(self.id, self.severity, mod.path, line, message,
+                       mod.snippet(line))
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding one rule instance to the registry."""
+    if not cls.id:
+        raise ValueError("rule class without id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _REGISTRY[cls.id] = cls()
+    return cls
+
+
+def rules() -> Dict[str, Rule]:
+    """id -> rule instance, all registered rules (loads rule modules)."""
+    import importlib
+    # NB: must be an explicit module import — the package __init__
+    # re-exports this `rules` FUNCTION, so `from . import rules` would
+    # bind that attribute and never load the subpackage
+    importlib.import_module(__package__ + ".rules")
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# analysis context
+# ---------------------------------------------------------------------------
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+def _parse_suppressions(lines: List[str]) -> Dict[int, set]:
+    out = {}
+    for i, ln in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(ln)
+        if m:
+            out[i] = {t.strip() for t in m.group(1).split(",") if t.strip()}
+    return out
+
+
+class Project:
+    """Repo-wide context shared by every Module of one run."""
+
+    def __init__(self, root: str, readme_text: Optional[str] = None):
+        self.root = root
+        self._readme = readme_text
+
+    @property
+    def readme(self) -> str:
+        if self._readme is None:
+            p = os.path.join(self.root, "README.md")
+            if os.path.exists(p):
+                with open(p, encoding="utf-8") as f:
+                    self._readme = f.read()
+            else:
+                self._readme = ""
+        return self._readme
+
+
+class Module:
+    """One parsed source file plus per-file caches rules share."""
+
+    def __init__(self, path: str, src: str, project: Project):
+        self.path = path.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)          # SyntaxError -> caller
+        self.project = project
+        self.suppressed = _parse_suppressions(self.lines)
+        self._parents = None
+        # scratch space for cross-rule caches (scope lists, traced-
+        # function sets) — see rules/_util.py mod_* helpers
+        self.cache: dict = {}
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return _normalize(self.lines[line - 1])
+        return ""
+
+    @property
+    def parents(self) -> dict:
+        """child AST node -> parent node (built lazily, shared)."""
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def is_suppressed(self, f: Finding) -> bool:
+        tags = self.suppressed.get(f.line)
+        return bool(tags) and (f.rule in tags or "all" in tags)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+class Baseline:
+    """Grandfathered findings: at most `count` occurrences of each
+    (rule, path, snippet) key are absorbed; everything beyond is new."""
+
+    def __init__(self, entries: List[dict]):
+        self.entries = entries
+        self._allow: Dict[tuple, int] = {}
+        for e in entries:
+            k = (e["rule"], e["path"], e["snippet"])
+            self._allow[k] = self._allow.get(k, 0) + int(e.get("count", 1))
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls([])
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("entries", []))
+
+    def split(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """findings -> (new, baselined), order preserved."""
+        used: Dict[tuple, int] = {}
+        new, old = [], []
+        for f in findings:
+            k = f.baseline_key()
+            if used.get(k, 0) < self._allow.get(k, 0):
+                used[k] = used.get(k, 0) + 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old
+
+
+def build_baseline(findings: List[Finding],
+                   previous: Optional[Baseline] = None,
+                   default_notes: Optional[Dict[str, str]] = None
+                   ) -> List[dict]:
+    """Entry list for the current findings. Notes survive from the
+    previous baseline when the key survives; otherwise the rule's
+    default justification is stamped so every entry carries a
+    rule-tagged reason line."""
+    prev_notes = {}
+    if previous is not None:
+        for e in previous.entries:
+            if e.get("note"):
+                prev_notes[(e["rule"], e["path"], e["snippet"])] = e["note"]
+    counts: Dict[tuple, int] = {}
+    order: List[tuple] = []
+    for f in findings:
+        k = f.baseline_key()
+        if k not in counts:
+            order.append(k)
+        counts[k] = counts.get(k, 0) + 1
+    entries = []
+    for k in sorted(order):
+        rule, path, snippet = k
+        note = prev_notes.get(k) or (default_notes or {}).get(rule, "")
+        e = {"rule": rule, "path": path, "snippet": snippet,
+             "count": counts[k]}
+        if note:
+            e["note"] = note
+        entries.append(e)
+    return entries
+
+
+def write_baseline(path: str, entries: List[dict]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "comment": "graftlint grandfathered findings — burn "
+                              "down by fixing a site and re-running "
+                              "`python -m tools.graftlint --update-"
+                              "baseline`; new findings always fail.",
+                   "entries": entries}, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# running
+# ---------------------------------------------------------------------------
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), "tools", "graftlint",
+                        "baseline.json")
+
+
+def iter_py_files(paths: List[str], root: str) -> List[str]:
+    """Root-relative .py paths under `paths` (files or directories)."""
+    out = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap):
+            if ap.endswith(".py"):
+                out.append(os.path.relpath(ap, root))
+        else:
+            for dirpath, dirnames, files in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        out.append(os.path.relpath(
+                            os.path.join(dirpath, fn), root))
+    return sorted(set(p.replace(os.sep, "/") for p in out))
+
+
+def analyze_module(mod: Module, rule_ids: Optional[Iterable[str]] = None
+                   ) -> List[Finding]:
+    """All non-suppressed findings for one Module."""
+    from . import config as _config
+    disabled = _config.disabled_for(mod.path)
+    out = []
+    for rid, rule in sorted(rules().items()):
+        if rule_ids is not None and rid not in rule_ids:
+            continue
+        if rid in disabled:
+            continue
+        for f in rule.check(mod):
+            if not mod.is_suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def analyze_source(src: str, path: str = "fixture.py",
+                   rule_ids: Optional[Iterable[str]] = None,
+                   readme_text: str = "",
+                   root: Optional[str] = None) -> List[Finding]:
+    """Analyze one in-memory source blob (the fixture/test entry)."""
+    project = Project(root or repo_root(), readme_text=readme_text)
+    mod = Module(path, src, project)
+    return analyze_module(mod, rule_ids)
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]          # every finding, sorted
+    new: List[Finding]               # not covered by the baseline
+    baselined: List[Finding]
+    files: int
+    parse_errors: List[Tuple[str, str]]
+
+    def per_rule(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for bucket, fs in (("new", self.new), ("baselined", self.baselined)):
+            for f in fs:
+                r = out.setdefault(f.rule, {"new": 0, "baselined": 0})
+                r[bucket] += 1
+        return out
+
+    def to_dict(self) -> dict:
+        base_keys = {id(f) for f in self.baselined}
+        findings = []
+        for f in self.findings:
+            d = f.to_dict()
+            d["baselined"] = id(f) in base_keys
+            findings.append(d)
+        return {
+            "findings": findings,
+            "counts": {"total": len(self.findings),
+                       "new": len(self.new),
+                       "baselined": len(self.baselined),
+                       "per_rule": self.per_rule()},
+            "files": self.files,
+            "parse_errors": [{"path": p, "error": e}
+                             for p, e in self.parse_errors],
+        }
+
+
+def run_paths(paths: List[str], root: Optional[str] = None,
+              rule_ids: Optional[Iterable[str]] = None,
+              baseline: Optional[Baseline] = None,
+              readme_text: Optional[str] = None) -> Report:
+    """Analyze every .py file under `paths`; split against `baseline`."""
+    root = root or repo_root()
+    project = Project(root, readme_text=readme_text)
+    findings: List[Finding] = []
+    parse_errors: List[Tuple[str, str]] = []
+    files = iter_py_files(paths, root)
+    for rel in files:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            src = f.read()
+        try:
+            mod = Module(rel, src, project)
+        except SyntaxError as e:
+            parse_errors.append((rel, str(e)))
+            continue
+        findings.extend(analyze_module(mod, rule_ids))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    baseline = baseline or Baseline([])
+    new, old = baseline.split(findings)
+    return Report(findings=findings, new=new, baselined=old,
+                  files=len(files), parse_errors=parse_errors)

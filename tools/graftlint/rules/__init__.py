@@ -1,0 +1,5 @@
+"""Rule modules — importing this package registers every rule."""
+from . import donation       # noqa: F401
+from . import purity         # noqa: F401
+from . import recompile      # noqa: F401
+from . import observability  # noqa: F401

@@ -1,0 +1,347 @@
+"""Shared AST machinery for graftlint rules: dotted-name resolution,
+per-scope function indexing, donated/jitted call-site discovery, and a
+small flow-sensitive may-alias ("taint") evaluator.
+
+The taint model (deliberately simple, tuned for jax framework code):
+
+* values flow through names, tuple/list packing, ternaries, subscripts,
+  attribute access and ``list.append``/``extend``;
+* **calls and operators produce fresh values** — in jax, every op
+  returns a new buffer (``x.at[i].set(v)``, ``lax.scan`` carries), and
+  accessor calls are presumed to copy or own what they return (the
+  ``state_dict()``-copies contract). Rebinding a name to a call result
+  therefore CLEARS its taint — this is what makes the canonical
+  "donate the input, return the successor" pattern analyze clean;
+* ``if``/``else`` branches analyze on forked environments merged with
+  may-alias OR; loop bodies run twice to catch loop-carried aliases.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+
+def dotted(node) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:               # pragma: no cover - defensive
+        return "<expr>"
+
+
+def const_int_seq(node) -> Optional[List[int]]:
+    """Literal int / tuple-or-list of ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def keyword(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# scopes
+# ---------------------------------------------------------------------------
+def own_body_nodes(fn) -> List[ast.AST]:
+    """Every node in `fn`'s body EXCLUDING nested function/class
+    bodies (those are separate scopes; the def node itself is
+    included). The skip check runs at POP time so a def reached any
+    way — initial body statement or nested child — never expands."""
+    out = []
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def scopes(tree) -> List[ast.AST]:
+    """The module plus every (nested) function definition."""
+    out = [tree]
+    out.extend(n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    return out
+
+
+def local_defs(scope) -> Dict[str, ast.AST]:
+    """name -> FunctionDef for defs appearing directly in `scope`'s
+    body blocks (one level: module-level defs, or a function's own
+    nested defs)."""
+    out = {}
+    body = scope.body if hasattr(scope, "body") else []
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+            continue                      # don't descend into it
+        if isinstance(node, ast.ClassDef):
+            continue
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                stack.append(child)
+    return out
+
+
+def resolve_function(name: str, scope, mod_tree) -> Optional[ast.AST]:
+    """Nearest def named `name`: the current scope's nested defs first,
+    then module level."""
+    hit = local_defs(scope).get(name)
+    if hit is not None:
+        return hit
+    return local_defs(mod_tree).get(name)
+
+
+# -- per-Module caches (rules share one Module instance per file; the
+#    raw helpers above recompute per call, which is quadratic across
+#    rules x scopes on big modules) -------------------------------------
+def mod_scopes(mod) -> List[ast.AST]:
+    hit = mod.cache.get("scopes")
+    if hit is None:
+        hit = mod.cache["scopes"] = scopes(mod.tree)
+    return hit
+
+
+def mod_own_body(mod, scope) -> List[ast.AST]:
+    # id() keying is sound here: scope nodes live exactly as long as
+    # mod.tree pins them, and the cache dies with the Module
+    cache = mod.cache.setdefault("own_body", {})
+    hit = cache.get(id(scope))  # graftlint: disable=unstable-cache-key
+    if hit is None:
+        hit = cache[id(scope)] = own_body_nodes(scope)  # graftlint: disable=unstable-cache-key
+    return hit
+
+
+def mod_local_defs(mod, scope) -> Dict[str, ast.AST]:
+    cache = mod.cache.setdefault("local_defs", {})
+    hit = cache.get(id(scope))  # graftlint: disable=unstable-cache-key
+    if hit is None:
+        hit = cache[id(scope)] = local_defs(scope)  # graftlint: disable=unstable-cache-key
+    return hit
+
+
+def mod_resolve_function(mod, name, scope) -> Optional[ast.AST]:
+    hit = mod_local_defs(mod, scope).get(name)
+    if hit is not None:
+        return hit
+    return mod_local_defs(mod, mod.tree).get(name)
+
+
+def param_names(fn) -> List[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return []
+    return [a.arg for a in list(args.posonlyargs) + list(args.args)]
+
+
+# ---------------------------------------------------------------------------
+# taint evaluation
+# ---------------------------------------------------------------------------
+class Taint:
+    """Environment: name -> reason-string (tainted) or absent (clean)."""
+
+    def __init__(self, sources=None):
+        self.env: Dict[str, str] = {}
+        # sources: callable(node) -> Optional[str] marking extra taint
+        # origins (e.g. `x._data` attribute reads)
+        self.sources = sources or (lambda node: None)
+
+    def why(self, node) -> Optional[str]:
+        """Reason `node` may alias a tainted value, else None."""
+        src = self.sources(node)
+        if src is not None:
+            return src
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                w = self.why(e)
+                if w:
+                    return w
+            return None
+        if isinstance(node, ast.Starred):
+            return self.why(node.value)
+        if isinstance(node, ast.IfExp):
+            return self.why(node.body) or self.why(node.orelse)
+        if isinstance(node, ast.Subscript):
+            return self.why(node.value)
+        if isinstance(node, ast.Attribute):
+            return self.why(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self.why(node.value)
+        # Call / BinOp / comprehension / literal: a fresh value
+        return None
+
+    # -- statement walking ------------------------------------------------
+    def _assign(self, target, value_node, why: Optional[str]):
+        if isinstance(target, ast.Name):
+            if why:
+                self.env[target.id] = why
+            else:
+                self.env.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value_node, (ast.Tuple, ast.List)) and \
+                    len(value_node.elts) == len(target.elts):
+                for t, v in zip(target.elts, value_node.elts):
+                    self._assign(t, v, self.why(v))
+            else:
+                for t in target.elts:
+                    self._assign(t, None, why)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, None, why)
+        elif isinstance(target, ast.Subscript) and why:
+            # storing a tainted value INTO a container taints it
+            base = target.value
+            if isinstance(base, ast.Name):
+                self.env[base.id] = why
+
+    def walk(self, stmts, on_stmt=None):
+        """Linear flow-sensitive walk. `on_stmt(stmt, taint)` fires for
+        every statement BEFORE its env effects apply (so a call site
+        inside it sees the env state on entry to the statement)."""
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue                  # separate scope
+            if on_stmt is not None:
+                on_stmt(st, self)
+            if isinstance(st, ast.Assign):
+                w = self.why(st.value)
+                for tgt in st.targets:
+                    self._assign(tgt, st.value, w)
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                self._assign(st.target, st.value, self.why(st.value))
+            elif isinstance(st, ast.AugAssign):
+                if isinstance(st.target, ast.Name):
+                    w = self.env.get(st.target.id) or self.why(st.value)
+                    if w:
+                        self.env[st.target.id] = w
+            elif isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+                c = st.value
+                if isinstance(c.func, ast.Attribute) and \
+                        c.func.attr in ("append", "extend", "insert",
+                                        "add") and \
+                        isinstance(c.func.value, ast.Name):
+                    for a in c.args:
+                        w = self.why(a)
+                        if w:
+                            self.env[c.func.value.id] = w
+                            break
+            elif isinstance(st, ast.If):
+                a = self._fork()
+                a.walk(st.body, on_stmt)
+                b = self._fork()
+                b.walk(st.orelse, on_stmt)
+                self._merge(a, b)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._assign(st.target, None, self.why(st.iter))
+                for _ in range(2):        # catch loop-carried aliases
+                    self.walk(st.body, on_stmt)
+                self.walk(st.orelse, on_stmt)
+            elif isinstance(st, ast.While):
+                for _ in range(2):
+                    self.walk(st.body, on_stmt)
+                self.walk(st.orelse, on_stmt)
+            elif isinstance(st, ast.With):
+                for item in st.items:
+                    if item.optional_vars is not None:
+                        self._assign(item.optional_vars, None,
+                                     self.why(item.context_expr))
+                self.walk(st.body, on_stmt)
+            elif isinstance(st, ast.Try):
+                self.walk(st.body, on_stmt)
+                for h in st.handlers:
+                    self.walk(h.body, on_stmt)
+                self.walk(st.orelse, on_stmt)
+                self.walk(st.finalbody, on_stmt)
+
+    def _fork(self) -> "Taint":
+        t = Taint(self.sources)
+        t.env = dict(self.env)
+        return t
+
+    def _merge(self, a: "Taint", b: "Taint"):
+        merged = {}
+        for env in (a.env, b.env):
+            merged.update(env)
+        self.env = merged
+
+
+# ---------------------------------------------------------------------------
+# jit-with-donation site discovery
+# ---------------------------------------------------------------------------
+def is_jit_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    return d in ("jax.jit", "jit") or (d or "").endswith(".jit")
+
+
+def donated_argnums(node) -> Optional[List[int]]:
+    """Literal donate_argnums of a jit call; None when absent or not
+    statically resolvable."""
+    kw = keyword(node, "donate_argnums")
+    if kw is None:
+        return None
+    return const_int_seq(kw)
+
+
+def call_arg_vector(mod, jit_call, scope):
+    """The positional-argument vector the donated executable is invoked
+    with, resolved within `scope`:
+
+    1. AOT:    jax.jit(f, ...).lower(a, b, ...)      -> lower's args
+    2. inline: jax.jit(f, ...)(a, b, ...)            -> that call's args
+    3. named:  g = jax.jit(f, ...)   ...   g(a, b)   -> first g(...) call
+
+    Returns (args, call_node) or (None, None)."""
+    parents = mod.parents
+    p = parents.get(jit_call)
+    if isinstance(p, ast.Attribute) and p.attr == "lower":
+        pp = parents.get(p)
+        if isinstance(pp, ast.Call) and pp.func is p:
+            return list(pp.args), pp
+    if isinstance(p, ast.Call) and p.func is jit_call:
+        return list(p.args), p
+    # named: jit call assigned (possibly through .lower(...).compile())
+    # to a simple name, then invoked in the same scope
+    node, par = jit_call, p
+    while isinstance(par, (ast.Attribute, ast.Call)):
+        node, par = par, parents.get(par)
+    if isinstance(par, ast.Assign) and len(par.targets) == 1 and \
+            isinstance(par.targets[0], ast.Name):
+        gname = par.targets[0].id
+        for n in own_body_nodes(scope):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == gname:
+                return list(n.args), n
+    return None, None

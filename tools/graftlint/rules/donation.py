@@ -1,0 +1,191 @@
+"""Donation-safety rules (family: donation).
+
+The invariant this family encodes: ``jax.jit(...,
+donate_argnums=...)`` tells XLA it may destroy the donated input
+buffers at call time. That is only sound when the caller's side of the
+contract holds — nothing outside the call may still reach the donated
+buffer. The repo shipped exactly this bug once (VERDICT r5 Weak #1):
+the fused optimizer donated parameter/gradient buffers that wrapper
+optimizers (LookAhead slow weights, ModelAverage sums) legitimately
+held across steps, and the failure surfaced much later as an unrelated
+"Array has been deleted". The fix (donate ONLY optimizer-owned
+accumulators, ``donate_argnums=(3,)``) is this family's negative test.
+
+Two statically checkable sides of the contract:
+
+* ``donate-return-alias`` — inside the jitted function, a donated
+  parameter must not escape through ``return`` or onto an object
+  attribute. Rebinding through a call (``caches = f(...)``,
+  ``x.at[i].set(v)``) is the sanctioned pattern and analyzes clean.
+* ``donate-external-buffer`` — at the call site, the value bound to a
+  donated position must not alias an externally visible buffer: a
+  framework ``Tensor``'s ``._data`` or a bare ``self.<attr>`` read.
+  Values produced by CALLS are presumed owned/copied (accessors follow
+  the ``state_dict()``-copies contract), which is precisely why
+  ``states.append(self._get_state(p))`` is clean and
+  ``work.append(p._data)`` is not.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register
+from . import _util as U
+
+
+def _donation_sites(mod, scope):
+    """(jit_call, fn_node, donated_positions) for every jit call with a
+    literal donate_argnums directly in `scope`."""
+    out = []
+    for node in U.mod_own_body(mod, scope):
+        if not U.is_jit_call(node):
+            continue
+        nums = U.donated_argnums(node)
+        if not nums or not node.args:
+            continue
+        fn_arg = node.args[0]
+        fn = None
+        if isinstance(fn_arg, ast.Name):
+            fn = U.resolve_function(fn_arg.id, scope, mod.tree)
+        elif isinstance(fn_arg, ast.Lambda):
+            fn = fn_arg
+        out.append((node, fn, sorted(set(nums))))
+    return out
+
+
+@register
+class DonateReturnAlias(Rule):
+    id = "donate-return-alias"
+    family = "donation"
+    severity = "error"
+    invariant = ("a jitted function must not return (or store on an "
+                 "object) a value aliasing a donated parameter — the "
+                 "donated buffer is deleted at call time")
+    history = ("fused-optimizer donation bug: donated buffers outliving "
+               "the call died later as 'Array has been deleted' "
+               "(VERDICT r5 Weak #1)")
+
+    def check(self, mod):
+        for scope in U.mod_scopes(mod):
+            for jit_call, fn, nums in _donation_sites(mod, scope):
+                if fn is None:
+                    continue
+                names = U.param_names(fn)
+                donated = {names[i]: i for i in nums if i < len(names)}
+                if not donated:
+                    continue
+                if isinstance(fn, ast.Lambda):
+                    t = U.Taint()
+                    for n in donated:
+                        t.env[n] = f"donated parameter '{n}'"
+                    why = t.why(fn.body)
+                    if why:
+                        yield self.finding(
+                            mod, fn.lineno,
+                            f"jitted lambda returns {why} "
+                            f"(donate_argnums={sorted(donated.values())})"
+                            " — the donated buffer is deleted by XLA at"
+                            " call time, so the returned alias dies "
+                            "with it")
+                    continue
+                yield from self._check_def(mod, fn, donated)
+
+    def _check_def(self, mod, fn, donated):
+        t = U.Taint()
+        for n in donated:
+            t.env[n] = f"donated parameter '{n}'"
+        findings = []
+
+        def on_stmt(st, taint):
+            if isinstance(st, ast.Return) and st.value is not None:
+                why = taint.why(st.value)
+                if why:
+                    findings.append(self.finding(
+                        mod, st.lineno,
+                        f"jitted function '{fn.name}' returns a value "
+                        f"that may alias {why} — donated buffers are "
+                        "deleted at call time; return the computed "
+                        "successor (rebinding through an op/call) "
+                        "instead of the donated input"))
+            elif isinstance(st, ast.Assign):
+                for tgt in st.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        why = taint.why(st.value)
+                        if why:
+                            findings.append(self.finding(
+                                mod, st.lineno,
+                                f"jitted function '{fn.name}' stores "
+                                f"{why} onto attribute "
+                                f"'{U.unparse(tgt)}' — the alias "
+                                "outlives the call and dies with the "
+                                "donated buffer"))
+
+        t.walk(fn.body, on_stmt)
+        yield from findings
+
+
+def _external_sources(node):
+    """Taint origin: externally visible buffer reads.
+
+    * ``<x>._data`` — a framework Tensor's public buffer: user code,
+      wrapper optimizers and callbacks legitimately capture it.
+    * bare ``self.<attr>`` reads — object state someone else can read
+      later; pass a copy or an owned value to a donated position.
+    Call results are NOT sources (owned-by-contract)."""
+    if isinstance(node, ast.Attribute):
+        if node.attr == "_data":
+            return f"externally visible buffer '{U.unparse(node)}'"
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            return f"object state '{U.unparse(node)}'"
+    return None
+
+
+@register
+class DonateExternalBuffer(Rule):
+    id = "donate-external-buffer"
+    family = "donation"
+    severity = "error"
+    invariant = ("a donated call-site argument must not alias an "
+                 "externally visible buffer (Tensor._data, bare "
+                 "self.<attr> state) — donate only buffers the callee's"
+                 " owner exclusively holds")
+    history = ("re-adding donate_argnums=(1, 3) to the fused optimizer "
+               "step (donating params/grads built from p._data) "
+               "reintroduces the LookAhead/ModelAverage 'Array has "
+               "been deleted' regression")
+
+    def check(self, mod):
+        for scope in U.mod_scopes(mod):
+            for jit_call, fn, nums in _donation_sites(mod, scope):
+                args, call = U.call_arg_vector(mod, jit_call, scope)
+                if args is None:
+                    continue
+                findings = []
+                target = {}          # arg node -> donated position
+                for i in nums:
+                    if i < len(args):
+                        target[id(args[i])] = (args[i], i)
+                if not target:
+                    continue
+
+                def on_stmt(st, taint, _target=target, _call=call,
+                            _findings=findings):
+                    hit = any(n is _call for n in ast.walk(st))
+                    if not hit:
+                        return
+                    for arg, pos in _target.values():
+                        why = taint.why(arg)
+                        if why:
+                            _findings.append(self.finding(
+                                mod, arg.lineno,
+                                f"donated argument {pos} "
+                                f"('{U.unparse(arg)}') is built from "
+                                f"{why} — XLA deletes it at call time "
+                                "while outside references stay live "
+                                "('Array has been deleted' class); "
+                                "donate only owned buffers, or copy"))
+                    _target.clear()   # report once per site
+
+                t = U.Taint(_external_sources)
+                t.walk(scope.body, on_stmt)
+                yield from findings

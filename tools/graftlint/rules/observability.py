@@ -1,0 +1,259 @@
+"""Observability-discipline rules (family: obs).
+
+The invariant: every observable NAME the runtime emits — metric
+series, trace span names, resilience fault points, ``engine.stats``
+keys — is part of the operator interface and must (a) follow the
+naming conventions and (b) appear VERBATIM in the README tables, so an
+operator can grep any name a dashboard shows straight to its
+documentation. ``tools/check_metric_names.py`` pioneered this for
+metric series (tier-1-wired since PR 3); this family absorbs it into
+the rule registry and extends the same audit to spans, fault points
+and stats keys. The old CLI remains as a thin shim importing the
+legacy ``collect_series``/``check`` API from here.
+
+Conventions enforced for metrics (unchanged from the legacy tool):
+  * every series name starts with the ``paddle_tpu_`` prefix
+  * monotonic counters end in ``_total``
+  * histograms carry a base unit suffix (``_seconds`` or ``_bytes``)
+  * gauges do NOT end in ``_total`` (that suffix promises monotonicity)
+  * every registration carries a NON-EMPTY help string literal
+  * every registered name appears VERBATIM in README.md
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Rule, register
+from . import _util as U
+
+_UNIT_SUFFIXES = ("_seconds", "_bytes")
+
+# ---------------------------------------------------------------------------
+# legacy API (tools/check_metric_names.py shim imports these verbatim)
+# ---------------------------------------------------------------------------
+# a registration is `<registry>.counter("name", "help...", ...)` etc.
+# — the name/help literals may sit on following lines (the codebase
+# wraps at 72; help strings use implicit concatenation, so capturing
+# the FIRST fragment is enough to prove the help is non-empty)
+_REG_RE = re.compile(
+    r'\.(counter|gauge|histogram)\(\s*"([A-Za-z0-9_]+)"'
+    r'(?:\s*,\s*"((?:[^"\\]|\\.)*)")?')
+
+
+def collect_series(root: str) -> List[Tuple[str, str, str, str]]:
+    """[(kind, name, help_fragment, relpath)] for every metric
+    registration under `root`/paddle_tpu (tests excluded — they
+    register fixtures)."""
+    found = {}
+    pkg = os.path.join(root, "paddle_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for kind, name, help_frag in _REG_RE.findall(text):
+                key = (kind, name, os.path.relpath(path, root))
+                # re.findall yields "" for a missing optional group;
+                # keep the best (non-empty) help seen for the site
+                found[key] = max(found.get(key, ""), help_frag,
+                                 key=len)
+    return sorted((k, n, h, p) for (k, n, p), h in found.items())
+
+
+def _series_problems(kind: str, name: str, help_frag: str,
+                     where: str, readme_text: str) -> List[str]:
+    problems = []
+    if not name.startswith("paddle_tpu_"):
+        problems.append(
+            f"{where}: series must carry the paddle_tpu_ prefix")
+        return problems
+    if kind == "counter" and not name.endswith("_total"):
+        problems.append(
+            f"{where}: counters are monotonic and must end _total")
+    if kind == "gauge" and name.endswith("_total"):
+        problems.append(
+            f"{where}: gauges must NOT end _total (reserved for "
+            "monotonic counters)")
+    if kind == "histogram" and not name.endswith(_UNIT_SUFFIXES):
+        problems.append(
+            f"{where}: histograms must carry a base-unit suffix "
+            f"({' or '.join(_UNIT_SUFFIXES)})")
+    if not help_frag.strip():
+        problems.append(
+            f"{where}: empty or missing help string (the # HELP "
+            "line is required documentation)")
+    if name not in readme_text:
+        problems.append(
+            f"{where}: not documented in the README observability "
+            "table (add the FULL series name)")
+    return problems
+
+
+def check(series: List[Tuple[str, str, str, str]],
+          readme_text: str) -> List[str]:
+    """Returns the list of violations (empty = clean)."""
+    problems = []
+    for kind, name, help_frag, path in series:
+        problems.extend(_series_problems(
+            kind, name, help_frag, f"{name} ({kind}, {path})",
+            readme_text))
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+def _literal_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@register
+class MetricNaming(Rule):
+    id = "metric-naming"
+    family = "obs"
+    severity = "error"
+    invariant = ("every registered paddle_tpu_* series follows the "
+                 "naming conventions (prefix, _total counters, unit-"
+                 "suffixed histograms, non-empty help) and appears "
+                 "verbatim in the README observability table")
+    history = ("tier-1-wired since PR 3 as tools/check_metric_names.py "
+               "— a series cannot land undocumented or misnamed; the "
+               "CLI survives as a shim over this rule")
+
+    def check(self, mod):
+        seen: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in ("counter", "gauge", "histogram")):
+                continue
+            name = _literal_str(node.args[0]) if node.args else None
+            if name is None:
+                continue
+            kind = node.func.attr
+            help_frag = ""
+            if len(node.args) > 1:
+                help_frag = _literal_str(node.args[1]) or ""
+            key = (kind, name)
+            line, best = seen.get(key, (node.lineno, ""))
+            # registrations are get-or-create: audit each (kind, name)
+            # once per file, with the best help string seen
+            seen[key] = (min(line, node.lineno),
+                         max(best, help_frag, key=len))
+        for (kind, name), (line, help_frag) in sorted(seen.items()):
+            for p in _series_problems(kind, name, help_frag, name,
+                                      mod.project.readme):
+                yield self.finding(mod, line, p)
+
+
+def _readme_missing(name: str, readme: str) -> bool:
+    return name not in readme
+
+
+@register
+class SpanNaming(Rule):
+    id = "span-naming"
+    family = "obs"
+    severity = "error"
+    invariant = ("every trace span / event name recorded via "
+                 "span(...)/add_event(...) is a registered, README-"
+                 "documented name — operators grep a span name from a "
+                 "trace straight to its documentation")
+    history = ("extends the PR 3 metric-name audit to the span "
+               "namespace: request-tree debugging (PR 4) only works "
+               "when span names are a closed, documented set")
+
+    def check(self, mod):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = U.dotted(node.func) or ""
+            leaf = d.split(".")[-1]
+            if leaf not in ("span", "add_event") or not node.args:
+                continue
+            name = _literal_str(node.args[0])
+            if name is None:
+                continue
+            if _readme_missing(name, mod.project.readme):
+                yield self.finding(
+                    mod, node.lineno,
+                    f"span/event name '{name}' is not documented in "
+                    "the README span-name table (add the FULL name)")
+
+
+@register
+class FaultPointNaming(Rule):
+    id = "fault-point-naming"
+    family = "obs"
+    severity = "error"
+    invariant = ("every resilience fault point compiled into the "
+                 "runtime (fault_point(\"...\") sites) is listed in "
+                 "the README fault-tolerance section")
+    history = ("chaos tests target fault points by name; an "
+               "undocumented point is chaos coverage nobody knows "
+               "exists")
+
+    def check(self, mod):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = U.dotted(node.func) or ""
+            if d.split(".")[-1] != "fault_point" or not node.args:
+                continue
+            name = _literal_str(node.args[0])
+            if name is None:
+                continue
+            if _readme_missing(name, mod.project.readme):
+                yield self.finding(
+                    mod, node.lineno,
+                    f"fault point '{name}' is not documented in the "
+                    "README fault-tolerance section (Registered "
+                    "points list)")
+
+
+@register
+class StatsKeyNaming(Rule):
+    id = "stats-key-naming"
+    family = "obs"
+    severity = "error"
+    invariant = ("every engine.stats key (the _EngineStats dict) is "
+                 "README-documented — bench and tests read these keys "
+                 "as a public contract")
+    history = ("the test_observability key-list contract pins the "
+               "exact stats key set; the README table is the operator-"
+               "facing half of the same contract")
+
+    def check(self, mod):
+        # scoped to modules that define/use _EngineStats so arbitrary
+        # stats dicts elsewhere (e.g. HostEmbedding.stats) keep their
+        # own namespace
+        if "_EngineStats" not in mod.src:
+            return
+        keys: Dict[str, int] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    (U.dotted(node.func) or "").endswith("_EngineStats"):
+                for kw in node.keywords:
+                    if kw.arg and kw.arg not in keys:
+                        keys[kw.arg] = node.lineno
+            if isinstance(node, ast.Subscript):
+                base = U.dotted(node.value) or ""
+                if base.split(".")[-1] == "stats":
+                    key = _literal_str(node.slice)
+                    if key is not None and key not in keys:
+                        keys[key] = node.lineno
+        for key, line in sorted(keys.items(), key=lambda kv: kv[1]):
+            if _readme_missing(key, mod.project.readme):
+                yield self.finding(
+                    mod, line,
+                    f"engine.stats key '{key}' is not documented in "
+                    "the README engine.stats table")

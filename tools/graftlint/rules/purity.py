@@ -1,0 +1,245 @@
+"""Trace-purity / host-sync rules (family: purity).
+
+The invariant: code that runs UNDER A JAX TRACE — jitted functions,
+``lax.scan``/``while_loop``/``cond`` bodies, Pallas kernels — must stay
+device-pure. Host materialization (``.item()``, ``np.asarray``,
+``jax.device_get``, ``block_until_ready``, ``float()``/``int()`` on a
+traced value) either fails at trace time or, worse, silently bakes a
+trace-time constant into the executable; ``time.*`` and ``print``
+execute once at trace time and never again, which is a classic
+recompile-debugging trap.
+
+Outside traces, host materialization is legal but EXPENSIVE: each one
+is a device->host round trip on the dispatch path (ROADMAP item 4:
+``eager_over_trainstep`` 1.74 vs the <=1.5 target is exactly
+accumulated round-trip cost). ``host-sync`` (warning) inventories
+every such site so the count only goes DOWN — existing sites are
+grandfathered in the baseline; a new one must either be justified into
+the baseline or kept off the host.
+
+Reachability is static and deliberately shallow: a function is
+"traced" when it is decorated with / passed to a tracing entry point,
+or when it is called BY a traced function via a bare name defined in
+the same module (one level of call graph — deeper indirection should
+be refactored, not chased)."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from ..core import Rule, register
+from . import _util as U
+
+# tracing entry points: dotted-suffix -> positions of traced callables.
+# Ambiguous bare names (scan, cond, map, grad, checkpoint, remat) must
+# carry a qualifier (jax./lax./pl.) to count.
+_QUALIFIED = {
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "switch": (1,), "associative_scan": (0,),
+    "map": (0,), "grad": (0,), "value_and_grad": (0,),
+    "checkpoint": (0,), "remat": (0,),
+}
+_UNQUALIFIED = {
+    "jit": (0,), "pallas_call": (0,), "while_loop": (0, 1),
+    "fori_loop": (2,), "vmap": (0,), "pmap": (0,),
+    "value_and_grad": (0,), "associative_scan": (0,),
+}
+_QUALIFIERS = ("jax", "lax", "pl", "pallas", "plgpu", "pltpu")
+
+
+def _trace_positions(call: ast.Call):
+    d = U.dotted(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    leaf = parts[-1]
+    if len(parts) > 1 and parts[-2] in _QUALIFIERS or \
+            len(parts) > 2 and parts[0] in _QUALIFIERS:
+        hit = _QUALIFIED.get(leaf) or _UNQUALIFIED.get(leaf)
+        return hit
+    return _UNQUALIFIED.get(leaf)
+
+
+def _jit_decorated(fn) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        d = U.dotted(node) or ""
+        leaf = d.split(".")[-1]
+        if leaf == "jit":
+            return True
+        if leaf == "partial" and isinstance(dec, ast.Call) and dec.args:
+            inner = U.dotted(dec.args[0]) or ""
+            if inner.split(".")[-1] == "jit":
+                return True
+    return False
+
+
+def traced_functions(mod) -> Dict[ast.AST, str]:
+    """FunctionDef/Lambda -> reason string for everything that runs
+    under a trace in this module (incl. the one-level call walk).
+    Cached on the Module (both purity rules consume it)."""
+    hit = mod.cache.get("traced_functions")
+    if hit is not None:
+        return hit
+    out: Dict[ast.AST, str] = {}
+    mod.cache["traced_functions"] = out
+
+    def mark(fn, reason):
+        if fn is not None and fn not in out:
+            out[fn] = reason
+
+    scope_of = {}
+    for scope in U.mod_scopes(mod):
+        for node in U.mod_own_body(mod, scope):
+            scope_of[node] = scope
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decorated(node):
+                mark(node, f"decorated jit function '{node.name}'")
+        if not isinstance(node, ast.Call):
+            continue
+        pos = _trace_positions(node)
+        if pos is None:
+            continue
+        entry = U.dotted(node.func)
+        scope = scope_of.get(node, mod.tree)
+        for i in pos:
+            if i >= len(node.args):
+                continue
+            arg = node.args[i]
+            if isinstance(arg, ast.Lambda):
+                mark(arg, f"lambda passed to {entry}")
+            elif isinstance(arg, ast.Name):
+                fn = U.resolve_function(arg.id, scope, mod.tree)
+                if fn is not None:
+                    mark(fn, f"'{fn.name}' passed to {entry}")
+
+    # one-level call-graph walk: bare-name calls from a traced body
+    for fn, reason in list(out.items()):
+        if isinstance(fn, ast.Lambda):
+            continue
+        scope = scope_of.get(fn, mod.tree)
+        for node in U.own_body_nodes(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name):
+                callee = U.resolve_function(node.func.id, fn, mod.tree) \
+                    or U.resolve_function(node.func.id, scope, mod.tree)
+                if callee is not None and callee not in out:
+                    out[callee] = (f"'{callee.name}' called from traced "
+                                   f"{reason}")
+    return out
+
+
+def _numpy_call(d: str) -> bool:
+    parts = d.split(".")
+    return len(parts) > 1 and parts[0] in ("np", "numpy") and \
+        parts[-1] in ("asarray", "array")
+
+
+def _host_sync_why(node: ast.Call) -> Optional[str]:
+    """Reason `node` is a host materialization, else None. The shared
+    pattern set of both purity rules."""
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr == "item" and not node.args:
+            return ".item() forces a device->host transfer"
+        if node.func.attr == "block_until_ready":
+            return "block_until_ready() synchronizes with the device"
+    d = U.dotted(node.func) or ""
+    if _numpy_call(d):
+        return f"{d}() materializes the value on the host"
+    if d in ("jax.device_get", "device_get"):
+        return "jax.device_get() copies device memory to the host"
+    if d == "jax.block_until_ready":
+        return "jax.block_until_ready() synchronizes with the device"
+    return None
+
+
+def _trace_only_why(node: ast.Call) -> Optional[str]:
+    """Patterns flagged ONLY under a trace (legal, if slow, on the
+    host): float()/int() coercion, wall clocks, print."""
+    d = U.dotted(node.func) or ""
+    if d in ("float", "int") and node.args and \
+            not isinstance(node.args[0], ast.Constant):
+        return (f"{d}() on a traced value forces host materialization "
+                "(or bakes a trace-time constant)")
+    if d.startswith("time.") or d.startswith("_time."):
+        return (f"{d}() reads the host clock — under a trace it runs "
+                "ONCE at trace time and becomes a constant")
+    if d == "print":
+        return ("print() executes at trace time only; use "
+                "jax.debug.print for runtime values")
+    return None
+
+
+@register
+class HostSyncInTrace(Rule):
+    id = "host-sync-in-trace"
+    family = "purity"
+    severity = "error"
+    invariant = ("functions that run under a jax trace (jit, "
+                 "scan/while/cond bodies, Pallas kernels, one bare-name"
+                 " call away) must not touch the host: no .item()/"
+                 "np.asarray/device_get/block_until_ready/float()/"
+                 "int()/time.*/print")
+    history = ("host round-trips inside hot dispatch paths are the "
+               "measured eager_over_trainstep ceiling (ROADMAP item 4:"
+               " 1.74 vs <=1.5); trace-time clocks/prints are classic "
+               "silent-constant bugs")
+
+    def check(self, mod):
+        traced = traced_functions(mod)
+        for fn, reason in traced.items():
+            nodes = []
+            if isinstance(fn, ast.Lambda):
+                nodes = list(ast.walk(fn.body))
+            else:
+                # include nested defs (inner helpers execute under the
+                # same trace) EXCEPT ones independently traced — those
+                # get their own walk, and double-visiting would count
+                # one violation twice in the baseline/bench numbers
+                stack = list(fn.body)
+                while stack:
+                    node = stack.pop()
+                    if node is not fn and node in traced:
+                        continue
+                    nodes.append(node)
+                    stack.extend(ast.iter_child_nodes(node))
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                why = _host_sync_why(node) or _trace_only_why(node)
+                if why:
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"{why} — inside {reason}, which runs under a "
+                        "jax trace")
+
+
+@register
+class HostSync(Rule):
+    id = "host-sync"
+    family = "purity"
+    severity = "warning"
+    invariant = ("host materialization (.item(), np.asarray, "
+                 "jax.device_get, block_until_ready) on library paths "
+                 "is a device->host round trip: every site is "
+                 "inventoried, existing ones are baselined, and the "
+                 "count must only go down")
+    history = ("per-grad-node host round-trips keep "
+               "eager_over_trainstep at 1.74 (target <=1.5, ROADMAP "
+               "item 4) — the burn-down list lives in the baseline")
+    baseline_note = ("host-sync: grandfathered host materialization "
+                     "(pre-graftlint inventory) — burn down by keeping "
+                     "values on device, ROADMAP item 4")
+
+    def check(self, mod):
+        traced = set()
+        for fn in traced_functions(mod):
+            traced.update(id(n) for n in ast.walk(fn))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or id(node) in traced:
+                continue
+            why = _host_sync_why(node)
+            if why:
+                yield self.finding(mod, node.lineno, why)

@@ -1,0 +1,175 @@
+"""Recompile-hazard rules (family: recompile).
+
+The invariant: the identity of an XLA executable is its cache key. A
+key component that is UNSTABLE (default object ``repr`` embeds the
+memory address; ``id()`` is the address; an f-string hides type
+coercion) mints a fresh key per instance/process — each one a silent
+mid-serving recompile, the class of incident the engine's
+``_CompileTimed`` compile telemetry exists to catch. Likewise a
+``static_argnums`` position bound to an unhashable object (list/dict/
+set) fails at dispatch, and one bound to an object without value-based
+``__hash__``/``__eq__`` recompiles per instance.
+
+This PR's motivating sites: the fused optimizer's
+``_hyper_fingerprint`` (``repr(wd)`` of a weight-decay object =
+per-instance key) and its group-hyper fallback ``repr(items)`` — both
+fixed to structural fingerprints in the same change that lands this
+rule. The engine's executable caches (``_prefill_fns``/``_decode_fns``)
+key on shape/dtype tuples and stay clean.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule, register
+from . import _util as U
+
+# function names that build cache keys / fingerprints
+_KEYFN_RE = re.compile(
+    r"fingerprint|cache_key|cachekey|hyper_fp|(^|_)fp$|_key$")
+# container names that are executable/compile caches
+_CACHE_RE = re.compile(r"cache|_fns$|_executables?$", re.IGNORECASE)
+
+
+def _unstable_why(node) -> str:
+    """Reason `node` is an unstable key component, else ''."""
+    if isinstance(node, ast.Call):
+        d = U.dotted(node.func)
+        if d == "repr" and node.args:
+            return ("repr() of an object without a value-based __repr__"
+                    " embeds the memory address — a fresh instance "
+                    "mints a fresh executable-cache key (silent "
+                    "recompile)")
+        if d == "id" and node.args:
+            return ("id() is the memory address — per-instance cache "
+                    "keys recompile on every new object")
+    if isinstance(node, ast.JoinedStr):
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue):
+                return ("f-string-built key components hide type/format"
+                        " coercions (1 vs 1.0 vs True collide or "
+                        "diverge silently) — key on the structured "
+                        "values themselves")
+    return ""
+
+
+def _cache_name(node) -> bool:
+    """`node` names a cache-like container (`cache[...]`,
+    `self._prefill_fns[...]`)."""
+    d = U.dotted(node)
+    if not d:
+        return False
+    leaf = d.split(".")[-1]
+    return bool(_CACHE_RE.search(leaf))
+
+
+@register
+class UnstableCacheKey(Rule):
+    id = "unstable-cache-key"
+    family = "recompile"
+    severity = "error"
+    invariant = ("executable-cache keys and fingerprints must be built "
+                 "from stable, value-comparable components — never "
+                 "repr()/id() of arbitrary objects or f-strings")
+    history = ("the fused-optimizer _hyper_fingerprint repr() fallback "
+               "made two equal-valued decay objects key differently "
+               "(one recompile per instance); pinned verify widths in "
+               "spec-decode exist because signature drift = mid-"
+               "serving XLA compiles")
+
+    def check(self, mod):
+        # 1. inside key-builder functions
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _KEYFN_RE.search(node.name.lower()):
+                for sub in ast.walk(node):
+                    why = _unstable_why(sub)
+                    if why:
+                        yield self.finding(
+                            mod, sub.lineno,
+                            f"in key-builder '{node.name}': {why}")
+        # 2. expressions used directly as cache keys, and the
+        #    one-assignment-back construction of key variables
+        for scope in U.mod_scopes(mod):
+            key_names = set()
+            nodes = U.mod_own_body(mod, scope)
+            for node in nodes:
+                key_exprs = []
+                if isinstance(node, ast.Subscript) and \
+                        _cache_name(node.value):
+                    key_exprs.append(node.slice)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("get", "setdefault", "pop") \
+                        and _cache_name(node.func.value) and node.args:
+                    key_exprs.append(node.args[0])
+                for ke in key_exprs:
+                    for sub in ast.walk(ke):
+                        why = _unstable_why(sub)
+                        if why:
+                            yield self.finding(
+                                mod, sub.lineno,
+                                f"in executable-cache key: {why}")
+                    if isinstance(ke, ast.Name):
+                        key_names.add(ke.id)
+            if not key_names:
+                continue
+            for node in nodes:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id in key_names
+                        for t in node.targets):
+                    for sub in ast.walk(node.value):
+                        why = _unstable_why(sub)
+                        if why:
+                            yield self.finding(
+                                mod, sub.lineno,
+                                "in the construction of cache key "
+                                f"'{[t.id for t in node.targets if isinstance(t, ast.Name)][0]}'"
+                                f": {why}")
+
+
+@register
+class UnhashableStaticArg(Rule):
+    id = "unhashable-static-arg"
+    family = "recompile"
+    severity = "error"
+    invariant = ("static_argnums positions must receive hashable, "
+                 "value-comparable arguments — a list/dict/set fails at"
+                 " dispatch, an identity-hashed object recompiles per "
+                 "instance")
+    history = ("static-arg signature drift is the same incident class "
+               "as the spec-decode verify-width pin: every new "
+               "signature is a mid-serving XLA compile")
+
+    _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                   ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+    def check(self, mod):
+        for scope in U.mod_scopes(mod):
+            for node in U.mod_own_body(mod, scope):
+                if not U.is_jit_call(node):
+                    continue
+                kw = U.keyword(node, "static_argnums")
+                if kw is None:
+                    continue
+                nums = U.const_int_seq(kw)
+                if not nums:
+                    continue
+                args, call = U.call_arg_vector(mod, node, scope)
+                if args is None:
+                    continue
+                for i in nums:
+                    if i >= len(args):
+                        continue
+                    a = args[i]
+                    bad = isinstance(a, self._UNHASHABLE) or (
+                        isinstance(a, ast.Call) and
+                        U.dotted(a.func) in ("list", "dict", "set"))
+                    if bad:
+                        yield self.finding(
+                            mod, a.lineno,
+                            f"static_argnums position {i} receives "
+                            f"'{U.unparse(a)}' — unhashable static "
+                            "arguments fail at dispatch time; pass a "
+                            "tuple / frozen value instead")
