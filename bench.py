@@ -506,14 +506,11 @@ def bench_decode(on_tpu):
     }
 
 
-def bench_decode_paged(on_tpu):
-    """Continuous-batching serving throughput at EQUAL cache HBM
-    (VERDICT r4 next-2): a mixed-length workload through
-    inference.LLMEngine (paged pool + admission/preemption) vs the
-    dense static-batch generate() path given the SAME cache bytes.
-    Dense must pad every sequence to the group max and run each group
-    to its longest request; the paged pool shares pages across lengths,
-    so more sequences decode per weight-stream pass."""
+def _paged_workload(on_tpu):
+    """Shared setup for the decode_paged bench AND the --gate window
+    server: one engine + one dense baseline over the same mixed-length
+    workload at equal cache HBM. Returns closures so callers control
+    warmup/timing (the gate interleaves windows across processes)."""
     import jax
     import paddle_tpu as pt
     from paddle_tpu.inference import LLMEngine
@@ -599,14 +596,47 @@ def bench_decode_paged(on_tpu):
                           decode_tokens=eng.stats["decode_tokens"]
                           - start_tokens)
 
+    return {
+        "run_paged": run_paged, "run_dense": run_dense,
+        "meta": {
+            "requests": n_req, "max_batch": max_batch,
+            "cache_budget_gb": round(dense_bytes / 1e9, 3),
+            "num_blocks": num_blocks, "block_size": block_size,
+            "decode_chunk": chunk,
+        },
+    }
+
+
+def bench_decode_paged(on_tpu, windows=2):
+    """Continuous-batching serving throughput at EQUAL cache HBM
+    (VERDICT r4 next-2): a mixed-length workload through
+    inference.LLMEngine (paged pool + admission/preemption) vs the
+    dense static-batch generate() path given the SAME cache bytes.
+    Dense must pad every sequence to the group max and run each group
+    to its longest request; the paged pool shares pages across lengths,
+    so more sequences decode per weight-stream pass. The two legs run
+    as INTERLEAVED best-of-N windows (paged, dense, paged, dense ...)
+    so a load spike on the shared box lands on both sides instead of
+    corrupting the ratio — the same convention the --gate prev-rev A/B
+    uses."""
+    wl = _paged_workload(on_tpu)
+    run_paged, run_dense = wl["run_paged"], wl["run_dense"]
     run_paged()            # compile prefill/decode executables
     run_dense()            # compile dense prefill + loop executables
-    t0 = time.perf_counter()
-    paged_tokens, stats = run_paged()
-    t_paged = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    dense_tokens = run_dense()
-    t_dense = time.perf_counter() - t0
+    t_paged = t_dense = float("inf")
+    paged_tokens = dense_tokens = 0
+    stats = {}
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        ptoks, pstats = run_paged()
+        dt = time.perf_counter() - t0
+        if dt < t_paged:
+            t_paged, paged_tokens, stats = dt, ptoks, pstats
+        t0 = time.perf_counter()
+        dtoks = run_dense()
+        dt = time.perf_counter() - t0
+        if dt < t_dense:
+            t_dense, dense_tokens = dt, dtoks
     paged_tps = paged_tokens / t_paged
     dense_tps = dense_tokens / t_dense
     return {
@@ -616,10 +646,8 @@ def bench_decode_paged(on_tpu):
         "vs_baseline": round(paged_tps / dense_tps, 4),
         "extra": {
             "dense_tokens_per_sec": round(dense_tps, 1),
-            "requests": n_req, "max_batch": max_batch,
-            "cache_budget_gb": round(dense_bytes / 1e9, 3),
-            "num_blocks": num_blocks, "block_size": block_size,
-            "decode_chunk": chunk,
+            "windows": windows,
+            **wl["meta"],
             "engine_stats": stats,
             "request_latency": _request_latency_percentiles(),
         },
@@ -892,19 +920,241 @@ CONFIGS = {
 }
 
 
-def main():
-    import jax
-    from paddle_tpu import observability as obs
+# ---------------------------------------------------------------------------
+# round-over-round perf gate (VERDICT item 9 / ROADMAP item 4 prereq):
+# prev-rev vs current-rev INTERLEAVED best-of-N windows per decode
+# config, pass/fail JSON on the BENCH line — so every perf claim this
+# round and after is self-verifying instead of compared across
+# sessions with different box load.
+# ---------------------------------------------------------------------------
+def _gate_window_paged(on_tpu):
+    """One gate window = one full serve of the decode_paged workload
+    through the engine (setup+compile happen once, before READY)."""
+    wl = _paged_workload(on_tpu)
+    wl["run_paged"]()          # compile + settle
 
+    def window():
+        t0 = time.perf_counter()
+        tokens, _stats = wl["run_paged"]()
+        return tokens, time.perf_counter() - t0
+
+    return window
+
+
+def _gate_window_dense(on_tpu):
+    """One gate window = one dense fused-loop generate() leg on the
+    decode config's main batch."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if on_tpu:
+        kw = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                  num_heads=16, max_position_embeddings=2048,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        prompt_len, n_new, b = 128, 128, 8
+    else:
+        kw = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                  num_heads=4, max_position_embeddings=256,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        prompt_len, n_new, b = 8, 8, 2
+    cfg = GPTConfig(**kw)
+    model = GPTForCausalLM(cfg).bfloat16()
+    model.eval()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size,
+                       (b, prompt_len)).astype(np.int32)
+    generate(model, pt.to_tensor(ids), max_new_tokens=n_new).numpy()
+    salt = [0]
+
+    def window():
+        # content-varying input: the tunnel runtime dedups identical
+        # executions (see bench_decode)
+        salt[0] += 1
+        ids2 = ids.copy()
+        ids2[:, 0] = (ids2[:, 0] + salt[0]) % cfg.vocab_size
+        t0 = time.perf_counter()
+        generate(model, pt.to_tensor(ids2),
+                 max_new_tokens=n_new).numpy()
+        return b * n_new, time.perf_counter() - t0
+
+    return window
+
+
+GATE_WINDOWS = {
+    "decode_paged": _gate_window_paged,
+    "decode": _gate_window_dense,
+}
+
+
+def _serve_windows(config, on_tpu):
+    """Hidden --window-server mode: set up the config's gate workload
+    once (compiles included), print READY, then run one timed window
+    per 'go' line on stdin. Run with cwd = the revision to measure —
+    the cwd is pushed to sys.path FIRST, so `import paddle_tpu`
+    resolves against that tree even though this bench.py (which both
+    revisions share, so the protocol exists on both sides) lives in
+    the current one."""
+    import sys
+    sys.path.insert(0, os.getcwd())
+    window = GATE_WINDOWS[config](on_tpu)
+    print("READY", flush=True)
+    for line in sys.stdin:
+        cmd = line.strip()
+        if cmd == "go":
+            tokens, dt = window()
+            print(json.dumps({"tokens": tokens, "dt": dt}), flush=True)
+        else:
+            break
+
+
+_GATE_SETUP_TIMEOUT_S = 1800.0   # window-server setup incl. compiles
+_GATE_WINDOW_TIMEOUT_S = 600.0   # one timed window
+
+
+def _run_gate(config, rev, windows, tol):
+    """Interleaved prev-rev vs current-rev A/B: two persistent window
+    servers (one per revision, each with its own compiled state), N
+    'go' commands alternating between them, best-of-N tok/s per side.
+    Returns the pass/fail dict that rides the BENCH line."""
+    import queue
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    root = os.path.dirname(os.path.abspath(__file__))
+
+    def _git(*a):
+        return subprocess.run(
+            ["git", *a], cwd=root, capture_output=True, text=True,
+            check=True).stdout.strip()
+
+    try:
+        if rev is None:
+            dirty = subprocess.run(
+                ["git", "diff", "--quiet", "HEAD"], cwd=root
+            ).returncode != 0
+            # dirty tree: the working tree IS the candidate, HEAD the
+            # baseline; clean tree: this commit vs its parent
+            rev = "HEAD" if dirty else "HEAD^"
+        sha = _git("rev-parse", rev)
+    except Exception as e:
+        return {"config": config, "pass": None,
+                "error": f"cannot resolve prev rev: {e}"}
+    wt = tempfile.mkdtemp(prefix="bench_gate_")
+    os.rmdir(wt)
+    procs = {}
+    outq = {}
+    best = {}
+
+    def _pump(stream, q):
+        # reader thread: readline() on a live-but-wedged child blocks
+        # with no timeout, which would skip the finally (leaked
+        # worktree + orphan servers). Deadline-guarded queue reads
+        # raise instead, and the except/finally path cleans up.
+        for line in stream:
+            q.put(line)
+        q.put("")                            # EOF marker
+
+    def _readline(tag, timeout, what):
+        try:
+            line = outq[tag].get(timeout=timeout)
+        except queue.Empty:
+            raise RuntimeError(
+                f"{tag} window server wedged during {what} "
+                f"(no output in {timeout:.0f}s)")
+        if not line:
+            raise RuntimeError(
+                f"{tag} window server died during {what}")
+        return line
+
+    try:
+        _git("worktree", "add", "--detach", wt, sha)
+        for tag, cwd in (("cur", root), ("prev", wt)):
+            procs[tag] = subprocess.Popen(
+                [sys.executable, os.path.join(root, "bench.py"),
+                 "--window-server", "--config", config],
+                cwd=cwd, stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE, text=True, bufsize=1)
+            outq[tag] = queue.Queue()
+            threading.Thread(target=_pump,
+                             args=(procs[tag].stdout, outq[tag]),
+                             daemon=True).start()
+        for tag in procs:
+            while True:
+                line = _readline(tag, _GATE_SETUP_TIMEOUT_S, "setup")
+                if line.strip() == "READY":
+                    break
+        for _ in range(windows):
+            for tag in ("cur", "prev"):     # interleaved
+                p = procs[tag]
+                p.stdin.write("go\n")
+                p.stdin.flush()
+                r = json.loads(
+                    _readline(tag, _GATE_WINDOW_TIMEOUT_S, "a window"))
+                tps = r["tokens"] / max(r["dt"], 1e-9)
+                best[tag] = max(best.get(tag, 0.0), tps)
+        ratio = best["cur"] / max(best["prev"], 1e-9)
+        return {
+            "config": config, "prev_rev": sha[:12],
+            "windows": windows,
+            "prev_tokens_per_sec": round(best["prev"], 1),
+            "cur_tokens_per_sec": round(best["cur"], 1),
+            "ratio": round(ratio, 4), "tol": tol,
+            "pass": bool(ratio >= 1.0 - tol),
+        }
+    except Exception as e:
+        return {"config": config, "prev_rev": sha[:12], "pass": None,
+                "error": f"{type(e).__name__}: {e}",
+                **({"partial_best": best} if best else {})}
+    finally:
+        for p in procs.values():
+            try:
+                p.stdin.close()
+            except Exception:
+                pass
+            p.kill()
+            p.wait()
+        subprocess.run(["git", "worktree", "remove", "--force", wt],
+                       cwd=root, capture_output=True)
+
+
+def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", choices=sorted(CONFIGS), default="gpt2s")
     ap.add_argument("--all", action="store_true",
                     help="run every config, one JSON line each")
     ap.add_argument("--no-obs", action="store_true",
                     help="skip the observability snapshot in the output")
+    ap.add_argument("--gate", action="store_true",
+                    help="append the round-over-round perf gate to the "
+                         "BENCH line: prev-rev vs current-rev "
+                         "interleaved best-of-N windows (decode "
+                         "configs only)")
+    ap.add_argument("--gate-rev", default=None,
+                    help="baseline revision for --gate (default: HEAD "
+                         "when the tree is dirty, else HEAD^)")
+    ap.add_argument("--gate-windows", type=int, default=3,
+                    help="interleaved windows per side for --gate")
+    ap.add_argument("--gate-tol", type=float, default=0.08,
+                    help="--gate fails when cur/prev < 1 - tol")
+    ap.add_argument("--window-server", action="store_true",
+                    help=argparse.SUPPRESS)   # internal: --gate child
     args = ap.parse_args()
 
+    import jax
     on_tpu = jax.devices()[0].platform != "cpu"
+    if args.window_server:
+        # IMPORTANT: no paddle_tpu import may happen before this call —
+        # it re-points sys.path at the cwd so the serving revision's
+        # tree wins over the one this bench.py file lives in
+        _serve_windows(args.config, on_tpu)
+        return
+
+    from paddle_tpu import observability as obs
     names = list(CONFIGS) if args.all else [args.config]
     for name in names:
         if not args.no_obs:
@@ -914,6 +1164,9 @@ def main():
             obs.enable()
             obs.reset()
         result = CONFIGS[name](on_tpu)
+        if args.gate and name in GATE_WINDOWS:
+            result["gate"] = _run_gate(name, args.gate_rev,
+                                       args.gate_windows, args.gate_tol)
         if not args.no_obs:
             result["obs"] = obs.summary()
             obs.disable()

@@ -20,17 +20,25 @@ allocator; every device step is ONE cached XLA executable:
     preempted (pages freed, request re-queued for re-prefill with its
     generated tokens carried along) — the vLLM-style recompute policy,
     matching the reference scheduler's behavior under cache pressure.
-  * Prefill/decode disaggregation: a prompt is prefilled by a
-    bucketed-length executable (one sequence per call, packed tokens,
-    dead-token writes dropped), decode runs the WHOLE batch one chunk
+  * Ragged packed prefill/verify: every token-computing launch — a
+    fresh prompt's suffix, a prefix-resume tail, a speculative verify
+    window — packs its rows into ONE [total_tokens] stream with
+    per-token (row, position) metadata and runs the
+    `kernels.pallas.ragged_paged_attention` family (`engine_ragged`):
+    mixed rows of arbitrary per-row lengths in one launch, bucketed
+    ONLY on total-token count. Decode runs the WHOLE batch one chunk
     (`decode_chunk` tokens) per executable call as a `lax.scan` with
     every layer's paged attention inside — caches donated, so XLA
-    updates the pool in place. Between chunks the host syncs only
-    [B, chunk] int32 tokens.
-  * Step shapes are bucketed (prompt buckets, power-of-two page-count
-    and chunk buckets) so the number of compiled executables stays
-    O(log) in every dimension while attention reads scale with the
-    CURRENT longest sequence, not the model maximum.
+    updates the pool in place; k/v writes stage in a small
+    [L, B, chunk] side buffer and merge with ONE flat token-major
+    scatter per cache at chunk end, so the pool is never both
+    scattered-into and read in the same scan body (the aliasing
+    hazard that used to cost a full pool copy per step). Between
+    chunks the host syncs only [B, chunk] int32 tokens.
+  * Step shapes are bucketed (ragged total-token buckets, power-of-two
+    chunk buckets) so the number of compiled executables stays O(log +
+    linear/quantum) while attention reads scale with the CURRENT
+    longest sequence, not the model maximum.
   * Automatic prefix caching (enable_prefix_caching, default on): full
     prompt blocks are content-hashed in the PagedKVCache; a request
     sharing a page-aligned prefix with earlier traffic (system prompt,
@@ -46,6 +54,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
+import warnings
 from typing import Dict, List, Optional
 
 import jax
@@ -121,6 +130,11 @@ def _metrics():
                 "paddle_tpu_engine_verify_seconds",
                 "one speculative verify executable call (k+1 "
                 "positions per row) incl. host prep"),
+            "ragged": r.histogram(
+                "paddle_tpu_engine_ragged_seconds",
+                "one ragged packed-batch executable call (mixed "
+                "prefill/prefix-resume/verify rows in a single "
+                "launch) incl. host prep"),
             "prefix_pages": r.gauge(
                 "paddle_tpu_engine_prefix_cache_pages",
                 "prefix-cache page index occupancy after a step: "
@@ -604,8 +618,19 @@ class LLMEngine:
 
         self.waiting: collections.deque = collections.deque()
         self.slots: List[Optional[_Seq]] = [None] * self.max_batch
-        self._prefill_fns: Dict = {}
-        self._decode_fns: Dict = {}
+        # unified executable cache: ("ragged", token_bucket, with_pool)
+        # -> the packed mixed prefill/prefix-resume/verify executable
+        # ("engine_ragged" compile family), ("decode", chunk) -> the
+        # chunked decode scan ("engine_decode"). The old
+        # (bucket, pages)-keyed prefill / prefix-resume / verify zoo
+        # collapsed into the ragged family (ISSUE 7).
+        self._fns: Dict = {}
+        # per-ragged-executable implementation record: fkey ->
+        # ("pallas"|"jnp", reason) so launches can surface which path
+        # they took (a TPU deployment silently riding the O(T^2)
+        # reference because a shape gate rejected the kernel is a
+        # throughput cliff that must be visible in observability)
+        self._ragged_paths: Dict = {}
         # load shedding / deadlines / watchdog (resilience layer)
         self.shed_load = bool(shed_load)
         self.max_waiting = max_waiting
@@ -636,7 +661,8 @@ class LLMEngine:
             deadline_expired=0, prefix_cache_hit_tokens=0,
             prefix_cache_miss_tokens=0, spec_steps=0,
             spec_drafted_tokens=0, spec_accepted_tokens=0,
-            spec_proposer_errors=0, spec_step_errors=0)
+            spec_proposer_errors=0, spec_step_errors=0,
+            ragged_launches=0)
         # in-step pool-occupancy high-water (pages off the free list
         # at the post-lease peak); plain attribute, reset at will
         self.peak_used_blocks = 0
@@ -847,11 +873,11 @@ class LLMEngine:
 
     # -- device steps ------------------------------------------------------
     def _run_prefills(self, seqs: List[_Seq]) -> List[int]:
-        """ONE batched pass over every admitted sequence's prompt
-        (+ resumed tokens): rows are padded to the bucketed max context
-        and to max_batch (empty rows write nothing), so the model's
-        weights stream ONCE per admission wave instead of once per
-        sequence. Returns each sequence's first sampled token."""
+        """ONE ragged packed pass over every admitted sequence's
+        uncached tokens: rows pack back-to-back into the total-token
+        bucket (dead padding writes nothing), so the model's weights
+        stream ONCE per admission wave instead of once per sequence.
+        Returns each sequence's first sampled token."""
         t0 = time.perf_counter()
         with _ot.span("engine.prefill", seqs=len(seqs)):
             out = self._run_prefills_impl(seqs)
@@ -873,278 +899,132 @@ class LLMEngine:
         return out
 
     def _run_prefills_impl(self, seqs: List[_Seq]) -> List[int]:
+        entries, merged = self._prefill_entries(seqs)
+        toks = self._run_ragged(entries)
+        self._commit_prefill(seqs, merged)
+        return [int(toks[s.slot][-1]) for s in seqs]
+
+    def _prefill_entries(self, seqs: List[_Seq]):
+        """Ragged-batch rows for a prefill wave: each sequence
+        contributes its UNCACHED suffix at its per-row cached offset
+        (page-aligned; 0 when nothing was cached). Applies the COW
+        guard and the per-sequence accounting every prefill execution
+        carries. Returns (entries, {rid: merged prompt+carried tokens})
+        so the post-launch commit reuses the merged arrays instead of
+        re-concatenating per sequence."""
         self.stats["prefills"] += len(seqs)
+        entries = []
+        merged_by_rid = {}
         for s in seqs:
             faults.fault_point("engine.prefill.seq", rid=s.rid)
-        B = self.max_batch
-        merged = [self._merged_tokens(s) for s in seqs]
-        plens = [len(m) for m in merged]
-        starts = [s.cached_len for s in seqs]
-        # COW guard: the suffix write range must not touch shared pages
-        # (a no-op under page-aligned matching, by construction)
-        for s, st in zip(seqs, starts):
+            merged = self._merged_tokens(s)
+            merged_by_rid[s.rid] = merged
+            st = s.cached_len
+            # COW guard: the suffix write range must not touch shared
+            # pages (a no-op under page-aligned matching)
             self.cache.ensure_writable(s.rid, st)
-        # the context bucket governs the write-table width either way
-        sbc = min(_bucket(max(plens), self.prompt_quantum),
-                  self.max_model_len)
-        npb_pf = -(-sbc // self.block_size)
-        if not any(starts):
-            # no cached prefix anywhere: the legacy executable (no pool
-            # read-back) — bit-for-bit the caching-off path
-            nxt = self._call_prefill_full(seqs, merged, sbc, npb_pf)
-        else:
-            nxt = self._call_prefill_prefix(seqs, merged, starts,
-                                            npb_pf)
-        if self.cache.enable_prefix_caching:
-            for s, m in zip(seqs, merged):
-                self.cache.commit_prefix(s.rid, m)
-        return nxt
+            entries.append((s, np.asarray(merged[st:], np.int32), st,
+                            False))
+        return entries, merged_by_rid
 
-    def _call_prefill_full(self, seqs, merged, sb, npb_pf) -> List[int]:
-        B = self.max_batch
-        ids = np.zeros((B, sb), np.int32)
-        plen = np.zeros((B,), np.int32)
-        tbl = np.full((B, npb_pf), -1, np.int32)
-        for r, (s, m) in enumerate(zip(seqs, merged)):
-            ids[r, :len(m)] = m
-            plen[r] = len(m)
-            pages = self.cache.pages(s.rid)
-            tbl[r, :len(pages)] = pages
-        fn = self._prefill_fn(sb, npb_pf)
-        kcs, vcs = self.cache.key_caches, self.cache.value_caches
-        self._key, sub = jax.random.split(self._key)
-        with self._step_watchdog("engine prefill"):
-            nxt, kcs, vcs = fn([t._data for t in self._tensors], kcs, vcs,
-                               jnp.asarray(ids), jnp.asarray(plen),
-                               jnp.asarray(tbl), sub)
-            nxt = jax.block_until_ready(nxt)
-        for i in range(self.cache.num_layers):
-            self.cache.update(i, kcs[i], vcs[i])
-        return [int(t) for t in np.asarray(nxt)[:len(seqs)]]
+    def _commit_prefill(self, seqs: List[_Seq],
+                        merged_by_rid: Dict) -> None:
+        if not self.cache.enable_prefix_caching:
+            return
+        for s in seqs:
+            if self.slots[s.slot] is s:
+                self.cache.commit_prefix(s.rid, merged_by_rid[s.rid])
 
-    def _call_prefill_prefix(self, seqs, merged, starts,
-                             npb_pf) -> List[int]:
-        """Prefix-resume prefill: each row computes only its UNCACHED
-        suffix; attention over the cached page-aligned prefix reads the
-        pool through the per-row ownership map (the decode pattern).
-        The suffix length, not the full context, picks the bucket — the
-        FLOPs saved are exactly the cache-hit tokens."""
-        B = self.max_batch
-        NB = self.cache.allocator.num_blocks
-        slens = [len(m) - st for m, st in zip(merged, starts)]
-        sb = min(_bucket(max(slens), self.prompt_quantum),
-                 self.max_model_len)
-        ids = np.zeros((B, sb), np.int32)
-        pstart = np.zeros((B,), np.int32)
-        plen = np.zeros((B,), np.int32)
-        tbl = np.full((B, npb_pf), -1, np.int32)
-        off = np.full((B, NB), -1, np.int32)
-        for r, (s, m, st) in enumerate(zip(seqs, merged, starts)):
-            ids[r, :len(m) - st] = m[st:]
-            pstart[r] = st
-            plen[r] = len(m)
-            pages = self.cache.pages(s.rid)
-            tbl[r, :len(pages)] = pages
-            off[r, pages] = np.arange(len(pages), dtype=np.int32) \
-                * self.block_size
-        fn = self._prefill_prefix_fn(sb, npb_pf)
-        kcs, vcs = self.cache.key_caches, self.cache.value_caches
-        self._key, sub = jax.random.split(self._key)
-        with self._step_watchdog("engine prefill"):
-            nxt, kcs, vcs = fn([t._data for t in self._tensors], kcs, vcs,
-                               jnp.asarray(ids), jnp.asarray(pstart),
-                               jnp.asarray(plen), jnp.asarray(tbl),
-                               jnp.asarray(off), sub)
-            nxt = jax.block_until_ready(nxt)
-        for i in range(self.cache.num_layers):
-            self.cache.update(i, kcs[i], vcs[i])
-        return [int(t) for t in np.asarray(nxt)[:len(seqs)]]
+    # -- ragged packed launches (prefill / prefix-resume / verify) ---------
+    def _token_bucket(self, n: int) -> int:
+        """Total-token bucket for the ragged executable: power-of-two
+        below the prompt quantum (floored at the Pallas sublane count),
+        quantum multiples above — the ONLY shape the ragged family
+        compiles on, so a mixed workload reuses O(log + linear/quantum)
+        executables instead of one per (kind, length, pages) triple."""
+        if n >= self.prompt_quantum:
+            return _bucket(n, self.prompt_quantum)
+        return max(8, _pow2_ceil(max(n, 1)))
 
-    def _prefill_fn(self, sb: int, npb_pf: int):
-        """Prompt pass: plain causal self-attention over the prompt's
-        OWN freshly computed k/v (no pool read-back) + one flat
-        token-major scatter per cache writing the pool pages."""
-        hit = self._prefill_fns.get((sb, npb_pf))
+    def _ragged_fn(self, tb: int, with_pool: bool, all_pos: bool):
+        """The ragged packed-batch executable ("engine_ragged" compile
+        family): every token-computing launch — fresh prefill,
+        prefix-resume, speculative verify — compiles down to this one
+        function of the total-token bucket. Rows of arbitrary per-row
+        lengths ride in a [tb] packed stream with per-token
+        (row, position) metadata; attention over the paged pool plus
+        the packed fresh k/v runs through
+        kernels.pallas.ragged_paged_attention (flash-style Pallas
+        kernel on TPU, the jnp reference on CPU — the
+        float-op-structure twin of the executables it replaced, so
+        greedy outputs stay bit-identical with the dense oracle).
+        with_pool=False is the no-cached-context variant: nothing
+        reads the pool, exactly the legacy fresh-prefill data flow.
+        all_pos=True (verify waves) samples a token at EVERY packed
+        position; all_pos=False (prefill waves) gathers each row's
+        last hidden state through the `sel` operand before the lm
+        head, so the [tb, vocab] logits tensor — ~tokens/rows times
+        the lm-head FLOPs and a multi-GB HBM spike at serving shapes —
+        is only ever built for the short verify windows that consume
+        all of it."""
+        fkey = ("ragged", tb, with_pool, all_pos)
+        hit = self._fns.get(fkey)
         if hit is not None:
-            return hit
+            return hit, self._ragged_paths[fkey][0]
         from ..jit import _functional_params
         from ..autograd import tape as _tape
         from ..models.generation import _pick_token
         from ..incubate.nn.functional.serving import _quantize_kv, \
             _apply_rotary
+        from ..kernels.pallas.ragged_paged_attention import (
+            ragged_attention_path, ragged_paged_attention)
         import math as _math
         fam = self.fam
         rope = self._rope
         bs = self.block_size
         kvH, H_D = self.fam.kv_heads, self.fam.head_dim
-        scale = 1.0 / _math.sqrt(H_D)
-        tensors = self._tensors
-        kq, vq = self._kq, self._vq
-
-        B = self.max_batch
-
-        def prefill(params, kcs, vcs, ids, plen, tbl, key):
-            # ids [B, sb]; plen [B] (0 = empty row); tbl [B, npb_pf]
-            with _tape.no_grad(), _functional_params(tensors, params):
-                T_pool = kcs[0].shape[0]
-                pos = jnp.arange(sb, dtype=jnp.int32)
-                x = Tensor._wrap(fam.embed(
-                    ids, jnp.broadcast_to(pos[None], (B, sb))))
-                page = pos[None, :] // bs                   # [1, sb]
-                phys = jnp.maximum(
-                    jnp.take_along_axis(tbl, jnp.broadcast_to(
-                        page, (B, sb)), axis=1), 0)
-                # dead tokens (>= row plen) scatter OOB -> dropped
-                flat = jnp.where(pos[None, :] < plen[:, None],
-                                 phys * bs + pos[None, :] % bs,
-                                 T_pool).reshape(-1)        # [B*sb]
-                live = (pos[None, :] < plen[:, None])
-                new_k, new_v = [], []
-                for li, layer in enumerate(fam.layers()):
-                    qkv = fam.qkv(layer, Tensor._wrap(
-                        x._data.reshape(B * sb, -1)))
-                    nH = qkv.shape[-1] // H_D - 2 * kvH
-                    q = qkv[:, :nH * H_D].reshape(B, sb, nH, H_D)
-                    k = qkv[:, nH * H_D:(nH + kvH) * H_D].reshape(
-                        B, sb, kvH, H_D)
-                    v = qkv[:, (nH + kvH) * H_D:].reshape(
-                        B, sb, kvH, H_D)
-                    if rope is not None:
-                        cos = rope[0][pos][None, :, None, :]
-                        sin = rope[1][pos][None, :, None, :]
-                        q = _apply_rotary(q, cos, sin, True).astype(
-                            q.dtype)
-                        k = _apply_rotary(k, cos, sin, True).astype(
-                            k.dtype)
-                    if kq is not None:
-                        kw = _quantize_kv(k, kq[li], 1, 127., -127.)
-                        vw = _quantize_kv(v, vq[li], 1, 127., -127.)
-                    else:
-                        kw = k.astype(kcs[li].dtype)
-                        vw = v.astype(vcs[li].dtype)
-                    new_k.append(kcs[li].at[flat].set(
-                        kw.reshape(B * sb, kvH, H_D)))
-                    new_v.append(vcs[li].at[flat].set(
-                        vw.reshape(B * sb, kvH, H_D)))
-                    # attention over each row's own prompt (k/v still
-                    # in registers — never read back from the pool)
-                    rep = nH // kvH
-                    kr = jnp.repeat(k, rep, axis=2) if rep > 1 else k
-                    vr = jnp.repeat(v, rep, axis=2) if rep > 1 else v
-                    s = jnp.einsum(
-                        "bqhd,bkhd->bhqk",
-                        (q.astype(jnp.float32) * scale).astype(q.dtype),
-                        kr, preferred_element_type=jnp.float32)
-                    ok = (pos[None, None, :] <= pos[None, :, None]) & \
-                        live[:, None, :]
-                    s = jnp.where(ok[:, None, :, :], s, -jnp.inf)
-                    p = jax.nn.softmax(s, axis=-1)
-                    p = jnp.where(jnp.isnan(p), 0.0, p)  # empty rows
-                    o = jnp.einsum("bhqk,bkhd->bqhd",
-                                   p.astype(vr.dtype), vr,
-                                   preferred_element_type=jnp.float32)
-                    x = fam.attn_out(
-                        layer, x,
-                        o.reshape(B, sb, nH * H_D).astype(
-                            x._data.dtype))
-                    x = fam.mlp(layer, x)
-                x = fam.final(x)
-                last_idx = jnp.maximum(plen - 1, 0)          # [B]
-                last = jnp.take_along_axis(
-                    x._data, last_idx[:, None, None], axis=1)  # [B,1,h]
-                lg = fam.logits(Tensor._wrap(last))._data[:, -1]
-                nxt, _ = _pick_token(lg.astype(jnp.float32), key,
-                                     self.do_sample, self.temperature,
-                                     self.top_p, self.top_k)
-                return nxt, new_k, new_v
-
-        fn = _CompileTimed(jax.jit(prefill, donate_argnums=(1, 2)),
-                           "engine_prefill")
-        self._prefill_fns[(sb, npb_pf)] = fn
-        return fn
-
-    def _prefill_prefix_fn(self, sb: int, npb_pf: int,
-                           all_positions: bool = False):
-        """Prefix-resume prompt pass: each row starts at its per-row
-        cached offset `pstart` (page-aligned). The suffix's q/k/v are
-        computed fresh and its self-attention stays in registers
-        (exactly the legacy prefill); attention over the cached prefix
-        reads the POOL through the per-row block-ownership map, the
-        same masked whole-pool pattern decode uses. Rows with
-        pstart=0 reduce to the legacy math.
-
-        all_positions=True builds the SPECULATIVE VERIFY variant of
-        the same executable: the suffix is a row's [last committed
-        token + k drafts] window (pstart = tokens in the cache — not
-        page-aligned here, which is fine: the ownership map masks by
-        exact position, and `ensure_writable` guarded the write
-        range), and tokens are sampled at EVERY suffix position
-        instead of only the last — one weight/pool stream scores all
-        k+1 positions, which is the entire speedup of speculative
-        decoding over one-token-per-stream decode. The per-position
-        math is the prefix-resume math verbatim, the same family of
-        executables the bit-identity oracle tests pin."""
-        fkey = (sb, npb_pf, "verify" if all_positions else "prefix")
-        hit = self._prefill_fns.get(fkey)
-        if hit is not None:
-            return hit
-        from ..jit import _functional_params
-        from ..autograd import tape as _tape
-        from ..models.generation import _pick_token
-        from ..incubate.nn.functional.serving import _quantize_kv, \
-            _apply_rotary
-        import math as _math
-        fam = self.fam
-        rope = self._rope
-        bs = self.block_size
-        kvH, H_D = self.fam.kv_heads, self.fam.head_dim
+        nH = self.model.config.num_heads
         scale = 1.0 / _math.sqrt(H_D)
         tensors = self._tensors
         kq, vq = self._kq, self._vq
         kdq = None if kq is None else 1.0 / kq
         vdq = None if vq is None else 1.0 / vq
-        B = self.max_batch
+        T_pool = self.cache.allocator.num_blocks * bs
+        # implementation pick is an executable-shape property: resolved
+        # ONCE here (the Pallas availability probe runs a device call —
+        # never inside the trace), then baked into the program
+        path, why = ragged_attention_path(
+            tb, T_pool if with_pool else 0, nH, kvH, H_D, bs, with_pool)
+        self._ragged_paths[fkey] = (path, why)
+        if path == "jnp" and jax.default_backend() == "tpu":
+            # the reference path materializes [H, T, T] scores — fine
+            # for CPU tests/oracles, a serving cliff on TPU
+            warnings.warn(
+                f"ragged executable {fkey} fell back to the jnp "
+                f"reference on a TPU backend: {why}", RuntimeWarning,
+                stacklevel=2)
 
-        def prefill(params, kcs, vcs, ids, pstart, plen, tbl, off, key):
-            # ids [B, sb]: suffix tokens; pstart [B]: cached-prefix
-            # length (page-aligned); plen [B]: total context; tbl
-            # [B, npb_pf]: full write table; off [B, NB]: block ->
-            # start position in row b, -1 when not owned
+        def ragged(params, kcs, vcs, ids, rows, pos, kvs, off, wf, sel,
+                   key):
+            # ids/rows/pos/wf [tb]: the packed token stream (rows -1 =
+            # dead padding; wf = flat pool row to write, T_pool drops);
+            # kvs [B]: cached tokens readable per row; off [B, NB]:
+            # block -> start position ownership map; sel [B]: each
+            # row's last packed position (0 for empty slots; consumed
+            # only when all_pos=False)
             with _tape.no_grad(), _functional_params(tensors, params):
-                cdtype = kcs[0].dtype
-                T_pool = kcs[0].shape[0]
-                j = jnp.arange(sb, dtype=jnp.int32)
-                pos = pstart[:, None] + j[None, :]     # [B, sb] absolute
-                slen = plen - pstart
-                live = j[None, :] < slen[:, None]      # [B, sb]
-                x = Tensor._wrap(fam.embed(ids, pos))
-                page = jnp.clip(pos // bs, 0, npb_pf - 1)
-                phys = jnp.maximum(
-                    jnp.take_along_axis(tbl, page, axis=1), 0)
-                # dead tokens (>= row suffix) scatter OOB -> dropped
-                flat = jnp.where(live, phys * bs + pos % bs,
-                                 T_pool).reshape(-1)
-                # pool ownership/position mask is frozen for the pass:
-                # only positions strictly inside the cached prefix
-                toff = jnp.repeat(off, bs, axis=1)     # [B, T_pool]
-                gpos_pool = toff + jnp.tile(
-                    jnp.arange(bs, dtype=jnp.int32),
-                    T_pool // bs)[None, :]
-                pool_ok = (toff >= 0) & (gpos_pool < pstart[:, None])
+                x = Tensor._wrap(fam.embed(ids, pos))      # [tb, h]
                 new_k, new_v = [], []
                 for li, layer in enumerate(fam.layers()):
-                    qkv = fam.qkv(layer, Tensor._wrap(
-                        x._data.reshape(B * sb, -1)))
-                    nH = qkv.shape[-1] // H_D - 2 * kvH
-                    rep = nH // kvH
-                    q = qkv[:, :nH * H_D].reshape(B, sb, nH, H_D)
+                    qkv = fam.qkv(layer, x)
+                    q = qkv[:, :nH * H_D].reshape(tb, nH, H_D)
                     k = qkv[:, nH * H_D:(nH + kvH) * H_D].reshape(
-                        B, sb, kvH, H_D)
+                        tb, kvH, H_D)
                     v = qkv[:, (nH + kvH) * H_D:].reshape(
-                        B, sb, kvH, H_D)
+                        tb, kvH, H_D)
                     if rope is not None:
-                        cos = rope[0][pos][:, :, None, :]  # [B,sb,1,D/2]
-                        sin = rope[1][pos][:, :, None, :]
+                        cos = rope[0][pos][:, None, :]     # [tb,1,D/2]
+                        sin = rope[1][pos][:, None, :]
                         q = _apply_rotary(q, cos, sin, True).astype(
                             q.dtype)
                         k = _apply_rotary(k, cos, sin, True).astype(
@@ -1155,84 +1035,125 @@ class LLMEngine:
                     else:
                         kw = k.astype(kcs[li].dtype)
                         vw = v.astype(vcs[li].dtype)
-                    new_k.append(kcs[li].at[flat].set(
-                        kw.reshape(B * sb, kvH, H_D)))
-                    new_v.append(vcs[li].at[flat].set(
-                        vw.reshape(B * sb, kvH, H_D)))
-                    # suffix self-attention: own k/v still in registers
-                    kr = jnp.repeat(k, rep, axis=2) if rep > 1 else k
-                    vr = jnp.repeat(v, rep, axis=2) if rep > 1 else v
-                    qs = q.astype(jnp.float32) * scale
-                    ss = jnp.einsum("bqhd,bkhd->bhqk",
-                                    qs.astype(q.dtype), kr,
-                                    preferred_element_type=jnp.float32)
-                    ok = (j[None, None, :] <= j[None, :, None]) & \
-                        live[:, None, :]
-                    ss = jnp.where(ok[:, None, :, :], ss, -jnp.inf)
-                    # cached-prefix attention against the pool (read of
-                    # kcs/vcs BEFORE this layer's scatter: prefix pages
-                    # and suffix writes are disjoint rows)
-                    q4 = qs.reshape(B, sb, kvH, rep, H_D)
-                    if cdtype == jnp.int8:
-                        qop = q4
-                        kp = kcs[li].astype(jnp.float32)
-                    else:
-                        qop = q4.astype(cdtype)
-                        kp = kcs[li]
-                    sp = jnp.einsum("bqkrd,tkd->bkrqt", qop, kp,
-                                    preferred_element_type=jnp.float32)
-                    if kdq is not None:
-                        sp = sp * kdq[li][None, :, None, None, None]
-                    sp = sp.reshape(B, nH, sb, T_pool)
-                    sp = jnp.where(pool_ok[:, None, None, :], sp,
-                                   -jnp.inf)
-                    s = jnp.concatenate([sp, ss], axis=-1)
-                    p = jax.nn.softmax(s, axis=-1)
-                    p = jnp.where(jnp.isnan(p), 0.0, p)    # empty rows
-                    pp, psf = p[..., :T_pool], p[..., T_pool:]
-                    pp = pp.reshape(B, kvH, rep, sb, T_pool)
-                    if cdtype == jnp.int8:
-                        vp, ppo = vcs[li].astype(jnp.float32), pp
-                    else:
-                        vp, ppo = vcs[li], pp.astype(cdtype)
-                    o = jnp.einsum("bkrqt,tkd->bqkrd", ppo, vp,
-                                   preferred_element_type=jnp.float32)
-                    if vdq is not None:
-                        o = o * vdq[li][None, None, :, None, None]
-                    o = o.reshape(B, sb, nH * H_D)
-                    o = o + jnp.einsum(
-                        "bhqk,bkhd->bqhd", psf.astype(vr.dtype), vr,
-                        preferred_element_type=jnp.float32).reshape(
-                            B, sb, nH * H_D)
-                    x = fam.attn_out(layer, x,
-                                     o.astype(x._data.dtype))
+                    # dead/padded tokens carry wf = T_pool: the scatter
+                    # drops them (the same OOB trick every engine write
+                    # path uses)
+                    new_k.append(kcs[li].at[wf].set(kw))
+                    new_v.append(vcs[li].at[wf].set(vw))
+                    # pool attention reads kcs/vcs BEFORE this layer's
+                    # scatter: cached-prefix pages and fresh writes are
+                    # disjoint pool rows, packed k/v stay in registers
+                    o = ragged_paged_attention(
+                        q, k, v, kcs[li], vcs[li], rows, pos, kvs, off,
+                        block_size=bs, scale=scale,
+                        kdq=None if kdq is None else kdq[li],
+                        vdq=None if vdq is None else vdq[li],
+                        with_pool=with_pool, path=path)
+                    x = fam.attn_out(
+                        layer, x,
+                        o.reshape(tb, nH * H_D).astype(x._data.dtype))
                     x = fam.mlp(layer, x)
                 x = fam.final(x)
-                if all_positions:
-                    # verify: greedy targets at every suffix position
-                    # (j scores the token AFTER j committed/drafted
-                    # tokens); dead rows/positions are ignored by the
-                    # host-side acceptance
-                    lg = fam.logits(x)._data         # [B, sb, vocab]
-                    nxt, _ = _pick_token(
-                        lg.reshape(B * sb, -1).astype(jnp.float32),
-                        key, self.do_sample, self.temperature,
-                        self.top_p, self.top_k)
-                    return nxt.reshape(B, sb), new_k, new_v
-                last_idx = jnp.maximum(slen - 1, 0)          # [B]
-                last = jnp.take_along_axis(
-                    x._data, last_idx[:, None, None], axis=1)  # [B,1,h]
-                lg = fam.logits(Tensor._wrap(last))._data[:, -1]
+                if all_pos:
+                    # verify: sampled targets at EVERY packed position
+                    # (the lm head over [tb] rows is row-wise, so the
+                    # per-position logits are the same values the
+                    # per-kind executables computed)
+                    lg = fam.logits(x)._data               # [tb, vocab]
+                else:
+                    # prefill: only each row's last position feeds a
+                    # token — gather [B] hidden rows before the lm
+                    # head (row-wise, so bit-identical to slicing the
+                    # full [tb, vocab] logits at sel)
+                    lg = fam.logits(
+                        Tensor._wrap(x._data[sel]))._data  # [B, vocab]
                 nxt, _ = _pick_token(lg.astype(jnp.float32), key,
                                      self.do_sample, self.temperature,
                                      self.top_p, self.top_k)
                 return nxt, new_k, new_v
 
-        fn = _CompileTimed(jax.jit(prefill, donate_argnums=(1, 2)),
-                           "engine_verify" if all_positions
-                           else "engine_prefix_resume")
-        self._prefill_fns[fkey] = fn
-        return fn
+        fn = _CompileTimed(jax.jit(ragged, donate_argnums=(1, 2)),
+                           "engine_ragged")
+        self._fns[fkey] = fn
+        return fn, path
+
+    def _run_ragged(self, entries) -> Dict[int, np.ndarray]:
+        """Pack mixed rows into ONE ragged launch and run it.
+
+        entries: [(seq, tokens int32 [m], start, all_positions)] — each
+        row computes its `tokens` at absolute positions
+        start..start+m-1 while reading its cached context (positions
+        < start) from the paged pool through the per-row ownership
+        map; writes land token-major at the row's leased pages.
+        Returns {slot: np.int32 [m]} — every packed position's sampled
+        token for a verify wave, [1] (the row's last position) for a
+        prefill wave."""
+        B = self.max_batch
+        NB = self.cache.allocator.num_blocks
+        bs = self.block_size
+        T_pool = NB * bs
+        T_raw = sum(len(t) for _s, t, _st, _ap in entries)
+        with_pool = any(st > 0 for _s, _t, st, _ap in entries)
+        # waves are homogeneous: a prefill wave (all_pos=False
+        # everywhere) or a verify wave (True everywhere)
+        all_pos = entries[0][3]
+        if all_pos:
+            # verify waves PIN one bucket sized for the worst case
+            # (every slot drafting the full k) — draft lengths vary
+            # step to step, and letting them move the bucket would
+            # reintroduce the unpredictable mid-serving compile the
+            # old fixed-width verify executable existed to prevent
+            tb = self._token_bucket(B * (self._spec_k + 1))
+        else:
+            tb = self._token_bucket(T_raw)
+        ids = np.zeros((tb,), np.int32)
+        rows = np.full((tb,), -1, np.int32)
+        pos = np.zeros((tb,), np.int32)
+        kvs = np.zeros((B,), np.int32)
+        off = np.full((B, NB), -1, np.int32)
+        wf = np.full((tb,), T_pool, np.int32)
+        sel = np.zeros((B,), np.int32)
+        spans = {}
+        c = 0
+        for s, toks, st, _ap in entries:
+            m = len(toks)
+            b = s.slot
+            ids[c:c + m] = toks
+            rows[c:c + m] = b
+            gpos = st + np.arange(m, dtype=np.int32)
+            pos[c:c + m] = gpos
+            kvs[b] = st
+            pages = np.asarray(self.cache.pages(s.rid), np.int32)
+            off[b, pages] = np.arange(len(pages), dtype=np.int32) * bs
+            wf[c:c + m] = pages[gpos // bs] * bs + gpos % bs
+            sel[b] = c + m - 1
+            spans[b] = (c, m)
+            c += m
+        fn, impl = self._ragged_fn(tb, with_pool, all_pos)
+        kcs, vcs = self.cache.key_caches, self.cache.value_caches
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
+        with _ot.span("engine.ragged", rows=len(entries),
+                      tokens=T_raw, bucket=tb, path=impl):
+            with self._step_watchdog("engine ragged launch"):
+                nxt, kcs, vcs = fn(
+                    [t._data for t in self._tensors], kcs, vcs,
+                    jnp.asarray(ids), jnp.asarray(rows),
+                    jnp.asarray(pos), jnp.asarray(kvs),
+                    jnp.asarray(off), jnp.asarray(wf),
+                    jnp.asarray(sel), sub)
+                nxt = jax.block_until_ready(nxt)
+        t1 = time.perf_counter()
+        for i in range(self.cache.num_layers):
+            self.cache.update(i, kcs[i], vcs[i])
+        self.stats["ragged_launches"] += 1
+        if _om._ENABLED:
+            _metrics()["ragged"].observe(t1 - t0)
+        nxt = np.asarray(nxt)
+        if all_pos:
+            return {b: nxt[cc:cc + m] for b, (cc, m) in spans.items()}
+        # prefill waves sampled one token per row (at sel)
+        return {b: nxt[b:b + 1] for b in spans}
 
     def _decode_fn(self, chunk: int):
         """Chunked decode executable. The pool stays READ-ONLY inside
@@ -1243,7 +1164,7 @@ class LLMEngine:
         buffer via dynamic-update-slice and attends over pool+staging
         jointly; the staging merges into the pool with ONE flat
         token-major scatter per cache at chunk end."""
-        hit = self._decode_fns.get(chunk)
+        hit = self._fns.get(("decode", chunk))
         if hit is not None:
             return hit
         from ..jit import _functional_params
@@ -1387,7 +1308,7 @@ class LLMEngine:
 
         fn = _CompileTimed(jax.jit(decode, donate_argnums=(1, 2)),
                            "engine_decode")
-        self._decode_fns[chunk] = fn
+        self._fns[("decode", chunk)] = fn
         return fn
 
     def _run_decode_chunk(self, only: Optional[_Seq] = None
@@ -1563,15 +1484,14 @@ class LLMEngine:
         drafting = sum(1 for d in drafts.values() if len(d))
         if k_step <= 0 or 2 * drafting < len(active):
             return False
-        # the verify width is FIXED at the configured k (+1), not the
-        # step's max draft length: draft lengths vary step to step
-        # (n-gram hits are as long as the matched continuation), and a
-        # per-length executable would pay an unpredictable mid-serving
-        # compile per new length — dead positions are masked and their
-        # writes dropped, so padding costs only compute
-        n = self._spec_k + 1
+        # verify rides the ragged family: each row packs only its LIVE
+        # 1+len(drafts) window into a bucket PINNED at the worst-case
+        # B*(k+1) tokens (_run_ragged), so varying draft lengths
+        # (n-gram hits are as long as the matched continuation) can
+        # never compile a new shape — the same one-executable property
+        # the old fixed-width verify had, without the per-row padding
         try:
-            tgt, active = self._spec_device_phase(active, drafts, n,
+            tgt, active = self._spec_device_phase(active, drafts,
                                                   k_step)
         except Exception:
             # a failure raised by the donated verify call itself is
@@ -1602,8 +1522,9 @@ class LLMEngine:
         for s in active:
             b = s.slot
             d = drafts[b]
-            a = accept_drafts(d, tgt[b])
-            committed = tgt[b, :a + 1]      # accepted drafts + bonus
+            t_row = tgt[b]                  # [1+len(d)] greedy targets
+            a = accept_drafts(d, t_row)
+            committed = t_row[:a + 1]       # accepted drafts + bonus
             n_before = len(s.out)
             for t in committed:
                 if len(s.out) >= s.max_new:
@@ -1666,13 +1587,13 @@ class LLMEngine:
                                    / self.stats["spec_drafted_tokens"])
         return True
 
-    def _spec_device_phase(self, active, drafts, n, k_step):
+    def _spec_device_phase(self, active, drafts, k_step):
         """Lease + batched verify call for `_run_spec_step`. Returns
-        (targets [B, n] np.int32, surviving active list) — or
-        (None, None) when preemption during leasing emptied the batch.
-        Everything in here may fail WITHOUT having mutated host-side
-        sequence state, which is what makes the caller's degrade-to-
-        chunked-decode fallback safe."""
+        ({slot: np.int32 [1+len(drafts)] greedy targets}, surviving
+        active list) — or (None, None) when preemption during leasing
+        emptied the batch. Everything in here may fail WITHOUT having
+        mutated host-side sequence state, which is what makes the
+        caller's degrade-to-chunked-decode fallback safe."""
         # lease each row's LIVE verify window up front (preempting if
         # needed): only the row's own 1+len(drafts) positions ever
         # write (dead padding scatters out of bounds), and the lease
@@ -1696,51 +1617,29 @@ class LLMEngine:
         if not active:
             return None, None
         self._note_pool_highwater()
-        B = self.max_batch
-        NB = self.cache.allocator.num_blocks
-        # operand layout is the prefix-resume prefill's: each row's
-        # "suffix" is its verify window [last committed token, k
-        # drafts] at absolute positions length..length+k, the cached
-        # context is read from the pool through the ownership map.
-        # Inactive/padded positions are dead (>= row plen): their
-        # writes scatter out of bounds and drop
-        ids = np.zeros((B, n), np.int32)
-        pstart = np.zeros((B,), np.int32)
-        plen = np.zeros((B,), np.int32)
-        tbl = np.full((B, self.npb_full), -1, np.int32)
-        off = np.full((B, NB), -1, np.int32)
+        # each row's ragged entry is its verify window [last committed
+        # token, drafts...] at absolute positions length..length+k —
+        # the cached context reads from the pool through the ownership
+        # map, and the packed launch scores every window position in
+        # one pass. Row widths are the LIVE 1+len(drafts) (no per-row
+        # padding); the launch bucket is pinned at B*(k+1) so draft
+        # length variation never compiles a new shape.
+        entries = []
         for s in active:
             b = s.slot
             d = drafts.get(b, np.zeros((0,), np.int32))
             drafts[b] = d
-            ids[b, 0] = self._last_token(s)
-            ids[b, 1:1 + len(d)] = d
-            pstart[b] = s.length
-            plen[b] = s.length + 1 + len(d)
-            pages = self.cache.pages(s.rid)
-            tbl[b, :len(pages)] = pages
-            off[b, pages] = np.arange(len(pages), dtype=np.int32) \
-                * self.block_size
-        fn = self._prefill_prefix_fn(n, self.npb_full,
-                                     all_positions=True)
-        kcs, vcs = self.cache.key_caches, self.cache.value_caches
-        self._key, sub = jax.random.split(self._key)
+            window = np.concatenate(
+                [np.asarray([self._last_token(s)], np.int32), d])
+            entries.append((s, window, s.length, True))
         t0 = time.perf_counter()
         with _ot.span("engine.verify", rows=len(active), k=k_step):
-            with self._step_watchdog("engine verify step"):
-                tgt, kcs, vcs = fn(
-                    [t._data for t in self._tensors], kcs, vcs,
-                    jnp.asarray(ids), jnp.asarray(pstart),
-                    jnp.asarray(plen), jnp.asarray(tbl),
-                    jnp.asarray(off), sub)
-                tgt = jax.block_until_ready(tgt)
+            tgt = self._run_ragged(entries)
         t1 = time.perf_counter()
-        for i in range(self.cache.num_layers):
-            self.cache.update(i, kcs[i], vcs[i])
         self._t_verify0, self._t_verify1 = t0, t1
         if _om._ENABLED:
             _metrics()["verify"].observe(t1 - t0)
-        return np.asarray(tgt), active      # [B, n] greedy targets
+        return tgt, active      # {slot: greedy targets}
 
     def _step_watchdog(self, what: str):
         """Hang detector around a device step (step_timeout_s)."""
@@ -1801,9 +1700,9 @@ class LLMEngine:
     def _safe_prefills(self, seqs: List[_Seq],
                        finished: List[GenerationResult]):
         """Batched prefill with poisoned-request isolation: if the
-        batch raises, each sequence is retried alone (same bucketed
-        executable — rows are padded to max_batch either way) and only
-        the one(s) that still raise are failed and evicted."""
+        packed batch raises, each sequence is retried alone (a smaller
+        total-token bucket of the same ragged family) and only the
+        one(s) that still raise are failed and evicted."""
         try:
             return list(zip(seqs, self._run_prefills(seqs)))
         except Exception:

@@ -400,9 +400,9 @@ class TestEngineInstrumentation:
             "deadline_expired", "decode_chunks", "decode_tokens",
             "failed_requests", "preemptions", "prefills",
             "prefix_cache_hit_tokens", "prefix_cache_miss_tokens",
-            "rejected_requests", "spec_accepted_tokens",
-            "spec_drafted_tokens", "spec_proposer_errors",
-            "spec_step_errors", "spec_steps"]
+            "ragged_launches", "rejected_requests",
+            "spec_accepted_tokens", "spec_drafted_tokens",
+            "spec_proposer_errors", "spec_step_errors", "spec_steps"]
         # nothing leaked into the (disabled) registry
         ev = _series("paddle_tpu_engine_events_total")
         assert sum(ev.values()) == 0

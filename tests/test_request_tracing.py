@@ -470,10 +470,10 @@ class TestEngineRequestTracing:
         comp = _series("paddle_tpu_compile_total")
         engine_compiles = sum(
             v for (fam,), v in comp.items() if fam.startswith("engine"))
-        assert engine_compiles == \
-            len(eng._prefill_fns) + len(eng._decode_fns)
-        # prefix caching + preemption means the resume family compiled
-        assert comp[("engine_prefix_resume",)] >= 1
+        assert engine_compiles == len(eng._fns)
+        # prefix caching + preemption means the pool-reading ragged
+        # variant compiled (prefix-resume rides the ragged family now)
+        assert comp[("engine_ragged",)] >= 1
         ct = _series("paddle_tpu_compile_seconds")
         assert sum(v["count"] for v in ct.values()) == engine_compiles
 
@@ -559,3 +559,71 @@ class TestSpawnBoundaryTraces:
         # and the metric snapshot still merges alongside (PR 2 path)
         assert _series(
             "paddle_tpu_dataloader_worker_batches_total")[()] == 3
+
+
+# ---------------------------------------------------------------------------
+# compile-family budget: the ragged rewire's executable-zoo contract
+# ---------------------------------------------------------------------------
+@pytest.mark.obs
+class TestCompileFamilyBudget:
+    """ISSUE 7 acceptance: the old (bucket, pages)-keyed prefill /
+    prefix-resume / verify executable zoo collapsed into ONE
+    `engine_ragged` family (bucketed only on total-token count) plus
+    the retained `engine_decode` chunk family. A mixed workload pins
+    (a) the counter == the executable-cache size, (b) the closed
+    family label set, and (c) the executable count under a budget —
+    so any zoo regrowth (a new family, per-(kind, length) keys
+    sneaking back) fails tier-1."""
+
+    BUDGET = 10     # ragged total-token buckets (x with/without pool)
+                    # + pow2 decode chunks for THIS workload; the old
+                    # zoo keyed the same traffic by (bucket, pages,
+                    # kind) and grew per dimension
+
+    def test_mixed_workload_stays_inside_family_budget(self, tiny_gpt):
+        from paddle_tpu.inference import LLMEngine, SpeculativeConfig
+        obs.enable()
+        rng = np.random.default_rng(7)
+        eng = LLMEngine(tiny_gpt, max_batch=2, block_size=8,
+                        num_blocks=24, decode_chunk=4,
+                        prompt_quantum=16, max_model_len=64,
+                        enable_prefix_caching=True,
+                        speculative_config=SpeculativeConfig(
+                            proposer="ngram",
+                            num_speculative_tokens=4))
+        # mixed traffic: two repetitive prompts first (they share the
+        # batch, so the n-gram proposer drafts and verify rows run),
+        # then shared-prefix prompts of assorted lengths (fresh
+        # prefill + prefix-resume rows), plus the chunked decode every
+        # sequence runs between launches
+        rep = [np.tile(rng.integers(0, 1024, (8,)).astype(np.int32), 4)
+               for _ in range(2)]
+        prefix = rng.integers(0, 1024, (8,)).astype(np.int32)
+        prompts = rep + [np.concatenate(
+            [prefix, rng.integers(0, 1024, (t,)).astype(np.int32)])
+            for t in (1, 5, 9)]
+        done = _run(eng, prompts, "mix", n_new=16)
+        assert len(done) == len(prompts)
+        assert all(r.ok for r in done.values())
+        assert eng.stats["ragged_launches"] > 0
+        assert eng.stats["spec_steps"] > 0      # verify rode ragged
+        assert eng.stats["decode_chunks"] > 0   # chunk family retained
+        comp = _series("paddle_tpu_compile_total")
+        # zero-valued rows are label sets other tests registered before
+        # obs.reset() (reset zeroes values but keeps series) — only
+        # families that actually compiled THIS workload count
+        fams = {fam for (fam,), v in comp.items() if v}
+        # the whole point: TWO engine families, nothing else
+        assert fams <= {"engine_ragged", "engine_decode"}, fams
+        assert "engine_ragged" in fams
+        engine_compiles = sum(v for (fam,), v in comp.items()
+                              if fam.startswith("engine"))
+        # counter == executable cache (no recompiles, no untimed fns)
+        assert engine_compiles == len(eng._fns), (
+            engine_compiles, sorted(eng._fns))
+        assert engine_compiles <= self.BUDGET, (
+            f"executable zoo regrew: {engine_compiles} > "
+            f"{self.BUDGET}: {sorted(eng._fns)}")
+        ct = _series("paddle_tpu_compile_seconds")
+        assert sum(v["count"] for (fam,), v in ct.items()
+                   if fam.startswith("engine")) == engine_compiles

@@ -14,8 +14,8 @@ This PR's motivating sites: the fused optimizer's
 ``_hyper_fingerprint`` (``repr(wd)`` of a weight-decay object =
 per-instance key) and its group-hyper fallback ``repr(items)`` — both
 fixed to structural fingerprints in the same change that lands this
-rule. The engine's executable caches (``_prefill_fns``/``_decode_fns``)
-key on shape/dtype tuples and stay clean.
+rule. The engine's executable cache (``LLMEngine._fns``) keys on
+shape/dtype tuples and stays clean.
 """
 from __future__ import annotations
 
@@ -56,7 +56,7 @@ def _unstable_why(node) -> str:
 
 def _cache_name(node) -> bool:
     """`node` names a cache-like container (`cache[...]`,
-    `self._prefill_fns[...]`)."""
+    `self._fns[...]`)."""
     d = U.dotted(node)
     if not d:
         return False
