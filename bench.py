@@ -24,19 +24,14 @@ import time
 
 import numpy as np
 
-PEAK_BF16_FLOPS = {
-    # per-chip peak bf16 FLOP/s
-    "v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12, "v4": 275e12,
-    "v3": 123e12, "v6e": 918e12,
-}
-
-
-def _device_lookup(device, table, default):
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return default
+# per-chip peak tables live in observability.perf (the roofline gauges
+# read them strictly — unknown device, no series); bench keeps its
+# historical convention of defaulting unknown devices to v5e numbers.
+# Imported lazily: no paddle_tpu import may happen at module scope
+# (the --window-server re-points sys.path first).
+def peak_flops(device) -> float:
+    from paddle_tpu.observability import perf
+    return perf.lookup(device, perf.PEAK_BF16_FLOPS, 197e12)  # v5e default
 
 
 def _request_latency_percentiles():
@@ -60,10 +55,6 @@ def _request_latency_percentiles():
         out[f"{key}_p95_ms"] = round(entry["p95"] * 1e3, 3)
         out[f"{key}_n"] = entry["count"]
     return out or None
-
-
-def peak_flops(device) -> float:
-    return _device_lookup(device, PEAK_BF16_FLOPS, 197e12)  # v5e default
 
 
 def _require_pallas(batch, seq, heads, head_dim, kv_heads=None):
@@ -255,10 +246,10 @@ def bench_resnet50(on_tpu):
                                dtype="bfloat16"):
                 return model(xx)._data
 
-    ca = jax.jit(fwd).lower([t._data for t in tensors],
-                            x).compile().cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else ca
-    fwd_bytes = float(ca.get("bytes accessed", 0.0))
+    from paddle_tpu.observability import perf as _perf
+    cm = _perf.read_cost_model(
+        jax.jit(fwd).lower([t._data for t in tensors], x).compile())
+    fwd_bytes = cm.bytes_accessed if cm else 0.0
     roofline_img_s = hbm_bw(dev) / (3.0 * fwd_bytes / batch) \
         if fwd_bytes else float("nan")
     return {
@@ -334,12 +325,41 @@ def bench_bert_base(on_tpu):
     }
 
 
+def _dispatch_gap_summary():
+    """Gap-histogram summary for the BENCH line: count, total, p50/p95
+    and the top op types by attributed gap seconds — the decomposition
+    of the eager-over-TrainStep ratio into named host gaps. None when
+    observability is off (--no-obs) or no backward ran."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import metrics as _m
+    if not obs.enabled():
+        return None
+    snap = obs.snapshot()
+    rec = snap.get("paddle_tpu_dispatch_gap_seconds")
+    val = (rec or {}).get("series", {}).get(())
+    if not val or not val["count"]:
+        return None
+    out = {"count": val["count"], "total_ms": round(val["sum"] * 1e3, 3)}
+    for name, q in (("p50_us", 0.5), ("p95_us", 0.95)):
+        est = _m.quantile_from_buckets(rec["buckets"], val["buckets"],
+                                       q, lo=val["min"], hi=val["max"])
+        if est is not None:
+            out[name] = round(est * 1e6, 1)
+    ops = snap.get("paddle_tpu_dispatch_gap_op_seconds_total", {})
+    top = sorted(ops.get("series", {}).items(), key=lambda kv: -kv[1])
+    out["top_ops_ms"] = {op: round(v * 1e3, 3)
+                         for (op,), v in top[:5] if v}
+    return out
+
+
 def bench_dispatch(on_tpu):
     """Eager op-dispatch latency (VERDICT r2 missing #7 measurement):
     a small fwd+bwd op chain driven eagerly — per-(op,shape) executable
     caching in ops.registry.dispatch vs the whole-graph TrainStep.
     Reports eager steps/s; extra carries the TrainStep ratio (the honest
-    guidance remains: train under TrainStep; eager is for development)."""
+    guidance remains: train under TrainStep; eager is for development)
+    plus the dispatch-gap histogram summary (per-grad-node host gaps —
+    the named decomposition of that ratio)."""
     import jax
     import paddle_tpu as pt
     from paddle_tpu.jit import TrainStep
@@ -402,19 +422,14 @@ def bench_dispatch(on_tpu):
             "exec_cache_entries": exec_cache_size(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "steps": steps,
+            "dispatch_gap": _dispatch_gap_summary(),
         },
     }
 
 
-HBM_BYTES_PER_SEC = {
-    # per-chip HBM bandwidth
-    "v5e": 819e9, "v5litepod": 819e9, "v5p": 2765e9, "v4": 1228e9,
-    "v3": 900e9, "v6e": 1640e9,
-}
-
-
 def hbm_bw(device) -> float:
-    return _device_lookup(device, HBM_BYTES_PER_SEC, 819e9)
+    from paddle_tpu.observability import perf
+    return perf.lookup(device, perf.HBM_BYTES_PER_SEC, 819e9)  # v5e default
 
 
 def bench_decode(on_tpu):
@@ -1014,6 +1029,52 @@ _GATE_SETUP_TIMEOUT_S = 1800.0   # window-server setup incl. compiles
 _GATE_WINDOW_TIMEOUT_S = 600.0   # one timed window
 
 
+# ---------------------------------------------------------------------------
+# perf ledger: per-family expected/achieved records appended per config
+# run, so a regression the --gate machinery DETECTS gets ATTRIBUTED to
+# an executable family by tools/perf_ledger.py (which diffs the latest
+# record against the ledger history).
+# ---------------------------------------------------------------------------
+def _git_rev():
+    import subprocess
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"], cwd=root,
+            capture_output=True, text=True, check=True).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "diff", "--quiet", "HEAD"], cwd=root,
+            capture_output=True).returncode != 0
+        return sha + ("+dirty" if dirty else "")
+    except Exception:
+        return "unknown"
+
+
+def _append_perf_ledger(path, name, result):
+    """One JSONL record: this config window's per-family
+    expected/achieved summary (observability.perf.family_records —
+    reset per config by obs.reset()) plus the headline number it rode
+    with. Configs that compiled/ran no instrumented family (lint,
+    --no-obs runs) append nothing."""
+    import jax
+    from paddle_tpu.observability import perf
+    fams = perf.family_records()
+    if not fams:
+        return None
+    dev = jax.devices()[0]
+    rec = {
+        "rev": _git_rev(), "config": name,
+        "ts": round(time.time(), 3),
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+        "metric": result.get("metric"), "value": result.get("value"),
+        "vs_baseline": result.get("vs_baseline"),
+        "families": fams,
+    }
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return path
+
+
 def _run_gate(config, rev, windows, tol):
     """Interleaved prev-rev vs current-rev A/B: two persistent window
     servers (one per revision, each with its own compiled state), N
@@ -1141,6 +1202,15 @@ def main():
                     help="interleaved windows per side for --gate")
     ap.add_argument("--gate-tol", type=float, default=0.08,
                     help="--gate fails when cur/prev < 1 - tol")
+    ap.add_argument("--ledger",
+                    default=os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "perf_ledger.jsonl"),
+                    help="perf-ledger JSONL to append per-family "
+                         "expected/achieved records to (see "
+                         "tools/perf_ledger.py)")
+    ap.add_argument("--no-ledger", action="store_true",
+                    help="skip the perf-ledger append")
     ap.add_argument("--window-server", action="store_true",
                     help=argparse.SUPPRESS)   # internal: --gate child
     args = ap.parse_args()
@@ -1169,6 +1239,8 @@ def main():
                                        args.gate_windows, args.gate_tol)
         if not args.no_obs:
             result["obs"] = obs.summary()
+            if not args.no_ledger:
+                _append_perf_ledger(args.ledger, name, result)
             obs.disable()
         print(json.dumps(result), flush=True)
 

@@ -11,12 +11,16 @@ eagerly on TPU via XLA.
 """
 from __future__ import annotations
 
+import time
 import weakref
 from collections import defaultdict, deque
 from typing import Any, List, Optional, Sequence
 
 import jax
 import numpy as np
+
+from ..observability import metrics as _om
+from ..observability import perf as _pf
 
 # --------------------------------------------------------------------------
 # global tape state (analog of eager's tracer_has_grad)
@@ -384,6 +388,13 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         pending = dict(consumers)
         queue = deque(n for nid, n in node_by_id.items()
                       if pending.get(nid, 0) == 0)
+        # dispatch-gap profiler: the host time between consecutive
+        # grad-node dispatches (queue bookkeeping, cotangent
+        # accumulation, hook firing) is exactly the per-node overhead
+        # behind the eager-over-TrainStep ratio (ROADMAP item 4);
+        # each gap is attributed to the op about to be dispatched.
+        # Disabled cost: one module-flag check per node.
+        last_dispatch = None
         while queue:
             node = queue.popleft()
             slots = cot.get(id(node))
@@ -410,10 +421,16 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                         _apply_leaf_grad(t, cots[i], create_graph)
             # dispatch always builds vjp over a flat-tuple-output function,
             # so the cotangent argument is always a tuple
+            if _om._ENABLED:
+                now = time.perf_counter()
+                if last_dispatch is not None:
+                    _pf.note_dispatch_gap(now - last_dispatch, node.name)
             if create_graph:
                 in_cots = _replay_vjp(node, cots)
             else:
                 in_cots = node.vjp_fn(tuple(cots))
+            if _om._ENABLED:
+                last_dispatch = time.perf_counter()
             if not isinstance(in_cots, (tuple, list)):
                 in_cots = (in_cots,)
             assert len(in_cots) == len(node.edges), (

@@ -64,6 +64,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..observability import flight as _fl
 from ..observability import metrics as _om
+from ..observability import perf as _pf
 from ..observability import tracing as _ot
 from ..resilience import faults
 from .paged_cache import PagedKVCache
@@ -183,37 +184,13 @@ def _metrics():
     return _METRICS
 
 
-class _CompileTimed:
-    """First-call timing shim around a freshly built jit executable:
-    jax traces+compiles synchronously on the first invocation, so that
-    call's wall time IS the compile cost (one async-dispatched
-    execution rides along). Records compile count + wall time by
-    executable family, once; afterwards the shim is one attribute
-    check per call."""
-
-    __slots__ = ("fn", "family", "pending")
-
-    def __init__(self, fn, family: str):
-        self.fn = fn
-        self.family = family
-        self.pending = True
-
-    def __call__(self, *args):
-        if not self.pending:
-            return self.fn(*args)
-        t0 = time.perf_counter()
-        out = self.fn(*args)
-        # cleared only on success: a first call that raises (watchdog,
-        # injected fault) leaves the compile un-recorded, and the
-        # retry — which pays the compile again or hits jax's cache —
-        # records it instead of losing the count
-        self.pending = False
-        if _om._ENABLED:
-            m = _metrics()
-            m["compiles"].labels(family=self.family).inc()
-            m["compile_time"].labels(family=self.family).observe(
-                time.perf_counter() - t0)
-        return out
+# first-call compile shim: timing + cost-model telemetry by executable
+# family. Grown from the engine-local PR 4 class into the shared
+# observability.perf.CompileTimed (TrainStep uses the same shim) —
+# the first call goes through the AOT path so the compiled executable
+# yields its cost_analysis()/memory_analysis() expectation, carried on
+# `.expected` for the roofline accounting at the launch sites.
+_CompileTimed = _pf.CompileTimed
 
 
 class _EngineStats(dict):
@@ -1130,6 +1107,7 @@ class LLMEngine:
             spans[b] = (c, m)
             c += m
         fn, impl = self._ragged_fn(tb, with_pool, all_pos)
+        compiling = fn.pending          # first call pays the compile
         kcs, vcs = self.cache.key_caches, self.cache.value_caches
         self._key, sub = jax.random.split(self._key)
         t0 = time.perf_counter()
@@ -1149,6 +1127,13 @@ class LLMEngine:
         self.stats["ragged_launches"] += 1
         if _om._ENABLED:
             _metrics()["ragged"].observe(t1 - t0)
+            if not compiling:
+                # roofline: the launch is blocking-timed (the
+                # block_until_ready above), so latency x the
+                # executable's recorded cost model is an honest
+                # achieved-rate read; a compiling first call is not
+                _pf.observe_roofline("engine_ragged", t1 - t0,
+                                     fn.expected)
         nxt = np.asarray(nxt)
         if all_pos:
             return {b: nxt[cc:cc + m] for b, (cc, m) in spans.items()}
@@ -1395,13 +1380,20 @@ class LLMEngine:
             off[b, pages] = np.arange(len(pages), dtype=np.int32) \
                 * self.block_size
         fn = self._decode_fn(chunk)
+        compiling = fn.pending          # first call pays the compile
         kcs, vcs = self.cache.key_caches, self.cache.value_caches
         self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
         with self._step_watchdog("engine decode chunk"):
             kcs, vcs, toks = fn([t._data for t in self._tensors], kcs, vcs,
                                 jnp.asarray(cur), jnp.asarray(lens),
                                 jnp.asarray(tbl), jnp.asarray(off), sub)
             toks = jax.block_until_ready(toks)
+        if _om._ENABLED and not compiling:
+            # blocking-timed executable call (host prep excluded):
+            # latency x the recorded cost model -> achieved-vs-peak
+            _pf.observe_roofline("engine_decode",
+                                 time.perf_counter() - t0, fn.expected)
         for i in range(self.cache.num_layers):
             self.cache.update(i, kcs[i], vcs[i])
         toks = np.asarray(toks)

@@ -15,6 +15,7 @@ whole-graph CINN compile analog).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -23,6 +24,8 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..core.generator import rng_scope, next_key
 from ..nn.layer import Layer
+from ..observability import metrics as _om
+from ..observability import perf as _pf
 from ..ops.registry import OpDef
 from ..ops import registry as _op_registry
 from ..autograd import tape
@@ -395,6 +398,7 @@ class TrainStep:
         self._step_fn = self._build(donate)
         self._rng = jax.random.PRNGKey(0)
         self._step_count = 0
+        self._last_step_t = None    # roofline: previous call entry
 
     def _build(self, donate):
         model = self.model
@@ -446,7 +450,11 @@ class TrainStep:
             return loss, new_params, new_opt_states
 
         donate_argnums = (0, 1) if donate else ()
-        return jax.jit(step, donate_argnums=donate_argnums)
+        # CompileTimed: the train step joins the process-wide compile
+        # telemetry (family "train_step") and records its cost-model
+        # expectation for the roofline accounting in __call__
+        return _pf.CompileTimed(
+            jax.jit(step, donate_argnums=donate_argnums), "train_step")
 
     def __call__(self, *args, **kwargs):
         args = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
@@ -458,6 +466,19 @@ class TrainStep:
         step_id = self._step_count
         seed = jax.random.fold_in(self._rng, step_id)
         self._step_count += 1
+        if _om._ENABLED:
+            # roofline accounting: the train loop's steady-state step
+            # latency is the period BETWEEN call entries — with donated
+            # buffers each dispatch consumes the previous step's
+            # outputs, so once XLA's bounded async queue fills, the
+            # enqueue cadence tracks device step time. The first two
+            # steps (compile + queue fill) are skipped.
+            now = time.perf_counter()
+            if self._last_step_t is not None and step_id >= 2:
+                _pf.observe_roofline("train_step",
+                                     now - self._last_step_t,
+                                     self._step_fn.expected)
+            self._last_step_t = now
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         from ..utils.watchdog import watchdog
         with watchdog(what=f"TrainStep step {step_id}") as wd:

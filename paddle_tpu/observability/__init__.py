@@ -47,7 +47,7 @@ boundaries (the DataLoader does this automatically for its workers,
 shipping trace events alongside)."""
 from __future__ import annotations
 
-from . import flight, metrics, slo, tracing  # noqa: F401
+from . import flight, metrics, perf, slo, tracing  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, registry,
     DEFAULT_BUCKETS,
@@ -63,7 +63,7 @@ __all__ = [
     "reset", "to_prometheus", "to_json", "span", "current_trace",
     "trace_context", "trace_events", "trace_clear",
     "export_chrome_trace", "export_jsonl", "summary",
-    "metrics", "tracing", "slo", "flight", "SLO",
+    "metrics", "tracing", "slo", "flight", "perf", "SLO",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_BUCKETS",
 ]
@@ -97,9 +97,12 @@ def reset() -> None:
     every buffered trace event — the two stores move together so a
     fresh measurement window never mixes old spans with new counters
     (pinned by test_reset_clears_metrics_and_trace_ring). Use
-    `trace_clear()` for the narrow ring-only clear."""
+    `trace_clear()` for the narrow ring-only clear. The perf-ledger
+    window accumulators move with it (each bench config's ledger
+    record covers exactly its own window)."""
     registry().reset()
     tracing.clear()
+    perf.reset_window()
 
 
 def to_prometheus() -> str:

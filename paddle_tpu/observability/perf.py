@@ -1,0 +1,444 @@
+"""Performance observability: XLA cost-model telemetry, roofline
+accounting and the dispatch-gap profiler (see README "Performance
+observability").
+
+ROADMAP item 4 names two measured ceilings (flash fwd at ~1/8.6 of
+matmul efficiency, eager/TrainStep dispatch at 1.74 vs the <=1.5
+target) but until this module the repo had no STANDING instrumentation
+saying where a step's time goes relative to what the hardware allows:
+`cost_analysis()` was called ad hoc in tools and thrown away. Three
+sub-surfaces, all near-zero when observability is disabled:
+
+* **Cost-model telemetry.** `read_cost_model(compiled)` is the ONE
+  reader over XLA's `cost_analysis()` / `memory_analysis()` (tools and
+  bench call it instead of re-parsing the dict shapes). Every compile
+  that goes through `CompileTimed` (engine ragged/decode executables,
+  the TrainStep) or the fused optimizer's AOT path records its
+  expected work as gauges, keyed by the same compile families the
+  PR 4 compile counters use:
+  `paddle_tpu_executable_flops{family=}` and
+  `paddle_tpu_executable_bytes{family=,kind=accessed|output|temp|
+  argument}` (the most recently compiled executable of the family —
+  gauge semantics; per-executable expectations stay on the
+  `CompileTimed.expected` handles for tools).
+
+* **Roofline accounting.** `observe_roofline(family, seconds, cost)`
+  turns a measured launch/step latency plus the recorded cost model
+  into achieved flops/s and bytes/s and publishes them against the
+  device peaks as `paddle_tpu_roofline_utilization{family=,
+  bound=hbm|flops}`. Peaks come from the per-chip spec tables below
+  (shared with bench.py); an UNKNOWN device (the CPU test box) gets NO
+  roofline series — an honest absence beats a made-up denominator.
+  Spec peaks are the denominator by convention; BENCH_EXTRA r5
+  measured the shared chip's EFFECTIVE bandwidth at 233-314 GB/s vs
+  the 819 GB/s v5e spec in degraded windows (`VALIDATED_BW_WINDOW`),
+  so a utilization read taken in such a window understates the kernel
+  — `set_device_peaks()` lets a session that has measured its own
+  window pin the denominator it validated.
+
+* **Dispatch-gap profiler.** The eager autograd engine
+  (`autograd.tape.run_backward`) reports the host-side gap between
+  consecutive grad-node dispatches into
+  `paddle_tpu_dispatch_gap_seconds` (fine sub-millisecond buckets)
+  and attributes each gap to the op type about to be dispatched via
+  `paddle_tpu_dispatch_gap_op_seconds_total{op=}` — so the 1.74
+  eager-over-TrainStep ratio decomposes into NAMED host gaps before
+  anyone tries to batch them. Single flag check per node when
+  observability is off.
+
+Per-family run accumulators (`family_records()`) feed the perf ledger:
+`bench.py` appends expected/achieved records per family to
+`perf_ledger.jsonl` and `tools/perf_ledger.py` diffs runs against the
+ledger history, so a regression the round-over-round gate detects gets
+ATTRIBUTED to a family. `reset_window()` clears the accumulators (the
+top-level `obs.reset()` calls it) so each bench config reports its own
+window.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional, Tuple
+
+from . import metrics as _m
+
+__all__ = [
+    "CostModel", "read_cost_model", "CompileTimed", "record_compile",
+    "observe_roofline", "note_dispatch_gap", "family_records",
+    "reset_window", "device_peaks", "set_device_peaks", "lookup",
+    "PEAK_BF16_FLOPS", "HBM_BYTES_PER_SEC", "VALIDATED_BW_WINDOW",
+    "DISPATCH_GAP_BUCKETS",
+]
+
+# ---------------------------------------------------------------------------
+# device peaks (single source of truth — bench.py wraps these with its
+# historical v5e defaults; the roofline gauges use them STRICTLY: an
+# unmatched device kind publishes no series)
+# ---------------------------------------------------------------------------
+PEAK_BF16_FLOPS = {
+    # per-chip peak bf16 FLOP/s
+    "v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12, "v4": 275e12,
+    "v3": 123e12, "v6e": 918e12,
+}
+
+HBM_BYTES_PER_SEC = {
+    # per-chip HBM bandwidth (spec)
+    "v5e": 819e9, "v5litepod": 819e9, "v5p": 2765e9, "v4": 1228e9,
+    "v3": 900e9, "v6e": 1640e9,
+}
+
+# measured EFFECTIVE bandwidth window on the shared v5e (BENCH_EXTRA
+# round-5 methodology findings): the spec denominator overstates what a
+# degraded window can deliver — surfaced by tools/perf_ledger.py next
+# to utilization numbers so low reads get interpreted honestly
+VALIDATED_BW_WINDOW = {
+    "v5e": (233e9, 314e9), "v5litepod": (233e9, 314e9),
+}
+
+
+def lookup(device, table: dict, default=None):
+    """Substring match of the device kind against a peak table (the
+    bench.py `_device_lookup` convention, shared)."""
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return default
+
+
+# operator/test override: (peak_flops, peak_bytes_per_sec) or None
+_PEAK_OVERRIDE: Optional[Tuple[float, float]] = None
+
+
+def set_device_peaks(flops: Optional[float] = None,
+                     bytes_per_sec: Optional[float] = None) -> None:
+    """Pin the roofline denominators explicitly — for tests on the CPU
+    box (which otherwise publishes no roofline series) and for sessions
+    that measured their own validated-bandwidth window (BENCH_EXTRA:
+    the shared chip's effective BW runs well under spec in degraded
+    windows). Call with no arguments to clear the override."""
+    global _PEAK_OVERRIDE
+    if flops is None and bytes_per_sec is None:
+        _PEAK_OVERRIDE = None
+    else:
+        _PEAK_OVERRIDE = (float(flops or 0.0), float(bytes_per_sec or 0.0))
+
+
+def device_peaks(device=None) -> Optional[Tuple[float, float]]:
+    """(peak_flops, peak_bytes_per_sec) for the backend device, or None
+    when the device kind matches no table entry (CPU test boxes,
+    unknown accelerators) — the roofline gauges publish NOTHING rather
+    than a utilization against a made-up denominator."""
+    if _PEAK_OVERRIDE is not None:
+        return _PEAK_OVERRIDE
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    flops = lookup(device, PEAK_BF16_FLOPS)
+    bw = lookup(device, HBM_BYTES_PER_SEC)
+    if flops is None or bw is None:
+        return None
+    return (flops, bw)
+
+
+# ---------------------------------------------------------------------------
+# cost-model reader (the ONE place the cost_analysis()/memory_analysis()
+# dict shapes are known)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class CostModel:
+    """XLA's static expectation for one compiled executable: total
+    FLOPs and HBM bytes accessed from `cost_analysis()`, buffer-class
+    byte sizes from `memory_analysis()` (0.0 where a backend reports
+    nothing)."""
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    bytes_output: float = 0.0
+    bytes_argument: float = 0.0
+    bytes_temp: float = 0.0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def read_cost_model(compiled) -> Optional[CostModel]:
+    """Read a `jax.stages.Compiled` (or anything with the same
+    `cost_analysis`/`memory_analysis` surface) into a CostModel.
+    Returns None when the backend reports no cost analysis at all —
+    callers treat that as "no expectation recorded", never as zero
+    work."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not ca:
+        return None
+    flops = float(ca.get("flops", 0.0))
+    accessed = float(ca.get("bytes accessed", 0.0))
+    out = arg = temp = 0.0
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        out = float(getattr(ma, "output_size_in_bytes", 0) or 0)
+        arg = float(getattr(ma, "argument_size_in_bytes", 0) or 0)
+        temp = float(getattr(ma, "temp_size_in_bytes", 0) or 0)
+    return CostModel(flops=flops, bytes_accessed=accessed,
+                     bytes_output=out, bytes_argument=arg,
+                     bytes_temp=temp)
+
+
+# ---------------------------------------------------------------------------
+# metric handles (created once; the disabled path through every
+# recorder below is a single module-flag check)
+# ---------------------------------------------------------------------------
+# dispatch gaps are host-side tens-of-µs to low-ms events: the default
+# latency buckets start at 500 µs and would flatten the distribution
+# the profiler exists to resolve
+DISPATCH_GAP_BUCKETS = (
+    10e-6, 25e-6, 50e-6, 100e-6, 250e-6, 500e-6,
+    1e-3, 2.5e-3, 5e-3, 10e-3, 25e-3,
+)
+
+_METRICS = None
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        r = _m.registry()
+        _METRICS = {
+            "flops": r.gauge(
+                "paddle_tpu_executable_flops",
+                "XLA cost-model expected FLOPs of the family's most "
+                "recently compiled executable (per-executable "
+                "expectations live on the CompileTimed handles)",
+                ("family",)),
+            "bytes": r.gauge(
+                "paddle_tpu_executable_bytes",
+                "XLA cost/memory-model byte expectations of the "
+                "family's most recently compiled executable: accessed "
+                "= cost-model HBM traffic, output/temp/argument = "
+                "buffer-class sizes from memory_analysis()",
+                ("family", "kind")),
+            "roofline": r.gauge(
+                "paddle_tpu_roofline_utilization",
+                "achieved fraction of the device peak over the last "
+                "measured launch/step of the family: bound=hbm is "
+                "expected-bytes/latency over peak HBM bandwidth, "
+                "bound=flops is expected-flops/latency over peak "
+                "bf16 FLOP/s (spec peaks; unknown devices publish "
+                "no series)",
+                ("family", "bound")),
+            "gap": r.histogram(
+                "paddle_tpu_dispatch_gap_seconds",
+                "host-side gap between consecutive grad-node "
+                "dispatches in the eager backward engine (queue "
+                "bookkeeping, cotangent accumulation, hook firing "
+                "between device launches)",
+                buckets=DISPATCH_GAP_BUCKETS),
+            "gap_op": r.counter(
+                "paddle_tpu_dispatch_gap_op_seconds_total",
+                "cumulative dispatch-gap seconds attributed to the "
+                "grad-node op type about to be dispatched",
+                ("op",)),
+        }
+    return _METRICS
+
+
+# ---------------------------------------------------------------------------
+# per-family window accumulators (the perf-ledger source). Keyed by
+# compile family; reset per measurement window via reset_window()
+# (obs.reset() calls it).
+# ---------------------------------------------------------------------------
+_FAMILY_COST: Dict[str, CostModel] = {}     # last compile's expectation
+_FAMILY_RUNS: Dict[str, dict] = {}          # this window's executions
+
+
+def _family_slot(family: str) -> dict:
+    slot = _FAMILY_RUNS.get(family)
+    if slot is None:
+        slot = _FAMILY_RUNS[family] = {
+            "runs": 0, "seconds": 0.0, "flops": 0.0, "bytes": 0.0,
+            "compiles": 0}
+    return slot
+
+
+def reset_window() -> None:
+    """Drop this window's per-family run/compile accumulators (the
+    recorded per-family cost models survive — they describe live
+    executables, not a measurement window)."""
+    _FAMILY_RUNS.clear()
+
+
+def record_compile(family: str, compiled) -> Optional[CostModel]:
+    """Read a freshly compiled executable's cost model, remember it for
+    the family, and (when observability is enabled) publish the
+    executable gauges. The read happens even while disabled: it is a
+    one-shot at compile time and tools (profile_engine's per-entry
+    columns) want the expectation regardless of metric recording."""
+    cm = read_cost_model(compiled)
+    if cm is None:
+        return None
+    _FAMILY_COST[family] = cm
+    if _m._ENABLED:
+        m = _metrics()
+        m["flops"].labels(family=family).set(cm.flops)
+        b = m["bytes"]
+        b.labels(family=family, kind="accessed").set(cm.bytes_accessed)
+        b.labels(family=family, kind="output").set(cm.bytes_output)
+        b.labels(family=family, kind="argument").set(cm.bytes_argument)
+        b.labels(family=family, kind="temp").set(cm.bytes_temp)
+        _family_slot(family)["compiles"] += 1
+    return cm
+
+
+def observe_roofline(family: str, seconds: float,
+                     cost: Optional[CostModel]) -> None:
+    """Publish achieved-vs-peak utilization for one measured execution
+    (a blocking-timed engine launch, a steady-state train step) and
+    accumulate the window's per-family achieved record. No-op while
+    observability is disabled; the roofline gauges additionally demand
+    a KNOWN device peak (see device_peaks)."""
+    if not _m._ENABLED or cost is None or seconds <= 0.0:
+        return
+    slot = _family_slot(family)
+    slot["runs"] += 1
+    slot["seconds"] += seconds
+    slot["flops"] += cost.flops
+    slot["bytes"] += cost.bytes_accessed
+    peaks = device_peaks()
+    if peaks is None:
+        return
+    peak_flops, peak_bw = peaks
+    m = _metrics()["roofline"]
+    if peak_bw > 0:
+        m.labels(family=family, bound="hbm").set(
+            cost.bytes_accessed / seconds / peak_bw)
+    if peak_flops > 0:
+        m.labels(family=family, bound="flops").set(
+            cost.flops / seconds / peak_flops)
+
+
+def note_dispatch_gap(seconds: float, op: str) -> None:
+    """One host-side inter-dispatch gap from the eager backward engine.
+    Callers (autograd.tape) guard on the metrics flag, so this is never
+    reached while disabled — the body records unconditionally."""
+    m = _metrics()
+    m["gap"].observe(seconds)
+    m["gap_op"].labels(op=op).inc(seconds)
+
+
+def family_records() -> Dict[str, dict]:
+    """This window's per-family expected/achieved summary — the
+    perf-ledger record bench.py appends per config. Families appear
+    once they compiled or executed in the window; achieved rates need
+    at least one timed run (expected-only families — e.g. the fused
+    optimizer, whose launch is async-dispatched and never blocked on —
+    report null achieved honestly)."""
+    out = {}
+    peaks = device_peaks()
+    for family, slot in sorted(_FAMILY_RUNS.items()):
+        cm = _FAMILY_COST.get(family)
+        rec = {
+            "runs": slot["runs"],
+            "compiles": slot["compiles"],
+            "seconds": round(slot["seconds"], 6),
+            "expected": cm.as_dict() if cm is not None else None,
+            "achieved_flops_per_s": None,
+            "achieved_bytes_per_s": None,
+            "utilization_hbm": None,
+            "utilization_flops": None,
+        }
+        if slot["runs"] and slot["seconds"] > 0:
+            fps = slot["flops"] / slot["seconds"]
+            bps = slot["bytes"] / slot["seconds"]
+            rec["achieved_flops_per_s"] = round(fps, 1)
+            rec["achieved_bytes_per_s"] = round(bps, 1)
+            if peaks is not None:
+                peak_flops, peak_bw = peaks
+                if peak_flops > 0:
+                    rec["utilization_flops"] = round(fps / peak_flops, 6)
+                if peak_bw > 0:
+                    rec["utilization_hbm"] = round(bps / peak_bw, 6)
+        out[family] = rec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# first-call compile shim (grew out of the llm_engine-local
+# _CompileTimed; now shared by the engine executables and TrainStep)
+# ---------------------------------------------------------------------------
+class CompileTimed:
+    """First-call timing shim around a freshly built jit function.
+
+    The first call goes through the AOT path (`lower(...).compile()`)
+    so the compiled executable is IN HAND for cost-model telemetry —
+    the wall time of lower+compile+first execution is recorded as the
+    family's compile cost (the same quantity the old first-call shim
+    measured: jax traced+compiled synchronously inside that call), and
+    `record_compile` reads `cost_analysis()`/`memory_analysis()` into
+    the executable gauges. Afterwards calls go straight to the compiled
+    executable; `expected` carries the CostModel for roofline
+    accounting at the call sites.
+
+    Degradation contract: if AOT lowering/compiling raises (an exotic
+    backend, a sharding the AOT path rejects) the shim falls back to
+    plain jit dispatch — compile count/time still recorded, no cost
+    model (`expected` stays None, roofline stays silent). If a LATER
+    call hits the compiled executable with a different input signature
+    (jit would retrace; AOT raises TypeError before any donation is
+    consumed), the shim permanently reverts to the polymorphic jit
+    function — correctness first, telemetry only for the signatures it
+    saw first."""
+
+    __slots__ = ("fn", "jit_fn", "family", "pending", "expected")
+
+    def __init__(self, fn, family: str):
+        self.fn = fn
+        self.jit_fn = fn
+        self.family = family
+        self.pending = True
+        self.expected: Optional[CostModel] = None
+
+    def __call__(self, *args):
+        if not self.pending:
+            if self.fn is self.jit_fn:
+                return self.fn(*args)
+            try:
+                return self.fn(*args)
+            except TypeError:
+                # new input signature: AOT executables are monomorphic.
+                # The mismatch is detected before donation consumes any
+                # buffer, so re-dispatching through jit is safe — and if
+                # the TypeError was real, jit raises it again. The
+                # recorded cost model described the FIRST signature
+                # only: drop it so roofline/ledger reads go silent
+                # instead of silently wrong for the new shapes.
+                self.fn = self.jit_fn
+                self.expected = None
+                return self.fn(*args)
+        t0 = time.perf_counter()
+        compiled = None
+        try:
+            compiled = self.jit_fn.lower(*args).compile()
+        except Exception:
+            compiled = None     # fall back to plain jit dispatch
+        out = (compiled if compiled is not None else self.jit_fn)(*args)
+        # cleared only on success: a first call that raises (watchdog,
+        # injected fault) leaves the compile un-recorded, and the
+        # retry — which pays the compile again or hits jax's cache —
+        # records it instead of losing the count
+        self.pending = False
+        if compiled is not None:
+            self.fn = compiled
+            self.expected = record_compile(self.family, compiled)
+        if _m._ENABLED:
+            c, h = _m.compile_metrics()
+            c.labels(family=self.family).inc()
+            h.labels(family=self.family).observe(
+                time.perf_counter() - t0)
+        return out

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..autograd import no_grad
 from ..observability import metrics as _om
+from ..observability import perf as _pf
 from .lr import LRScheduler
 
 _FUSED_COUNTER = None
@@ -355,6 +356,12 @@ class Optimizer:
                     _fused_counter("fallback")
                 return False
             cache[key] = entry
+            # the AOT path has the compiled executable in hand — record
+            # its cost-model expectation (executable flops/bytes
+            # gauges, family optimizer_fused). The fused launch itself
+            # is async-dispatched and never blocked on, so the family
+            # reports expected-only: no per-launch roofline here
+            _pf.record_compile("optimizer_fused", entry)
             if _om._ENABLED:
                 _fused_counter("compile")
                 _fused_compile_time(_time.perf_counter() - t_compile)

@@ -627,3 +627,16 @@ class TestCompileFamilyBudget:
         ct = _series("paddle_tpu_compile_seconds")
         assert sum(v["count"] for (fam,), v in ct.items()
                    if fam.startswith("engine")) == engine_compiles
+        # cost-model telemetry rides the same families: one expected-
+        # flops gauge row per live family, no orphan families (a gauge
+        # family that never compiled would be a telemetry path the
+        # budget above cannot see)
+        fl = _series("paddle_tpu_executable_flops")
+        fl_fams = {fam for (fam,), v in fl.items() if v}
+        assert fl_fams == fams, (fl_fams, fams)
+        by = _series("paddle_tpu_executable_bytes")
+        for fam in fams:
+            assert by[(fam, "accessed")] > 0
+            for kind in ("output", "temp", "argument"):
+                assert (fam, kind) in by
+        assert {fam for (fam, _k), v in by.items() if v} == fams

@@ -64,7 +64,13 @@ def _counter_sum(doc, name, **labels):
     return total
 
 
-def _hist_quantiles(doc, name, qs=(0.5, 0.95)):
+def _hist_quantiles(doc, name, qs=(0.5, 0.95), prev=None):
+    """Percentile estimates for a histogram's unlabeled series. With
+    `prev` (the previous frame's doc), quantiles come from the
+    BETWEEN-FRAMES bucket delta — the live read for high-rate
+    histograms like the dispatch-gap profile, where the cumulative
+    distribution would bury the last few seconds. Falls back to the
+    cumulative series when the delta is empty (idle between frames)."""
     rec = doc.get(name)
     if not rec or rec.get("kind") != "histogram":
         return None
@@ -72,13 +78,27 @@ def _hist_quantiles(doc, name, qs=(0.5, 0.95)):
         if s["labels"]:
             continue
         v = s["value"]
-        if not v["count"]:
+        counts, lo, hi = v["buckets"], v["min"], v["max"]
+        if prev is not None:
+            for ps in (prev.get(name) or {}).get("series", []):
+                if ps["labels"]:
+                    continue
+                dl = [c - p for c, p in zip(counts,
+                                            ps["value"]["buckets"])]
+                if sum(dl) > 0:
+                    # window extrema are unknowable from two
+                    # cumulative frames; the bucket grid bounds the
+                    # estimate instead
+                    counts, lo, hi = dl, None, None
+                break
+        n = sum(counts)
+        if not n:
             return None
         return {
-            "count": v["count"],
+            "count": n,
             **{f"p{int(q * 100)}": quantile_from_buckets(
-                rec["buckets"], v["buckets"], q,
-                lo=v["min"], hi=v["max"]) for q in qs},
+                rec["buckets"], counts, q, lo=lo, hi=hi)
+               for q in qs},
         }
     return None
 
@@ -162,6 +182,25 @@ def render(doc, prev=None, dt=None) -> str:
     if br:
         lines.append("  SLO breaches " + "  ".join(
             f"{s['labels']['slo']}={int(s['value'])}" for s in br))
+
+    # roofline: achieved-vs-peak per executable family (published only
+    # on devices with known peaks) + the dispatch-gap profile of the
+    # eager backward engine (p95 between frames when watching live)
+    roof = {}
+    for s in _series(doc, "paddle_tpu_roofline_utilization"):
+        if s["value"]:
+            roof.setdefault(s["labels"]["family"], {})[
+                s["labels"]["bound"]] = s["value"]
+    gap = _hist_quantiles(doc, "paddle_tpu_dispatch_gap_seconds",
+                          prev=prev)
+    if roof or gap:
+        lines.append("== roofline ==")
+        for fam, bounds in sorted(roof.items()):
+            lines.append(f"  {fam:<16} " + "  ".join(
+                f"{b}={bounds[b]:6.1%}" for b in sorted(bounds)))
+        if gap:
+            lines.append(f"  dispatch gap   p50={_ms(gap['p50'])}  "
+                         f"p95={_ms(gap['p95'])}  n={gap['count']}")
 
     comp = _series(doc, "paddle_tpu_compile_total")
     if comp:

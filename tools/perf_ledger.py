@@ -1,0 +1,186 @@
+"""Perf-ledger trajectory and regression ATTRIBUTION over the
+per-family expected/achieved records `bench.py` appends to
+`perf_ledger.jsonl` (observability.perf.family_records, one record per
+config run).
+
+The round-over-round gate (`bench.py --gate`) answers "did throughput
+regress"; this tool answers "WHICH executable family regressed": it
+diffs the latest record per config against the ledger history,
+comparing each family's achieved bytes/s (the HBM-bound side — every
+hot path in this repo is bandwidth-dominated, see BENCH_EXTRA).
+
+    python tools/perf_ledger.py                  # trajectory table
+    python tools/perf_ledger.py --check          # diff latest vs history
+    python tools/perf_ledger.py --check --tol 0.2 --config decode_paged
+
+`--check` verdict rules (printed as one JSON line, exit 0/1):
+  * a family whose achieved rate dropped below (1 - tol) x the best
+    PRIOR-REVISION record for the same config FAILS and names the
+    family — the attribution the gate cannot give;
+  * prior records from the SAME revision only report the ratio (two
+    runs of one revision differ by box noise, not by code — the
+    interleaved-window gate is the honest same-code comparator, cf.
+    the BENCH_EXTRA methodology findings), so a ledger written
+    entirely by the current revision is self-consistent and passes;
+  * a family present in every prior record of a config but MISSING
+    from the latest fails (an instrumented path silently stopped
+    running — the regression observability itself would otherwise
+    hide).
+
+Records keep absolute achieved rates, so cross-revision diffs carry
+the same box-noise caveat as any non-interleaved comparison — the
+verdict names suspects for the gate to re-measure, it does not replace
+the gate."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def default_ledger_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "perf_ledger.jsonl")
+
+
+def load(path: str):
+    """[(lineno, record)] in file order; malformed lines are counted,
+    not fatal (a crashed bench append must not wedge the tool)."""
+    records, bad = [], 0
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                if isinstance(rec, dict) and "families" in rec:
+                    records.append((i, rec))
+                else:
+                    bad += 1
+            except ValueError:
+                bad += 1
+    return records, bad
+
+
+def _achieved(fam_rec) -> float:
+    v = fam_rec.get("achieved_bytes_per_s")
+    return float(v) if v else 0.0
+
+
+def check(records, tol: float, only_config=None) -> dict:
+    """Diff the LATEST record per config against that config's ledger
+    history. Returns the verdict dict (see module docstring)."""
+    by_config = {}
+    for _ln, rec in records:
+        by_config.setdefault(rec.get("config", "?"), []).append(rec)
+    verdict = {"pass": True, "tol": tol, "configs": {}}
+    for config, recs in sorted(by_config.items()):
+        if only_config and config != only_config:
+            continue
+        latest = recs[-1]
+        # baselines must share the latest record's DEVICE: achieved
+        # rates are absolute, and a v5e record is not a regression
+        # baseline for a CPU smoke run of the same config
+        history = [r for r in recs[:-1]
+                   if r.get("device") == latest.get("device")]
+        out = {"rev": latest.get("rev"), "history": len(history),
+               "families": {}, "missing_families": [], "pass": True}
+        for family, fam_rec in sorted(latest["families"].items()):
+            cur = _achieved(fam_rec)
+            fout = {"achieved_bytes_per_s": cur or None,
+                    "ratio_vs_history": None, "baseline_rev": None,
+                    "regressed": False}
+            # baseline: best prior achieved rate, preferring a
+            # DIFFERENT revision (same-rev deltas are box noise)
+            prior = [(_achieved(pf), prev.get("rev"))
+                     for prev in history
+                     for pf in [prev["families"].get(family)]
+                     if pf and _achieved(pf)]
+            other_rev = [p for p in prior if p[1] != latest.get("rev")]
+            best, best_rev = max(other_rev or prior,
+                                 default=(None, None))
+            if best and cur:
+                fout["ratio_vs_history"] = round(cur / best, 4)
+                fout["baseline_rev"] = best_rev
+                if best_rev != latest.get("rev") \
+                        and cur / best < 1.0 - tol:
+                    fout["regressed"] = True
+                    out["pass"] = False
+            out["families"][family] = fout
+        if history:
+            always = set(history[0]["families"])
+            for prev in history[1:]:
+                always &= set(prev["families"])
+            gone = sorted(always - set(latest["families"]))
+            if gone:
+                out["missing_families"] = gone
+                out["pass"] = False
+        verdict["configs"][config] = out
+        verdict["pass"] = verdict["pass"] and out["pass"]
+    if only_config and not verdict["configs"]:
+        verdict["pass"] = False
+        verdict["error"] = f"no ledger records for config {only_config!r}"
+    return verdict
+
+
+def trajectory(records) -> str:
+    """Human table: one line per (record, family) in ledger order."""
+    lines = [f"{'config':<16} {'rev':<19} {'family':<16} "
+             f"{'runs':>5} {'GB/s':>9} {'util_hbm':>9} {'util_flops':>10}"]
+    for _ln, rec in records:
+        for family, f in sorted(rec["families"].items()):
+            bps = f.get("achieved_bytes_per_s")
+            uh, uf = f.get("utilization_hbm"), f.get("utilization_flops")
+            lines.append(
+                f"{rec.get('config', '?'):<16} {rec.get('rev', '?'):<19} "
+                f"{family:<16} {f.get('runs', 0):>5} "
+                f"{'-' if not bps else f'{bps / 1e9:9.3f}':>9} "
+                f"{'-' if uh is None else f'{uh:9.4f}':>9} "
+                f"{'-' if uf is None else f'{uf:10.4f}':>10}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="perf-ledger trajectory / per-family regression "
+                    "attribution")
+    ap.add_argument("--ledger", default=default_ledger_path())
+    ap.add_argument("--check", action="store_true",
+                    help="diff the latest record per config against "
+                         "ledger history; exit 1 on an attributed "
+                         "regression or a disappeared family")
+    ap.add_argument("--config", default=None,
+                    help="restrict --check to one bench config")
+    ap.add_argument("--tol", type=float, default=0.2,
+                    help="--check fails a family below (1 - tol) x its "
+                         "best prior-revision rate")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.ledger):
+        print(json.dumps({"pass": False,
+                          "error": f"no ledger at {args.ledger} — run "
+                                   "bench.py (without --no-ledger) "
+                                   "first"}))
+        return 2
+    records, bad = load(args.ledger)
+    if not records:
+        print(json.dumps({"pass": False, "malformed_lines": bad,
+                          "error": "ledger holds no usable records"}))
+        return 2
+    if args.check:
+        verdict = check(records, args.tol, args.config)
+        if bad:
+            verdict["malformed_lines"] = bad
+        print(json.dumps(verdict, sort_keys=True))
+        return 0 if verdict["pass"] else 1
+    print(trajectory(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

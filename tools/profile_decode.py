@@ -66,11 +66,11 @@ def main():
         fin = jnp.zeros((b,), jnp.bool_)
         buf = jnp.zeros((b, max_len), jnp.int32)
 
-        lowered = loop.lower(params, caches, nxt, pos0, key, fin, buf)
-        compiled = lowered.compile()
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, list) else ca
-        bytes_total = float(ca.get("bytes accessed", 0.0))
+        from paddle_tpu.observability import perf as pperf
+        cm = pperf.read_cost_model(
+            loop.lower(params, caches, nxt, pos0, key, fin, buf)
+            .compile())
+        bytes_total = cm.bytes_accessed if cm else 0.0
         bytes_step = bytes_total / args.steps
 
         # analytic per-step floor: all weights once (bf16) + this

@@ -161,6 +161,23 @@ def main():
     dt = (time.perf_counter() - t0) / 4
     out["raw_chunk_ms"] = round(dt * 1e3, 1)
     out["raw_ms_per_scan_step"] = round(dt / chunk * 1e3, 2)
+
+    # per-executable cost-model expectations: every _fns entry is a
+    # CompileTimed whose first (AOT) call recorded XLA's expected
+    # flops/bytes — the static side of the roofline the timings above
+    # are the measured side of
+    out["fns"] = [
+        {
+            "key": "/".join(str(p) for p in key),
+            "expected_gflops":
+                None if fn.expected is None
+                else round(fn.expected.flops / 1e9, 3),
+            "expected_gb":
+                None if fn.expected is None
+                else round(fn.expected.bytes_accessed / 1e9, 3),
+        }
+        for key, fn in sorted(eng._fns.items(), key=lambda kv: str(kv[0]))
+    ]
     print(json.dumps(out), flush=True)
 
 

@@ -39,10 +39,10 @@ def fwd_bytes(model, x, train):
                                dtype="bfloat16"):
                 return model(xx)._data
 
-    ca = jax.jit(fwd).lower([t._data for t in tensors],
-                            x).compile().cost_analysis()
-    ca = ca[0] if isinstance(ca, list) else ca
-    return float(ca.get("bytes accessed", 0.0))
+    from paddle_tpu.observability import perf as pperf
+    cm = pperf.read_cost_model(
+        jax.jit(fwd).lower([t._data for t in tensors], x).compile())
+    return cm.bytes_accessed if cm else 0.0
 
 
 def main():
