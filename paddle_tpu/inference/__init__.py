@@ -147,3 +147,7 @@ from .llm_engine import (LLMEngine, GenerationResult,  # noqa: E402,F401
 from .speculative import (SpeculativeConfig,  # noqa: E402,F401
                           DraftProposer, NgramProposer,
                           DraftModelProposer)
+# replicated serving: health-checked router over N engine replicas
+# (prefix-cache affinity, failover, circuit breaking, load shedding)
+from .router import (Router, ReplicaSet,  # noqa: E402,F401
+                     ReplicaHandle, ReplicaGone)

@@ -225,7 +225,8 @@ class GenerationResult:
     request_id: object
     prompt_ids: np.ndarray
     output_ids: np.ndarray          # generated tokens (no prompt)
-    finish_reason: str   # "eos" | "length" | "error" | "deadline" | "rejected"
+    finish_reason: str   # "eos" | "length" | "error" | "deadline" |
+                         # "rejected" | "aborted"
     error: Optional[str] = None     # failure detail when not ok
 
     @property
@@ -635,6 +636,7 @@ class LLMEngine:
         self.stats = _EngineStats(
             preemptions=0, prefills=0, decode_chunks=0,
             decode_tokens=0, failed_requests=0, rejected_requests=0,
+            aborted_requests=0,
             deadline_expired=0, prefix_cache_hit_tokens=0,
             prefix_cache_miss_tokens=0, spec_steps=0,
             spec_drafted_tokens=0, spec_accepted_tokens=0,
@@ -684,11 +686,28 @@ class LLMEngine:
             finish_reason="rejected", error=reason))
 
     def add_request(self, request_id, prompt_ids, max_new_tokens: int = 32,
-                    deadline_s: Optional[float] = None):
+                    deadline_s: Optional[float] = None,
+                    obs_carry: Optional[tuple] = None,
+                    prefix_hashes: Optional[list] = None):
         """Queue a request. deadline_s: wall-clock TTL from now — when
         it expires before the request finishes, the request is failed
         with finish_reason="deadline" (evicted mid-decode if running)
-        while other requests keep serving."""
+        while other requests keep serving.
+
+        obs_carry: a (trace_id, root_span, t_enq) triple from an
+        EARLIER life of this request — the serving router re-serves a
+        failed-over request from its original prompt on a surviving
+        replica and passes the original trace identity and first
+        enqueue timestamp here, so the request stays ONE connected
+        trace tree and TTFT/queue-wait/e2e SLO accounting keeps
+        charging the time the dead replica burned.
+
+        prefix_hashes: a precomputed `cache.block_hashes(prompt)`
+        chain for THIS prompt — the router's affinity peek already
+        hashed it once per request, and admission reuses the chain
+        instead of re-hashing (the chain is a pure function of the
+        tokens and the block size, so it is valid on any identically-
+        provisioned replica)."""
         prompt = np.asarray(
             prompt_ids.numpy() if isinstance(prompt_ids, Tensor)
             else prompt_ids, dtype=np.int32).reshape(-1)
@@ -718,14 +737,60 @@ class LLMEngine:
         # one trace per request lifetime (ids only when tracing is on;
         # the timestamps are two perf_counter reads either way — SLO
         # accounting needs them if metrics get enabled mid-flight)
-        trace_id = _ot.new_trace_id() if _ot._ENABLED else None
-        root = _ot.new_span_id() if _ot._ENABLED else None
         t_now = time.perf_counter()
+        if obs_carry is not None:
+            trace_id, root, t_enq = obs_carry
+        else:
+            trace_id = _ot.new_trace_id() if _ot._ENABLED else None
+            root = _ot.new_span_id() if _ot._ENABLED else None
+            t_enq = t_now
         self.waiting.append(_Request(request_id, prompt,
                                      int(max_new_tokens),
                                      deadline=deadline,
+                                     hash_chain=(list(prefix_hashes)
+                                                 if prefix_hashes
+                                                 else None),
                                      trace_id=trace_id, root_span=root,
-                                     t_enq=t_now, t_queued=t_now))
+                                     t_enq=t_enq, t_queued=t_now))
+
+    def abort_request(self, request_id) -> bool:
+        """Cancel a queued or running request: leased pages return to
+        the pool immediately (pages of any full, hash-indexed prefix
+        blocks PARK in the prefix-cache LRU like a normal finish, so
+        the computed KV stays shareable), and the request completes
+        with finish_reason="aborted" on the next step() drain. The
+        serving router uses this to drain a quarantined replica before
+        re-routing its in-flight requests; callers use it for client
+        disconnects. Returns False when the id is not queued or
+        running here (already finished — or never arrived)."""
+        for req in self.waiting:
+            if req.rid == request_id:
+                self.waiting.remove(req)
+                self.stats["aborted_requests"] += 1
+                self._finish_obs(req.rid, "aborted", req.trace_id,
+                                 req.root_span, req.t_enq, req.t_first,
+                                 len(req.resume_out))
+                self._failed.append(GenerationResult(
+                    request_id=req.rid, prompt_ids=req.prompt,
+                    output_ids=np.asarray(req.resume_out, np.int32),
+                    finish_reason="aborted",
+                    error="aborted while queued"))
+                return True
+        for seq in self.slots:
+            if seq is not None and seq.rid == request_id:
+                self.stats["aborted_requests"] += 1
+                self.cache.free_sequence(seq.rid)
+                self.slots[seq.slot] = None
+                self._finish_obs(seq.rid, "aborted", seq.trace_id,
+                                 seq.root_span, seq.t_enq, seq.t_first,
+                                 len(seq.out))
+                self._failed.append(GenerationResult(
+                    request_id=seq.rid, prompt_ids=seq.prompt,
+                    output_ids=np.asarray(seq.out, np.int32),
+                    finish_reason="aborted",
+                    error="aborted mid-generation"))
+                return True
+        return False
 
     @property
     def has_unfinished(self) -> bool:

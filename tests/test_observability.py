@@ -397,6 +397,7 @@ class TestEngineInstrumentation:
         assert eng.stats["prefills"] == 2
         assert eng.stats["decode_tokens"] >= 2
         assert sorted(eng.stats) == [
+            "aborted_requests",
             "deadline_expired", "decode_chunks", "decode_tokens",
             "failed_requests", "preemptions", "prefills",
             "prefix_cache_hit_tokens", "prefix_cache_miss_tokens",

@@ -183,6 +183,37 @@ def render(doc, prev=None, dt=None) -> str:
         lines.append("  SLO breaches " + "  ".join(
             f"{s['labels']['slo']}={int(s['value'])}" for s in br))
 
+    # replicated serving: per-replica health + fleet failover totals
+    # (present only when a Router is running)
+    states = {}
+    for s in _series(doc, "paddle_tpu_router_replica_state"):
+        if s["value"]:
+            states[s["labels"]["replica"]] = s["labels"]["state"]
+    if states:
+        lines.append("== replicas ==")
+        for rep in sorted(states):
+            infl = _value(doc, "paddle_tpu_router_replica_inflight",
+                          replica=rep)
+            lines.append(f"  {rep:<12} {states[rep]:<10} "
+                         f"inflight={int(infl or 0)}")
+        fo = _counter_sum(doc, "paddle_tpu_router_failovers_total")
+        rr = _counter_sum(doc, "paddle_tpu_router_reroutes_total")
+        totals = f"  failovers={int(fo)}  reroutes={int(rr)}"
+        shed = _series(doc, "paddle_tpu_router_shed_total")
+        if any(s["value"] for s in shed):
+            totals += "  shed: " + " ".join(
+                f"{s['labels']['reason']}={int(s['value'])}"
+                for s in shed if s["value"])
+        lines.append(totals)
+        aff = "paddle_tpu_router_affinity_tokens_total"
+        ahit = _counter_sum(doc, aff, outcome="hit")
+        amiss = _counter_sum(doc, aff, outcome="miss")
+        if ahit + amiss:
+            lines.append(
+                f"  affinity     {ahit / (ahit + amiss):6.1%}  "
+                f"({int(ahit)} of {int(ahit + amiss)} routed prompt "
+                "tokens)")
+
     # roofline: achieved-vs-peak per executable family (published only
     # on devices with known peaks) + the dispatch-gap profile of the
     # eager backward engine (p95 between frames when watching live)
