@@ -352,17 +352,40 @@ def _dispatch_gap_summary():
     return out
 
 
+def _dispatch_batch_summary():
+    """paddle_tpu_dispatch_batch_size summary for the BENCH line:
+    dispatch calls, total nodes, mean/max run length. None when the
+    batched engine recorded nothing."""
+    from paddle_tpu import observability as obs
+    if not obs.enabled():
+        return None
+    rec = obs.snapshot().get("paddle_tpu_dispatch_batch_size")
+    val = (rec or {}).get("series", {}).get(())
+    if not val or not val["count"]:
+        return None
+    return {"dispatches": val["count"], "nodes": val["sum"],
+            "mean": round(val["sum"] / val["count"], 2),
+            "max": val["max"]}
+
+
 def bench_dispatch(on_tpu):
-    """Eager op-dispatch latency (VERDICT r2 missing #7 measurement):
-    a small fwd+bwd op chain driven eagerly — per-(op,shape) executable
-    caching in ops.registry.dispatch vs the whole-graph TrainStep.
-    Reports eager steps/s; extra carries the TrainStep ratio (the honest
-    guidance remains: train under TrainStep; eager is for development)
-    plus the dispatch-gap histogram summary (per-grad-node host gaps —
-    the named decomposition of that ratio)."""
+    """Eager dispatch latency with the backward dispatch-mode A/B
+    (ISSUE 10): batched (fused single-consumer runs through
+    autograd.dispatch_queue) vs per_node (the legacy walker) vs the
+    whole-graph TrainStep — interleaved best-of-N windows in ONE
+    session, so the `eager_over_trainstep <= 1.5` claim and the
+    batched-vs-per-node delta are self-verifying. A dedicated
+    attribution pass per mode captures the dispatch-gap summary
+    (count, total, p50/p95, top ops — the NAMED host gaps) and, for
+    batched, the fused-run length histogram; both modes land as
+    separate records in perf_ledger.jsonl (tools/perf_ledger.py
+    --check flags a dispatch-gap regression per (config, mode))."""
     import jax
     import paddle_tpu as pt
+    from paddle_tpu import observability as obs
+    from paddle_tpu.autograd import dispatch_queue as dq
     from paddle_tpu.jit import TrainStep
+    from paddle_tpu.observability import perf
     from paddle_tpu.optimizer import SGD
     from paddle_tpu.ops.registry import exec_cache_size
 
@@ -373,7 +396,10 @@ def bench_dispatch(on_tpu):
         (32, 256)).astype(np.float32))
     params = lin1.parameters() + lin2.parameters()
     opt = SGD(learning_rate=1e-3, parameters=params)
-    steps = 50 if on_tpu else 10
+    steps = 50 if on_tpu else 20
+    # this CPU box swings 3x window-to-window (shared host); best-of
+    # needs more samples than the quiet-chip default to converge
+    windows = 3 if on_tpu else 8
 
     def eager_step():
         h = pt.ops.tanh(lin1(x))
@@ -383,46 +409,112 @@ def bench_dispatch(on_tpu):
         opt.clear_grad()
         return loss
 
-    eager_step()  # warm the executable cache
-    loss = eager_step()
-    float(loss.numpy())
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = eager_step()
-    float(loss.numpy())
-    dt_eager = time.perf_counter() - t0
+    def run_eager(mode, n):
+        with dq.backward_dispatch_mode(mode):
+            loss = None
+            for _ in range(n):
+                loss = eager_step()
+            float(loss.numpy())
+
+    run_eager("per_node", 2)    # warm per-op executables
+    run_eager("batched", 2)     # warm the fused chain executable
+
+    # the TrainStep variant gets ITS OWN modules/optimizer: the jitted
+    # step donates its state, and the interleaved windows would feed
+    # the eager path deleted buffers if they shared parameters
+    lin3 = pt.nn.Linear(256, 256)
+    lin4 = pt.nn.Linear(256, 256)
 
     def loss_fn(m, x):
-        h = pt.ops.tanh(lin1(x))
-        return (lin2(h) ** 2).mean()
+        h = pt.ops.tanh(lin3(x))
+        return (lin4(h) ** 2).mean()
 
     class _Pair(pt.nn.Layer):
         def __init__(self):
             super().__init__()
-            self.a, self.b = lin1, lin2
+            self.a, self.b = lin3, lin4
 
-    step = TrainStep(_Pair(), opt, lambda m, x: loss_fn(m, x))
+    step = TrainStep(_Pair(),
+                     SGD(learning_rate=1e-3,
+                         parameters=lin3.parameters() + lin4.parameters()),
+                     lambda m, x: loss_fn(m, x))
     step(x)
-    loss = step(x)
-    float(loss.numpy())
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x)
-    float(loss.numpy())
-    dt_train = time.perf_counter() - t0
+    float(step(x).numpy())
 
+    def run_train(n):
+        loss = None
+        for _ in range(n):
+            loss = step(x)
+        float(loss.numpy())
+
+    # interleaved best-of-N windows: every variant samples every load
+    # phase of the shared box, min-reduce de-biases the contention.
+    # Observability is OFF for the timed windows — per_node records
+    # one gap per grad node and TrainStep records nothing, so leaving
+    # it on would bias exactly the ratios this bench pins
+    obs_was_on = obs.enabled()
+    obs.disable()
+    best = {"train": float("inf"), "per_node": float("inf"),
+            "batched": float("inf")}
+    try:
+        for _ in range(windows):
+            for variant in ("train", "per_node", "batched"):
+                t0 = time.perf_counter()
+                if variant == "train":
+                    run_train(steps)
+                else:
+                    run_eager(variant, steps)
+                best[variant] = min(best[variant],
+                                    time.perf_counter() - t0)
+    finally:
+        if obs_was_on:
+            obs.enable()
+
+    # attribution pass per eager mode: a fresh observability window so
+    # each mode's gap/batch series and per-family ledger record are
+    # its own (separate from the uninstrumented timed windows above)
+    gap_by_mode = {}
+    ledger_modes = []
+    for mode in ("per_node", "batched"):
+        obs.reset()
+        run_eager(mode, steps)
+        summ = _dispatch_gap_summary() or {"count": 0, "total_ms": 0.0}
+        if mode == "batched":
+            batch = _dispatch_batch_summary()
+            if batch:
+                summ["batch_size"] = batch
+        gap_by_mode[mode] = summ
+        total_ms = summ.get("total_ms", 0.0) or 0.0
+        ledger_modes.append({
+            "mode": mode,
+            "families": perf.family_records(),
+            "dispatch_gap": {
+                "steps": steps,
+                "count": summ.get("count", 0),
+                "total_ms": round(total_ms, 3),
+                "ms_per_step": round(total_ms / steps, 4),
+            },
+        })
+
+    dt_t, dt_p, dt_b = best["train"], best["per_node"], best["batched"]
     return {
         "metric": "eager_dispatch_steps_per_sec",
-        "value": round(steps / dt_eager, 1),
+        "value": round(steps / dt_b, 1),
         "unit": "steps/s",
-        "vs_baseline": round(dt_train / dt_eager, 4),
+        "vs_baseline": round(dt_t / dt_b, 4),
+        "_ledger_modes": ledger_modes,
         "extra": {
-            "trainstep_steps_per_sec": round(steps / dt_train, 1),
-            "eager_over_trainstep_time": round(dt_eager / dt_train, 2),
+            "trainstep_steps_per_sec": round(steps / dt_t, 1),
+            "per_node_steps_per_sec": round(steps / dt_p, 1),
+            "eager_over_trainstep_time": round(dt_b / dt_t, 2),
+            "eager_over_trainstep_per_node": round(dt_p / dt_t, 2),
+            "batched_over_per_node_time": round(dt_b / dt_p, 4),
             "exec_cache_entries": exec_cache_size(),
+            "fused_chain_entries": dq.chain_cache_size(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "steps": steps,
-            "dispatch_gap": _dispatch_gap_summary(),
+            "windows": windows,
+            "dispatch_gap": gap_by_mode,
         },
     }
 
@@ -1189,28 +1281,58 @@ def _git_rev():
         return "unknown"
 
 
-def _append_perf_ledger(path, name, result):
-    """One JSONL record: this config window's per-family
+def _append_perf_ledger(path, name, result, modes=None):
+    """JSONL records: this config window's per-family
     expected/achieved summary (observability.perf.family_records —
     reset per config by obs.reset()) plus the headline number it rode
-    with. Configs that compiled/ran no instrumented family (lint,
-    --no-obs runs) append nothing."""
+    with. `modes` (the dispatch config's per-mode payloads) writes ONE
+    record per backward dispatch mode, each carrying its own families
+    and dispatch-gap totals so tools/perf_ledger.py --check can
+    baseline per (config, mode). Pallas autotune sweeps recorded since
+    the last append ride on the first record (so a TPU run's candidate
+    timings land next to the configs they tuned under). Configs that
+    compiled/ran no instrumented family (lint, --no-obs runs) append
+    nothing."""
     import jax
     from paddle_tpu.observability import perf
-    fams = perf.family_records()
-    if not fams:
-        return None
     dev = jax.devices()[0]
-    rec = {
+    base = {
         "rev": _git_rev(), "config": name,
         "ts": round(time.time(), 3),
         "device": str(getattr(dev, "device_kind", dev.platform)),
         "metric": result.get("metric"), "value": result.get("value"),
         "vs_baseline": result.get("vs_baseline"),
-        "families": fams,
     }
+    records = []
+    if modes:
+        for m in modes:
+            rec = dict(base)
+            rec["mode"] = m["mode"]
+            rec["families"] = m["families"]
+            rec["dispatch_gap"] = m["dispatch_gap"]
+            records.append(rec)
+    else:
+        fams = perf.family_records()
+        if fams:
+            rec = dict(base)
+            rec["families"] = fams
+            records.append(rec)
+    try:
+        from paddle_tpu.kernels.pallas import autotune as _autotune
+        sweeps = _autotune.drain_sweeps()
+    except Exception:
+        sweeps = []
+    if not records:
+        if not sweeps:
+            return None
+        rec = dict(base)
+        rec["families"] = {}
+        records.append(rec)
+    if sweeps:
+        records[0]["autotune_sweeps"] = sweeps
     with open(path, "a", encoding="utf-8") as f:
-        f.write(json.dumps(rec, sort_keys=True) + "\n")
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
     return path
 
 
@@ -1373,13 +1495,15 @@ def main():
             obs.enable()
             obs.reset()
         result = CONFIGS[name](on_tpu)
+        ledger_modes = result.pop("_ledger_modes", None)
         if args.gate and name in GATE_WINDOWS:
             result["gate"] = _run_gate(name, args.gate_rev,
                                        args.gate_windows, args.gate_tol)
         if not args.no_obs:
             result["obs"] = obs.summary()
             if not args.no_ledger:
-                _append_perf_ledger(args.ledger, name, result)
+                _append_perf_ledger(args.ledger, name, result,
+                                    modes=ledger_modes)
             obs.disable()
         print(json.dumps(result), flush=True)
 

@@ -15,6 +15,9 @@ from .tape import (  # noqa: F401
     no_grad, enable_grad, is_grad_enabled, set_grad_enabled, run_backward,
     GradNode, InputEdge,
 )
+from .dispatch_queue import (  # noqa: F401
+    backward_dispatch_mode, dispatch_mode, set_dispatch_mode,
+)
 from ..core.tensor import Tensor
 
 

@@ -1,0 +1,526 @@
+"""Batched backward dispatch engine (ROADMAP item 4, second ceiling).
+
+The per-node walker in ``tape.run_backward`` pays host work per
+GradNode: cotangent slot assembly (``jnp.zeros`` allocated per dead
+slot, ``jnp.ones`` per implicit seed), hook/target bookkeeping through
+dict-backed accumulation slots, queue management, and — dominating all
+of it — one XLA dispatch per node (the jitted per-op bwd executable).
+PR 8's dispatch-gap profiler put numbers on exactly that host gap
+(``paddle_tpu_dispatch_gap_seconds``, per-op attributed). This module
+is the fix the telemetry was built for:
+
+* **Dispatch queue + fusion-at-dispatch** (cf. FusionStitching,
+  PAPERS.md; SURVEY §7.3 async dispatch queue): ready nodes stage into
+  the queue, and a maximal run of consecutive single-consumer nodes is
+  dispatched as ONE jitted call — the per-node vjp bodies chained
+  inside a single trace, cached per chain signature (compile family
+  ``backward_fused``). One XLA dispatch replaces ``len(run)`` of them,
+  and the inter-node host bookkeeping (slot dicts, pending counts,
+  queue churn, per-node zero building) vanishes from the hot loop:
+  intermediate cotangents flow inside the executable.
+
+* **Const caches**: per-aval zero-cotangent and seed-ones caches
+  replace the per-dispatch eager allocations (the tape walker shares
+  them, so the per-node A/B baseline gets the same fix — satellite of
+  ISSUE 10).
+
+* **Observability**: each dispatch call records its run length into
+  ``paddle_tpu_dispatch_batch_size`` (fused runs > 1, degraded
+  dispatches = 1), and dispatch gaps keep their per-op attribution so
+  the bench A/B shows WHERE the host time went, not just the total.
+
+Degradation contract — outputs stay bit-identical to the per-node
+walker. A node joins a fused run only when fusion cannot be observed:
+
+* it carries ``fuse_info`` (an exec-cache entry + captured
+  primals/nondiffs — ops recorded through the registry's cached path;
+  PyLayer, RNG-consuming and uncacheable ops never do),
+* every output aval is inexact (float0 cotangents stay host-side),
+* no hooks on its leaf edges, and — for non-head positions — exactly
+  one consumer edge, not root-seeded, and no hooks / ``retain_grad`` /
+  grad-target on its output tensors,
+* the ready queue is empty, so fused FIFO dispatch order is EXACTLY
+  the per-node order (leaf-grad accumulation order preserved —
+  bit-identical sums).
+
+Everything else (multi-consumer fan-in, hooks mid-chain,
+``create_graph``, a chain whose composed trace fails) degrades to the
+per-node path mid-walk. ``PADDLE_TPU_BACKWARD_DISPATCH=per_node`` (or
+``set_dispatch_mode``/``backward_dispatch_mode``) restores the old
+walker wholesale — ``bench.py --config dispatch`` A/Bs the two modes
+in one session.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# mode control
+# ---------------------------------------------------------------------------
+_MODE_ENV = "PADDLE_TPU_BACKWARD_DISPATCH"
+_VALID_MODES = ("batched", "per_node")
+_mode = os.environ.get(_MODE_ENV, "batched")
+if _mode not in _VALID_MODES:
+    _mode = "batched"
+
+
+def dispatch_mode() -> str:
+    """Current backward dispatch mode: 'batched' (default) or
+    'per_node' (the pre-ISSUE-10 walker, kept as the A/B baseline and
+    the always-correct fallback)."""
+    return _mode
+
+
+def set_dispatch_mode(mode: str) -> str:
+    """Set the backward dispatch mode; returns the previous mode."""
+    global _mode
+    if mode not in _VALID_MODES:
+        raise ValueError(
+            f"backward dispatch mode must be one of {_VALID_MODES}, "
+            f"got {mode!r}")
+    old = _mode
+    _mode = mode
+    return old
+
+
+class backward_dispatch_mode:
+    """Context manager pinning the backward dispatch mode (the bench
+    A/B and the bit-identical test suite run both modes through it)."""
+
+    def __init__(self, mode: str):
+        self._new = mode
+
+    def __enter__(self):
+        self._old = set_dispatch_mode(self._new)
+        return self
+
+    def __exit__(self, *exc):
+        set_dispatch_mode(self._old)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# const caches (satellite: the measured hot spot — jnp.zeros per dead
+# output slot / jnp.ones per implicit seed were eager device
+# allocations on EVERY dispatch; arrays are immutable, so one per aval
+# serves every backward)
+# ---------------------------------------------------------------------------
+_FLOAT0 = jax.dtypes.float0
+_ZEROS: Dict[Tuple, Any] = {}
+_ONES: Dict[Tuple, Any] = {}
+_CONST_CACHE_MAX = 256
+
+
+def is_float0(x) -> bool:
+    """Cheap float0 test. float0 cotangents are always numpy arrays
+    (jax Arrays never carry the float0 extended dtype), so the
+    expensive structured-np-dtype ``__eq__`` never runs for device
+    values — this check was measurable per-node host overhead when
+    written as ``x.dtype == float0`` unconditionally."""
+    return isinstance(x, np.ndarray) and x.dtype == _FLOAT0
+
+
+def zero_cotangent_array(aval):
+    """Cached zero cotangent for an output aval (inexact -> device
+    zeros, everything else -> numpy float0 zeros)."""
+    key = (tuple(aval.shape), aval.dtype)
+    hit = _ZEROS.get(key)
+    if hit is None:
+        if len(_ZEROS) >= _CONST_CACHE_MAX:
+            _ZEROS.clear()
+        if jnp.issubdtype(aval.dtype, jnp.inexact):
+            hit = jnp.zeros(aval.shape, aval.dtype)
+        else:
+            hit = np.zeros(aval.shape, _FLOAT0)
+        _ZEROS[key] = hit
+    return hit
+
+
+def ones_seed_array(shape, dtype):
+    """Cached implicit-seed ones (the scalar-loss ``backward()``
+    cotangent built once per (shape, dtype) instead of per call)."""
+    key = (tuple(shape), dtype)
+    hit = _ONES.get(key)
+    if hit is None:
+        if len(_ONES) >= _CONST_CACHE_MAX:
+            _ONES.clear()
+        hit = jnp.ones(shape, dtype)
+        _ONES[key] = hit
+    return hit
+
+
+def clear_const_caches() -> None:
+    _ZEROS.clear()
+    _ONES.clear()
+
+
+# ---------------------------------------------------------------------------
+# fused-chain executable cache
+# ---------------------------------------------------------------------------
+MAX_CHAIN = 64          # jit arg-count guard; runs longer than this split
+_CHAIN_CACHE: Dict[Tuple, Any] = {}     # key -> _FusedChain | None
+_CHAIN_CACHE_MAX = 256
+
+
+class _FusedChain:
+    """One compiled backward run: the chained vjp bodies of N
+    consecutive single-consumer grad nodes behind one jitted callable.
+    Holds strong refs to the exec-cache entries it traced through —
+    the cache key uses their ids, so pinning them makes id reuse
+    impossible while the chain is cached.
+
+    Compile telemetry (family ``backward_fused``) uses a first-call
+    shim like perf.CompileTimed but deliberately does NOT keep the AOT
+    executable for dispatch: ``jax.stages.Compiled.__call__`` goes
+    through a slow python argument path (~2x a pjit C++ fast-path
+    call, measured on the CPU box), and the whole point of this module
+    is dispatch latency. The AOT lower+compile runs once for the
+    cost-model read (only while observability is enabled), then every
+    call — including the first — dispatches through the jit fast
+    path."""
+
+    __slots__ = ("jit_fn", "entries", "pending", "disabled")
+
+    def __init__(self, fn, entries):
+        self.jit_fn = fn
+        self.entries = entries
+        self.pending = True
+        # flips True when the composed trace fails (concrete-path-only
+        # grads, exotic op): the chain dispatches per-node from then
+        # on. The disabled chain STAYS in the cache holding its entry
+        # refs — a bare None sentinel would not pin them, and an
+        # exec-cache eviction followed by id reuse could silently
+        # degrade a brand-new fusable chain that hashes to this key.
+        self.disabled = False
+
+    def __call__(self, *args):
+        if not self.pending:
+            return self.jit_fn(*args)
+        from ..observability import metrics as _m
+        from ..observability import perf as _pf
+        t0 = time.perf_counter()
+        if _m._ENABLED:
+            try:
+                _pf.record_compile(
+                    "backward_fused", self.jit_fn.lower(*args).compile())
+            except Exception:
+                pass        # cost model stays unrecorded, jit decides
+        out = self.jit_fn(*args)
+        # cleared only on success: a first call that raises leaves the
+        # compile un-recorded and the retry records it instead
+        self.pending = False
+        if _m._ENABLED:
+            c, h = _m.compile_metrics()
+            c.labels(family="backward_fused").inc()
+            h.labels(family="backward_fused").observe(
+                time.perf_counter() - t0)
+        return out
+
+
+def clear_chain_cache() -> None:
+    _CHAIN_CACHE.clear()
+
+
+def chain_cache_size() -> int:
+    return sum(1 for v in _CHAIN_CACHE.values() if not v.disabled)
+
+
+def _build_fused(descs):
+    """Trace-time composition: each node's cotangent contraction is
+    re-derived from its captured primals exactly like the per-node
+    ``entry.bwd`` executable does, but inside ONE trace — XLA sees the
+    whole run and the intermediate cotangents never surface to the
+    host. descs: per node (entry, cont_pos, out_avals|None,
+    seed_idx|None); head (out_avals None) receives its full cotangent
+    slot vector as an input, later nodes build zero slots in-trace and
+    take the previous node's continuation cotangent at seed_idx."""
+
+    def fused(head_cots, packs):
+        outs = []
+        nxt = None
+        cots = head_cots
+        for (entry, cont_pos, out_avals, seed_idx), (primals, nondiffs) \
+                in zip(descs, packs):
+            if out_avals is not None:
+                slots = [jnp.zeros(a.shape, a.dtype) for a in out_avals]
+                slots[seed_idx] = nxt
+                cots = tuple(slots)
+
+            def _fwd(*d, _e=entry, _nd=nondiffs):
+                return _e._run_raw(d, _nd)
+
+            _, vf = jax.vjp(_fwd, *primals)
+            in_cots = vf(tuple(cots))
+            for j, g in enumerate(in_cots):
+                if j != cont_pos:
+                    outs.append(g)
+            if cont_pos is not None:
+                nxt = in_cots[cont_pos]
+        return tuple(outs)
+
+    return jax.jit(fused)
+
+
+def _chain_key(chain, cont_positions):
+    """Chain-shape cache key. id(entry) is INTENTIONAL identity
+    keying (cf. dy2static's _bound_cache): an exec-cache entry fully
+    determines the node's traced bwd body, entries are long-lived on
+    their OpDef, and _FusedChain pins every entry it traced through —
+    so an id can never be reused while its key is live, and two
+    backwards over the same op signatures hit the same executable."""
+    parts = []
+    for i, (node, cont_pos) in enumerate(zip(chain, cont_positions)):
+        entry = node.fuse_info[0]
+        seed_idx = (-1 if i == 0 else
+                    chain[i - 1].edges[cont_positions[i - 1]].out_idx)
+        parts.append((id(entry), len(node.edges),  # graftlint: disable=unstable-cache-key
+                      -1 if cont_pos is None else cont_pos, seed_idx))
+    return tuple(parts)
+
+
+def _get_fused(chain, cont_positions):
+    """Fused executable for this chain shape (possibly disabled, when
+    a previous attempt found the composition untraceable)."""
+    key = _chain_key(chain, cont_positions)
+    if key in _CHAIN_CACHE:
+        return _CHAIN_CACHE[key], key
+    descs = []
+    for i, (node, cont_pos) in enumerate(zip(chain, cont_positions)):
+        entry = node.fuse_info[0]
+        seed_idx = (None if i == 0 else
+                    chain[i - 1].edges[cont_positions[i - 1]].out_idx)
+        out_avals = None if i == 0 else tuple(node.out_avals)
+        descs.append((entry, cont_pos, out_avals, seed_idx))
+    fused = _FusedChain(_build_fused(descs),
+                        tuple(d[0] for d in descs))
+    if len(_CHAIN_CACHE) >= _CHAIN_CACHE_MAX:
+        # simple LRU-ish trim: drop the oldest half (insertion order)
+        for k in list(_CHAIN_CACHE)[:_CHAIN_CACHE_MAX // 2]:
+            del _CHAIN_CACHE[k]
+    _CHAIN_CACHE[key] = fused
+    return fused, key
+
+
+# ---------------------------------------------------------------------------
+# the batched walker
+# ---------------------------------------------------------------------------
+_INEXACT_MEMO: Dict[Any, bool] = {}
+
+
+def _all_inexact(node) -> bool:
+    for a in node.out_avals:
+        v = _INEXACT_MEMO.get(a.dtype)
+        if v is None:
+            v = _INEXACT_MEMO[a.dtype] = bool(
+                jnp.issubdtype(a.dtype, jnp.inexact))
+        if not v:
+            return False
+    return True
+
+
+def _leaf_hooked(node) -> bool:
+    for e in node.edges:
+        if e.kind == "leaf" and e.tensor_ref is not None:
+            t = e.tensor_ref()
+            if t is not None and t._hooks:
+                return True
+    return False
+
+
+def _head_fusable(node) -> bool:
+    fi = node.fuse_info
+    return (fi is not None and fi[0].bwd_ok and _all_inexact(node)
+            and not _leaf_hooked(node))
+
+
+def run_batched(node_by_id, consumers, cot, node_store, seed,
+                target_ids, target_results, accumulate_leaf_grads,
+                retain_graph):
+    """The batched-mode hot loop of ``tape.run_backward`` (roots
+    already seeded; ``seed`` is the tape's accumulation closure over
+    ``cot``/``node_store``). Same semantics as the per-node walker —
+    FIFO dispatch order, hook/retain/target handling, leaf
+    accumulation order — with maximal single-consumer runs dispatched
+    as one fused call."""
+    from collections import deque
+
+    from . import tape
+    from ..observability import metrics as _om
+    from ..observability import perf as _pf
+
+    pending = dict(consumers)
+    queue = deque(n for nid, n in node_by_id.items()
+                  if pending.get(nid, 0) == 0)
+    root_seeded = frozenset(cot)
+    fusable_memo: Dict[int, bool] = {}
+
+    def nonhead_fusable(n) -> bool:
+        nid = id(n)
+        v = fusable_memo.get(nid)
+        if v is None:
+            v = (consumers.get(nid, 0) == 1
+                 and nid not in root_seeded
+                 and _head_fusable(n))
+            if v:
+                for ref in n.out_tensor_refs:
+                    t = ref() if ref is not None else None
+                    if t is not None and (
+                            t._hooks or t._retain_grad
+                            or (target_ids and id(t) in target_ids)):
+                        v = False
+                        break
+            fusable_memo[nid] = v
+        return v
+
+    def apply_leaf_edge(e, g):
+        """Leaf-edge cotangent handling — identical to the per-node
+        walker's edge loop body (hooks fired by the caller where they
+        can exist)."""
+        t = e.tensor_ref() if e.tensor_ref is not None else None
+        if t is None:
+            return
+        if t._hooks:
+            g = tape._apply_hooks(t._hooks, g, False)
+            fusable_memo.clear()    # a hook may register hooks/retain
+        if target_ids and id(t) in target_ids:
+            i = target_ids[id(t)]
+            r = target_results[i]
+            target_results[i] = g if r is None else r + g
+        if accumulate_leaf_grads:
+            tape._apply_leaf_grad(t, g, False)
+
+    def seed_node_edge(e, g):
+        seed(e.node, e.out_idx, g)
+        pending[id(e.node)] -= 1
+        if pending[id(e.node)] == 0:
+            queue.append(e.node)
+
+    last_dispatch = None
+    while queue:
+        node = queue.popleft()
+        slots = cot.get(id(node))
+        if slots is None:
+            slots = [None] * len(node.out_avals)
+        cots = [s if s is not None else zero_cotangent_array(a)
+                for s, a in zip(slots, node.out_avals)]
+        # hooks / retain_grad / targets on this node's outputs — the
+        # head of a run is mid-dispatch, so these fire exactly like
+        # the per-node walker (before the device call)
+        for i, ref in enumerate(node.out_tensor_refs):
+            t = ref() if ref is not None else None
+            if t is None:
+                continue
+            if t._hooks:
+                cots[i] = tape._apply_hooks(t._hooks, cots[i], False)
+                fusable_memo.clear()
+            if t._retain_grad or (target_ids and id(t) in target_ids):
+                if target_ids and id(t) in target_ids:
+                    r = target_results[target_ids[id(t)]]
+                    target_results[target_ids[id(t)]] = (
+                        cots[i] if r is None else r + cots[i])
+                if t._retain_grad and accumulate_leaf_grads:
+                    tape._apply_leaf_grad(t, cots[i], False)
+
+        # chain construction: only when the queue is empty does fusing
+        # the successor preserve exact FIFO order (and with it the
+        # bit-identical leaf accumulation order)
+        chain = None
+        cont_positions: List[Optional[int]] = []
+        if not queue and _head_fusable(node) \
+                and not any(is_float0(c) for c in cots):
+            chain = [node]
+            cur = node
+            while len(chain) < MAX_CHAIN:
+                cont_pos = None
+                for j, e in enumerate(cur.edges):
+                    if e.kind == "node":
+                        if cont_pos is not None:
+                            cont_pos = None
+                            break
+                        cont_pos = j
+                if cont_pos is None:
+                    break
+                nxt = cur.edges[cont_pos].node
+                if not nonhead_fusable(nxt):
+                    break
+                cont_positions.append(cont_pos)
+                chain.append(nxt)
+                cur = nxt
+            cont_positions.append(None)     # last node: no continuation
+
+        enabled = _om._ENABLED
+        if enabled:
+            now = time.perf_counter()
+            if last_dispatch is not None:
+                _pf.note_dispatch_gap(now - last_dispatch, node.name)
+
+        dispatched_fused = False
+        if chain is not None and len(chain) > 1:
+            fused, key = _get_fused(chain, cont_positions)
+            if not fused.disabled:
+                packs = tuple((n.fuse_info[1], n.fuse_info[2])
+                              for n in chain)
+                try:
+                    outs = fused(tuple(cots), packs)
+                    dispatched_fused = True
+                except Exception:
+                    # untraceable composition (concrete-path-only
+                    # grads, exotic op): remember and degrade — the
+                    # per-node path below redispatches this head
+                    fused.disabled = True
+        if dispatched_fused:
+            if enabled:
+                last_dispatch = time.perf_counter()
+                _pf.note_dispatch_batch(len(chain))
+            oi = 0
+            for n, cont_pos in zip(chain, cont_positions):
+                for j, e in enumerate(n.edges):
+                    if j == cont_pos:
+                        continue
+                    g = outs[oi]
+                    oi += 1
+                    if e.kind == "stop":
+                        continue
+                    if e.kind == "leaf":
+                        apply_leaf_edge(e, g)
+                    else:               # only the last node has these
+                        seed_node_edge(e, g)
+                if not retain_graph:
+                    n.vjp_fn = None
+                    n.replay_fn = None
+                    n.primal_arrays = None
+                    n.record_vjp = None
+                    n.fuse_info = None
+            cot.pop(id(node), None)
+            continue
+
+        # per-node dispatch (degraded or unfused) — the original walker
+        in_cots = node.vjp_fn(tuple(cots))
+        if enabled:
+            last_dispatch = time.perf_counter()
+            _pf.note_dispatch_batch(1)
+        if not isinstance(in_cots, (tuple, list)):
+            in_cots = (in_cots,)
+        assert len(in_cots) == len(node.edges), (
+            f"{node}: vjp returned {len(in_cots)} cotangents for "
+            f"{len(node.edges)} edges")
+        for e, g in zip(node.edges, in_cots):
+            if e.kind == "stop":
+                continue
+            if e.kind == "leaf":
+                apply_leaf_edge(e, g)
+            else:
+                seed_node_edge(e, g)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.replay_fn = None
+            node.primal_arrays = None
+            node.record_vjp = None
+            node.fuse_info = None
+        cot.pop(id(node), None)

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..observability import metrics as _om
 from ..observability import perf as _pf
+from . import dispatch_queue as _dq
 
 # --------------------------------------------------------------------------
 # global tape state (analog of eager's tracer_has_grad)
@@ -95,7 +96,7 @@ class InputEdge:
 class GradNode:
     __slots__ = (
         "name", "vjp_fn", "edges", "out_avals", "out_tensor_refs",
-        "replay_fn", "primal_arrays", "record_vjp",
+        "replay_fn", "primal_arrays", "record_vjp", "fuse_info",
         "__weakref__",
     )
 
@@ -120,6 +121,13 @@ class GradNode:
         self.replay_fn = None
         self.primal_arrays: Optional[List[Any]] = None
         self.record_vjp = None
+        # batched-dispatch fusion handle (ops.registry attaches it for
+        # exec-cache-backed nodes): (entry, primals, nondiff_arrays) —
+        # everything dispatch_queue needs to re-derive this node's
+        # cotangent contraction inside a fused trace. None = the node
+        # always dispatches per-node (PyLayer, RNG ops, uncacheable
+        # signatures, record_apply nodes).
+        self.fuse_info: Optional[tuple] = None
 
     def register_output(self, idx: int, tensor):
         self.out_tensor_refs[idx] = weakref.ref(tensor)
@@ -129,13 +137,14 @@ class GradNode:
 
 
 def _zero_cotangent(aval, as_tensor=False):
-    if jax.numpy.issubdtype(aval.dtype, jax.numpy.inexact):
-        z = jax.numpy.zeros(aval.shape, aval.dtype)
-        if as_tensor:
-            from ..core.tensor import Tensor
-            return Tensor._wrap(z, stop_gradient=True)
-        return z
-    return np.zeros(aval.shape, jax.dtypes.float0)
+    # per-aval cached (ISSUE 10 satellite: this used to allocate a
+    # fresh device zeros per dead output slot on EVERY dispatch —
+    # arrays are immutable, one per aval serves every backward)
+    z = _dq.zero_cotangent_array(aval)
+    if as_tensor and jax.numpy.issubdtype(aval.dtype, jax.numpy.inexact):
+        from ..core.tensor import Tensor
+        return Tensor._wrap(z, stop_gradient=True)
+    return z
 
 
 def build_node(name, vjp_fn, diff_tensors, out_avals,
@@ -291,12 +300,8 @@ def _accumulate(slot_map, key, idx, value):
     slots = slot_map[key]
     if slots[idx] is None:
         slots[idx] = value
-    else:
-        prev = slots[idx]
-        if hasattr(value, "dtype") and value.dtype == jax.dtypes.float0:
-            pass
-        else:
-            slots[idx] = prev + value
+    elif not _dq.is_float0(value):
+        slots[idx] = slots[idx] + value
 
 
 def _apply_hooks(hooks, val, create_graph):
@@ -363,7 +368,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 raise RuntimeError(
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {tuple(t._data.shape)}")
-            gval = jax.numpy.ones(t._data.shape, t._data.dtype)
+            gval = _dq.ones_seed_array(t._data.shape, t._data.dtype)
             if create_graph:
                 gval = Tensor._wrap(gval, stop_gradient=True)
         elif create_graph:
@@ -384,6 +389,16 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
 
     if roots:
         node_by_id, consumers = _collect_graph(roots)
+        if not create_graph and _dq.dispatch_mode() == "batched":
+            # ISSUE 10 tentpole: the dispatch-queue engine — fused
+            # single-consumer runs, const caches, bit-identical
+            # degradation to the per-node semantics below
+            _dq.run_batched(node_by_id, consumers, cot, node_store,
+                            seed, target_ids, target_results,
+                            accumulate_leaf_grads, retain_graph)
+            if grad_targets is not None:
+                return target_results
+            return None
         # ready = nodes with no unprocessed consumers within the graph
         pending = dict(consumers)
         queue = deque(n for nid, n in node_by_id.items()
@@ -464,6 +479,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                 node.replay_fn = None
                 node.primal_arrays = None
                 node.record_vjp = None
+                node.fuse_info = None
             cot.pop(id(node), None)
 
     if grad_targets is not None:
@@ -479,9 +495,11 @@ def _apply_leaf_grad(tensor, g, create_graph=False):
         # keep the cotangent's graph so .grad is differentiable
         tensor._grad = g if tensor._grad is None else tensor._grad + g
         return
-    if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+    if _dq.is_float0(g):
         return
     if tensor._grad is None:
-        tensor._grad = Tensor._wrap(jax.numpy.asarray(g), stop_gradient=True)
+        if not isinstance(g, jax.Array):
+            g = jax.numpy.asarray(g)
+        tensor._grad = Tensor._wrap(g, stop_gradient=True)
     else:
         tensor._grad = Tensor._wrap(tensor._grad._data + g, stop_gradient=True)

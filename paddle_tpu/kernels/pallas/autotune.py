@@ -17,6 +17,19 @@ Design notes:
     $PADDLE_TPU_CACHE_DIR (default ~/.cache/paddle_tpu).
   - PADDLE_TPU_PALLAS_AUTOTUNE=0 disables the search (defaults used);
     a cache HIT costs one dict lookup.
+  - BANDWIDTH-WINDOW VALIDATION (ISSUE 10): BENCH_EXTRA r5 measured the
+    shared chip's effective HBM bandwidth swinging between 233-314 GB/s
+    against the 819 GB/s spec — a sweep timed in a degraded window
+    picks a noise winner and FREEZES it into the cache (exactly what
+    happened to the flash forward config at seq-2048). `tune(...,
+    bw_window=(lo, hi))` probes effective copy bandwidth before and
+    after the candidate rounds; unless both probes land inside the
+    validated window, the sweep result is DISCARDED (defaults returned,
+    nothing persisted) so a later process retries in a healthy window.
+    Every sweep — validated or not — is recorded in the in-process
+    sweep log; bench.py flushes it into perf_ledger.jsonl so a TPU
+    deployment inherits the candidate timings alongside the configs
+    they produced.
 """
 from __future__ import annotations
 
@@ -29,6 +42,7 @@ _MEM: dict = {}
 _LOCK = threading.Lock()
 _LOADED_FILES: set = set()
 _TUNING = threading.local()     # reentrancy guard
+_SWEEPS: list = []              # sweep records since the last drain
 
 
 def enabled() -> bool:
@@ -97,11 +111,71 @@ def lookup(key_parts) -> tuple | None:
     return tuple(hit) if hit else None
 
 
-def tune(key_parts, candidates, run_candidate, rounds=2):
+def dedup_candidates(cands, normalize, keep_original=False):
+    """Divisibility-normalized candidate dedup (grown by the ragged
+    autotuner in PR 7, now shared with the flash kernels): candidates
+    that collapse to one effective block config after the use site's
+    fit/pick clamps are measured once. `normalize(*c)` maps a raw
+    candidate to its effective config; returns the deduped list of
+    effective configs (or, with keep_original=True, the first raw
+    candidate per effective class — for use sites whose runner wants
+    the raw values)."""
+    seen, keep = set(), []
+    for c in cands:
+        e = normalize(*c)
+        if e not in seen:
+            seen.add(e)
+            keep.append(tuple(c) if keep_original else tuple(e))
+    return keep
+
+
+def measure_effective_bw(nbytes=1 << 26, iters=4):
+    """Effective device copy bandwidth (bytes/s) RIGHT NOW: one jitted
+    elementwise pass over `nbytes` (read + write = 2x), blocked on.
+    The probe the bandwidth-window validation compares against
+    perf.VALIDATED_BW_WINDOW; returns None when measurement fails
+    (missing backend, transient error) — callers treat that as
+    'cannot validate'."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        x = jnp.zeros((nbytes // 4,), jnp.float32)
+        f = jax.jit(lambda a: a + 1.0)
+        f(x).block_until_ready()        # compile + settle
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = f(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        if dt <= 0:
+            return None
+        return (2.0 * nbytes) / dt
+    except Exception:
+        return None
+
+
+def drain_sweeps() -> list:
+    """Return and clear the sweep records accumulated since the last
+    drain (bench.py appends them to perf_ledger.jsonl)."""
+    out = list(_SWEEPS)
+    _SWEEPS.clear()
+    return out
+
+
+def tune(key_parts, candidates, run_candidate, rounds=2, bw_window=None):
     """Measure `candidates` with run_candidate(c) -> seconds; memoize
     and persist the fastest. Returns the winning candidate. Reentrant
     calls (the measurement itself dispatches the kernel) fall through
-    to the first candidate."""
+    to the first candidate.
+
+    bw_window=(lo, hi) bytes/s: validate the measurement window — the
+    effective copy bandwidth is probed before and after the candidate
+    rounds, and unless BOTH probes land inside the window the sweep is
+    discarded (defaults returned, nothing persisted) so a degraded
+    window cannot freeze a noise winner into the cache. The sweep
+    record (candidate timings, probes, verdict) is logged either way
+    for the perf ledger."""
     if getattr(_TUNING, "active", False):
         return candidates[0]
     hit = lookup(key_parts)
@@ -109,24 +183,56 @@ def tune(key_parts, candidates, run_candidate, rounds=2):
         return hit
     dev = _device_kind()
     key = "|".join(str(p) for p in key_parts) + "|" + dev
+    probes = []
+    window_ok = True
+    if bw_window is not None:
+        lo, hi = bw_window
+        for _ in range(3):      # a transient dip should not kill the sweep
+            bw = measure_effective_bw()
+            probes.append(bw)
+            if bw is not None and lo <= bw <= hi:
+                break
+        else:
+            window_ok = False
     best = {c: float("inf") for c in candidates}
     _TUNING.active = True
     try:
-        for _ in range(rounds):
-            for c in candidates:
-                try:
-                    t = run_candidate(c)
-                except Exception:
-                    t = float("inf")
-                if t < best[c]:
-                    best[c] = t
+        if window_ok:
+            for _ in range(rounds):
+                for c in candidates:
+                    try:
+                        t = run_candidate(c)
+                    except Exception:
+                        t = float("inf")
+                    if t < best[c]:
+                        best[c] = t
     finally:
         _TUNING.active = False
+    if bw_window is not None and window_ok:
+        lo, hi = bw_window
+        bw = measure_effective_bw()
+        probes.append(bw)
+        window_ok = bw is not None and lo <= bw <= hi
     winner = min(candidates, key=lambda c: best[c])
-    if best[winner] == float("inf"):
-        # every measurement failed (chip busy / transient error): fall
-        # back WITHOUT persisting, so the next process retries instead
-        # of freezing a glitch into "tuned" state
+    measured = best[winner] != float("inf")
+    # every measurement failed (chip busy / transient error) or the
+    # window never validated: fall back WITHOUT persisting, so the next
+    # process retries instead of freezing a glitch into "tuned" state
+    persisted = window_ok and measured
+    _SWEEPS.append({
+        "key": list(key_parts), "device": dev,
+        "candidates": {str(tuple(c)): (None if best[c] == float("inf")
+                                       else round(best[c], 6))
+                       for c in candidates},
+        "winner": list(winner) if persisted else list(candidates[0]),
+        "bw_probes_bytes_per_s": [None if p is None else round(p, 1)
+                                  for p in probes],
+        "bw_window": list(bw_window) if bw_window is not None else None,
+        "window_validated": window_ok if bw_window is not None else None,
+        "persisted": persisted,
+        "rounds": rounds,
+    })
+    if not persisted:
         return tuple(candidates[0])
     with _LOCK:
         _MEM[key] = tuple(winner)

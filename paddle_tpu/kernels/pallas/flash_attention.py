@@ -191,6 +191,20 @@ def _seg_operands(segment_ids, b, sq, sk):
     return q_seg, kv_seg
 
 
+def _validated_bw_window():
+    """The device's validated-bandwidth window from
+    observability.perf.VALIDATED_BW_WINDOW (BENCH_EXTRA r5 methodology:
+    sweeps timed outside it pick noise winners). None = no validated
+    window known for this device — the sweep runs unvalidated, which
+    is the honest option when there is nothing to validate against."""
+    import jax as _jax
+    from ...observability import perf as _perf
+    try:
+        return _perf.lookup(_jax.devices()[0], _perf.VALIDATED_BW_WINDOW)
+    except Exception:
+        return None
+
+
 def _autotuned_blocks(kind, q, k, H, Hk, causal, has_seg, defaults,
                       run_shape, normalize):
     """Per-(shape-class, device-generation) {block_q, block_k} search
@@ -198,7 +212,11 @@ def _autotuned_blocks(kind, q, k, H, Hk, causal, has_seg, defaults,
     a candidate set (hand-tuned defaults included, so tuned >= default
     up to noise) on synthetic data and persists the winner; later calls
     and later PROCESSES pay one dict lookup. Tracer-safe: measurement
-    uses fresh concrete arrays, never the traced operands."""
+    uses fresh concrete arrays, never the traced operands. The sweep is
+    constrained to the validated-bandwidth window (ISSUE 10: the shipped
+    seq-2048 fwd config was tuned in an unvalidated window — tune()
+    discards sweeps whose effective-BW probes fall outside
+    perf.VALIDATED_BW_WINDOW instead of persisting noise)."""
     from . import autotune
     import jax as _jax
     if not autotune.enabled():
@@ -223,17 +241,14 @@ def _autotuned_blocks(kind, q, k, H, Hk, causal, has_seg, defaults,
         # distributed one pre-seeded cache file to all hosts.
         return defaults
     cands = [defaults] + [c for c in
-                          [(256, 512), (128, 1024), (512, 1024)]
+                          [(256, 512), (128, 512), (512, 512),
+                           (128, 1024), (512, 1024)]
                           if c != defaults]
     # normalize through the same fit/pick THE USE SITE applies (fwd and
     # bwd differ: bwd grows block_k for long sk and buffers more), so
-    # candidates that collapse to one real config are deduped
-    seen, norm = set(), []
-    for c0 in cands:
-        c = normalize(*c0)
-        if c not in seen:
-            seen.add(c)
-            norm.append(c)
+    # candidates that collapse to one real config are deduped (the
+    # ragged autotuner's divisibility-normalized dedup, shared)
+    norm = autotune.dedup_candidates(cands, normalize)
     if len(norm) == 1:
         return norm[0]
 
@@ -245,7 +260,8 @@ def _autotuned_blocks(kind, q, k, H, Hk, causal, has_seg, defaults,
     return autotune.tune(
         key, norm,
         lambda c: autotune._time_call(
-            runners.setdefault(c, run_shape(*c))))
+            runners.setdefault(c, run_shape(*c))),
+        bw_window=_validated_bw_window())
 
 
 def _flash_fwd_fused(q, k, v, H, causal, block_q=256, block_k=1024,

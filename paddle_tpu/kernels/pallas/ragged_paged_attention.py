@@ -289,13 +289,10 @@ def _autotuned_ragged_blocks(T, T_pool, H, Hk, D, dtype, int8_pool, bs,
     cands = [defaults] + [c for c in [(128, 512), (256, 1024), (512, 512)]
                           if c != defaults]
     # dedup candidates that collapse to one effective block config
-    # after the divisibility clamps the use site applies
-    seen, keep = set(), []
-    for c in cands:
-        e = normalize(*c)
-        if e not in seen:
-            seen.add(e)
-            keep.append(c)
+    # after the divisibility clamps the use site applies (shared
+    # helper; keep the RAW candidates — the runner re-applies clamps)
+    keep = autotune.dedup_candidates(cands, normalize,
+                                     keep_original=True)
     if len(keep) == 1:
         return keep[0]
     runners: dict = {}
@@ -307,8 +304,10 @@ def _autotuned_ragged_blocks(T, T_pool, H, Hk, D, dtype, int8_pool, bs,
             runners[c] = run_shape(*c)
         return runners[c]
 
+    from .flash_attention import _validated_bw_window
     return autotune.tune(
-        key, keep, lambda c: autotune._time_call(_runner(c)))
+        key, keep, lambda c: autotune._time_call(_runner(c)),
+        bw_window=_validated_bw_window())
 
 
 def _ragged_pallas(q, k_new, v_new, kpool, vpool, rows, pos, kv_start,

@@ -64,7 +64,8 @@ from . import metrics as _m
 
 __all__ = [
     "CostModel", "read_cost_model", "CompileTimed", "record_compile",
-    "observe_roofline", "note_dispatch_gap", "family_records",
+    "observe_roofline", "note_dispatch_gap", "note_dispatch_batch",
+    "family_records",
     "reset_window", "device_peaks", "set_device_peaks", "lookup",
     "PEAK_BF16_FLOPS", "HBM_BYTES_PER_SEC", "VALIDATED_BW_WINDOW",
     "DISPATCH_GAP_BUCKETS",
@@ -245,6 +246,14 @@ def _metrics():
                 "cumulative dispatch-gap seconds attributed to the "
                 "grad-node op type about to be dispatched",
                 ("op",)),
+            "batch": r.histogram(
+                "paddle_tpu_dispatch_batch_size",
+                "grad nodes per backward dispatch call in the batched "
+                "dispatch engine: fused single-consumer runs observe "
+                "their length, per-node degradations (hooks, "
+                "fan-in, unfusable ops) observe 1; the per_node A/B "
+                "mode records nothing",
+                buckets=(1, 2, 4, 8, 16, 32, 64)),
         }
     return _METRICS
 
@@ -330,6 +339,13 @@ def note_dispatch_gap(seconds: float, op: str) -> None:
     m = _metrics()
     m["gap"].observe(seconds)
     m["gap_op"].labels(op=op).inc(seconds)
+
+
+def note_dispatch_batch(n_nodes: int) -> None:
+    """One backward dispatch call of the batched engine covering
+    `n_nodes` grad nodes (1 = degraded per-node dispatch). Caller
+    guards on the metrics flag like note_dispatch_gap."""
+    _metrics()["batch"].observe(n_nodes)
 
 
 def family_records() -> Dict[str, dict]:

@@ -30,6 +30,7 @@ from ..core import dtype as dtypes
 from ..core.flags import flag_value
 from ..core.tensor import Tensor
 from ..autograd import tape
+from ..autograd.dispatch_queue import is_float0 as _is_float0
 
 OPS: Dict[str, "OpDef"] = {}
 
@@ -173,7 +174,10 @@ def _cache_key(opdef, treedef, leaves, tensor_pos, diff_pos):
     for i, leaf in enumerate(leaves):
         if i in tensor_pos:
             d = leaf._data if _is_tensor(leaf) else leaf
-            parts.append((tuple(d.shape), str(d.dtype)))
+            # np.dtype objects hash fast and are exactly as
+            # discriminating as their str() form, which paid a numpy
+            # name-building pass per tensor leaf per dispatch
+            parts.append((tuple(d.shape), d.dtype))
         else:
             fp = _static_fingerprint(leaf)
             if fp is _UNFINGERPRINTABLE:
@@ -389,9 +393,7 @@ def _dispatch(opdef: OpDef, args, kwargs):
         out_tree = entry.out_tree
 
         def vjp_fn(cots, _e=entry, _p=primals, _nd=nondiff_arrs):
-            if _e.bwd_ok and not any(
-                    getattr(c, "dtype", None) == jax.dtypes.float0
-                    for c in cots):
+            if _e.bwd_ok and not any(_is_float0(c) for c in cots):
                 try:
                     return _e.bwd(_p, _nd, tuple(cots))
                 except Exception:
@@ -430,6 +432,13 @@ def _dispatch(opdef: OpDef, args, kwargs):
     node = tape.build_node(opdef.name, vjp_fn,
                            [leaves[i] for i in diff_pos], out_avals,
                            replay_fn=g, primal_arrays=list(primals))
+    if entry is not None:
+        # batched-dispatch fusion handle: the dispatch queue re-derives
+        # this node's cotangent contraction from (entry._run_raw,
+        # primals, nondiffs) inside a fused trace — the same packing
+        # entry.bwd jits per-node, chained across consecutive
+        # single-consumer nodes instead (tape.dispatch_queue)
+        node.fuse_info = (entry, primals, tuple(nondiff_arrs))
 
     out = jax.tree_util.tree_unflatten(out_tree, list(flat_out))
     return _wrap_outputs(opdef, out, node=node)

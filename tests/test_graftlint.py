@@ -244,6 +244,35 @@ class TestPurity:
         assert rule_ids(fs) == ["host-sync"]
         assert fs[0].severity == "warning"
 
+    def test_host_clock_flagged_on_dispatch_path_only(self):
+        src = """
+            import time
+            def walk(nodes):
+                t0 = time.perf_counter()
+                return t0
+        """
+        fs = analyze(src, rules={"host-clock-in-dispatch"},
+                     path="paddle_tpu/autograd/some_walker.py")
+        assert rule_ids(fs) == ["host-clock-in-dispatch"]
+        assert fs[0].severity == "warning"
+        # the registry file is audited too
+        fs = analyze(src, rules={"host-clock-in-dispatch"},
+                     path="paddle_tpu/ops/registry.py")
+        assert rule_ids(fs) == ["host-clock-in-dispatch"]
+        # everything off the dispatch hot path is not
+        fs = analyze(src, rules={"host-clock-in-dispatch"},
+                     path="paddle_tpu/inference/llm_engine.py")
+        assert fs == []
+
+    def test_host_clock_ignores_non_clock_time_attrs(self):
+        fs = analyze("""
+            import time
+            def nap():
+                time.sleep(0.1)
+        """, rules={"host-clock-in-dispatch"},
+            path="paddle_tpu/autograd/tape.py")
+        assert fs == []
+
 
 # ---------------------------------------------------------------------------
 # family 3: recompile hazards

@@ -240,11 +240,15 @@ class TestWiredFamilies:
         assert json.dumps(recs)              # ledger-serializable
 
     def test_eager_backward_records_dispatch_gaps(self):
+        from paddle_tpu.autograd import dispatch_queue as dq
         obs.enable()
         lin1, lin2 = pt.nn.Linear(8, 8), pt.nn.Linear(8, 8)
         x = pt.to_tensor(np.ones((4, 8), np.float32))
-        for _ in range(3):
-            (lin2(pt.ops.tanh(lin1(x))) ** 2).mean().backward()
+        # per_node mode: one gap per inter-node hop (the batched engine
+        # collapses the whole chain into one dispatch — see below)
+        with dq.backward_dispatch_mode("per_node"):
+            for _ in range(3):
+                (lin2(pt.ops.tanh(lin1(x))) ** 2).mean().backward()
         gap = _series("paddle_tpu_dispatch_gap_seconds")[()]
         # >= 2 inter-node gaps per backward over the 4-op chain
         assert gap["count"] >= 6
@@ -254,12 +258,32 @@ class TestWiredFamilies:
         assert any(v > 0 for v in ops.values())
         assert pytest.approx(gap["sum"]) == sum(ops.values())
 
+    def test_batched_backward_pins_batch_size_histogram(self):
+        # ISSUE 10: the batched engine's run lengths are a pinned
+        # series — a 5-node single-consumer chain is ONE fused
+        # dispatch (batch size 5, zero inter-dispatch gaps)
+        from paddle_tpu.autograd import dispatch_queue as dq
+        obs.enable()
+        lin1, lin2 = pt.nn.Linear(8, 8), pt.nn.Linear(8, 8)
+        x = pt.to_tensor(np.ones((4, 8), np.float32))
+        with dq.backward_dispatch_mode("batched"):
+            for _ in range(3):
+                (lin2(pt.ops.tanh(lin1(x))) ** 2).mean().backward()
+        batch = _series("paddle_tpu_dispatch_batch_size")[()]
+        assert batch["count"] == 3           # one dispatch per backward
+        assert batch["max"] == 5
+        assert batch["sum"] == 15            # every node dispatched
+        gap = _series("paddle_tpu_dispatch_gap_seconds")[()]
+        assert gap["count"] == 0
+
     def test_disabled_backward_records_nothing(self):
         lin = pt.nn.Linear(4, 4)
         x = pt.to_tensor(np.ones((2, 4), np.float32))
         (lin(x) ** 2).mean().backward()
         assert _series(
             "paddle_tpu_dispatch_gap_seconds")[()]["count"] == 0
+        assert _series(
+            "paddle_tpu_dispatch_batch_size")[()]["count"] == 0
 
 
 # ---------------------------------------------------------------------------
@@ -290,18 +314,26 @@ class TestDisabledOverhead:
 # ---------------------------------------------------------------------------
 # perf ledger: bench appends, tools/perf_ledger.py attributes
 # ---------------------------------------------------------------------------
-def _ledger_record(rev, config, fams, device="cpu"):
-    return {"rev": rev, "config": config, "ts": 1.0,
-            "device": device, "metric": "m", "value": 1.0,
-            "vs_baseline": 1.0,
-            "families": {
-                f: {"runs": 3, "compiles": 1, "seconds": 0.01,
-                    "expected": None,
-                    "achieved_flops_per_s": None,
-                    "achieved_bytes_per_s": bps,
-                    "utilization_hbm": None,
-                    "utilization_flops": None}
-                for f, bps in fams.items()}}
+def _ledger_record(rev, config, fams, device="cpu", mode=None,
+                   gap_ms_per_step=None):
+    rec = {"rev": rev, "config": config, "ts": 1.0,
+           "device": device, "metric": "m", "value": 1.0,
+           "vs_baseline": 1.0,
+           "families": {
+               f: {"runs": 3, "compiles": 1, "seconds": 0.01,
+                   "expected": None,
+                   "achieved_flops_per_s": None,
+                   "achieved_bytes_per_s": bps,
+                   "utilization_hbm": None,
+                   "utilization_flops": None}
+               for f, bps in fams.items()}}
+    if mode is not None:
+        rec["mode"] = mode
+    if gap_ms_per_step is not None:
+        rec["dispatch_gap"] = {"steps": 20, "count": 80,
+                               "total_ms": gap_ms_per_step * 20,
+                               "ms_per_step": gap_ms_per_step}
+    return rec
 
 
 def _perf_ledger():
@@ -385,6 +417,100 @@ class TestPerfLedger:
         assert v["pass"]
         fam = v["configs"]["decode"]["families"]["engine_decode"]
         assert fam["ratio_vs_history"] is None    # no same-device prior
+
+    def test_modes_baseline_independently(self, tmp_path):
+        # ISSUE 10: batched and per_node dispatch records are separate
+        # baseline groups — per_node's (larger) gap must not read as a
+        # regression baseline for batched, nor vice versa
+        pl = _perf_ledger()
+        p = str(tmp_path / "ledger.jsonl")
+        self._write(p, [
+            _ledger_record("rev_a", "dispatch", {}, mode="per_node",
+                           gap_ms_per_step=0.2),
+            _ledger_record("rev_a", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.01),
+            _ledger_record("rev_b", "dispatch", {}, mode="per_node",
+                           gap_ms_per_step=0.21),
+            _ledger_record("rev_b", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.012),
+        ])
+        records, _ = pl.load(p)
+        v = pl.check(records, tol=0.2)
+        assert v["pass"]
+        assert set(v["configs"]) == {"dispatch[per_node]",
+                                     "dispatch[batched]"}
+        g = v["configs"]["dispatch[batched]"]["dispatch_gap"]
+        assert g["baseline_rev"] == "rev_a"
+        assert not g["regressed"]
+
+    def test_dispatch_gap_regression_fails_per_mode(self, tmp_path):
+        pl = _perf_ledger()
+        p = str(tmp_path / "ledger.jsonl")
+        self._write(p, [
+            _ledger_record("rev_a", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.01),
+            _ledger_record("rev_b", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.05),   # 5x the gap
+        ])
+        records, _ = pl.load(p)
+        v = pl.check(records, tol=0.2)
+        assert not v["pass"]
+        g = v["configs"]["dispatch[batched]"]["dispatch_gap"]
+        assert g["regressed"]
+        assert g["ratio_vs_history"] == pytest.approx(5.0)
+        # same-revision gap deltas report, never fail (box noise)
+        self._write(p, [
+            _ledger_record("rev_a", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.01),
+            _ledger_record("rev_a", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.05),
+        ])
+        records, _ = pl.load(p)
+        assert pl.check(records, tol=0.2)["pass"]
+
+    def test_zero_gap_baseline_has_finite_sensitivity(self, tmp_path):
+        # the routine batched result is ms_per_step=0.0 (one fused
+        # dispatch per backward, zero gaps): timer jitter above it
+        # must NOT read as a regression — the absolute floor applies
+        pl = _perf_ledger()
+        p = str(tmp_path / "ledger.jsonl")
+        self._write(p, [
+            _ledger_record("rev_a", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.0),
+            _ledger_record("rev_b", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.004),   # < floor
+        ])
+        records, _ = pl.load(p)
+        assert pl.check(records, tol=0.2)["pass"]
+        # but a real gap reappearing over a zero baseline still fails
+        self._write(p, [
+            _ledger_record("rev_a", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.0),
+            _ledger_record("rev_b", "dispatch", {}, mode="batched",
+                           gap_ms_per_step=0.1),
+        ])
+        records, _ = pl.load(p)
+        v = pl.check(records, tol=0.2)
+        assert not v["pass"]
+        assert v["configs"]["dispatch[batched]"][
+            "dispatch_gap"]["regressed"]
+
+    def test_autotune_sweeps_render_in_trajectory(self, tmp_path):
+        pl = _perf_ledger()
+        p = str(tmp_path / "ledger.jsonl")
+        rec = _ledger_record("rev_a", "gpt2s", {"train_step": 1e9})
+        rec["autotune_sweeps"] = [{
+            "key": ["fwd", 2048], "device": "TPU_v5e",
+            "candidates": {"(256, 1024)": 0.002, "(512, 512)": 0.001},
+            "winner": [512, 512], "bw_window": [233e9, 314e9],
+            "window_validated": True, "persisted": True}]
+        self._write(p, [rec])
+        records, _ = pl.load(p)
+        table = pl.trajectory(records)
+        assert "autotune" in table and "fwd|2048" in table
+        assert "validated=True" in table
+        # sweeps never affect the regression verdict
+        assert pl.check(records, tol=0.2)["pass"]
 
     def test_missing_ledger_is_loud(self, tmp_path):
         pl = _perf_ledger()

@@ -31,5 +31,16 @@ PATH_EXEMPTIONS = {
 }
 
 
+# eager-dispatch hot path: the host-clock audit (purity rule
+# host-clock-in-dispatch) inventories wall-clock reads ONLY under
+# these prefixes — a stray perf_counter in the per-node/fused backward
+# loop or the op dispatcher is pure per-dispatch overhead (ROADMAP
+# item 4), so every site must be justified into the baseline
+DISPATCH_CLOCK_AUDIT_PATHS = (
+    "paddle_tpu/autograd/",
+    "paddle_tpu/ops/registry.py",
+)
+
+
 def disabled_for(path: str) -> FrozenSet[str]:
     return PATH_EXEMPTIONS.get(path, frozenset())

@@ -14,7 +14,9 @@ legacy ``collect_series``/``check`` API from here.
 Conventions enforced for metrics (unchanged from the legacy tool):
   * every series name starts with the ``paddle_tpu_`` prefix
   * monotonic counters end in ``_total``
-  * histograms carry a base unit suffix (``_seconds`` or ``_bytes``)
+  * histograms carry a base unit suffix (``_seconds``, ``_bytes``, or
+    ``_size`` for dimensionless item counts — the Prometheus
+    convention for e.g. batch sizes)
   * gauges do NOT end in ``_total`` (that suffix promises monotonicity)
   * every registration carries a NON-EMPTY help string literal
   * every registered name appears VERBATIM in README.md
@@ -29,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 from ..core import Rule, register
 from . import _util as U
 
-_UNIT_SUFFIXES = ("_seconds", "_bytes")
+_UNIT_SUFFIXES = ("_seconds", "_bytes", "_size")
 
 # ---------------------------------------------------------------------------
 # legacy API (tools/check_metric_names.py shim imports these verbatim)

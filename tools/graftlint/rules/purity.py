@@ -216,6 +216,48 @@ class HostSyncInTrace(Rule):
                         "jax trace")
 
 
+_CLOCK_LEAVES = ("time", "perf_counter", "perf_counter_ns",
+                 "monotonic", "monotonic_ns")
+
+
+@register
+class HostClockInDispatch(Rule):
+    id = "host-clock-in-dispatch"
+    family = "purity"
+    severity = "warning"
+    invariant = ("wall-clock reads (time.time/perf_counter/monotonic) "
+                 "on the eager dispatch hot path (autograd/, "
+                 "ops/registry.py) are per-dispatch host overhead: "
+                 "every site is inventoried and carries a baseline "
+                 "justification — gap-measurement sites must be one "
+                 "flag check when observability is off")
+    history = ("the dispatch-gap profiler (PR 8) and the batched "
+               "backward engine (ISSUE 10) both live on this path; "
+               "an unguarded clock read per grad node is exactly the "
+               "class of overhead that kept eager_over_trainstep at "
+               "1.74")
+    baseline_note = ("host-clock-in-dispatch: audited wall-clock read "
+                     "on the dispatch hot path — keep behind the "
+                     "observability flag")
+
+    def check(self, mod):
+        from .. import config as _cfg
+        if not any(mod.path == p or
+                   (p.endswith("/") and mod.path.startswith(p))
+                   for p in _cfg.DISPATCH_CLOCK_AUDIT_PATHS):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = U.dotted(node.func) or ""
+            if d.startswith(("time.", "_time.")) and \
+                    d.split(".")[-1] in _CLOCK_LEAVES:
+                yield self.finding(
+                    mod, node.lineno,
+                    f"{d}() reads the host clock on the eager "
+                    "dispatch hot path")
+
+
 @register
 class HostSync(Rule):
     id = "host-sync"

@@ -25,7 +25,13 @@ hot path in this repo is bandwidth-dominated, see BENCH_EXTRA).
   * a family present in every prior record of a config but MISSING
     from the latest fails (an instrumented path silently stopped
     running — the regression observability itself would otherwise
-    hide).
+    hide);
+  * records carrying a backward dispatch `mode` (bench.py --config
+    dispatch writes one per mode) are baselined per (config, mode),
+    and their `dispatch_gap.ms_per_step` is checked the same way
+    bytes/s is — a latest gap total ABOVE (1 + tol) x the best
+    prior-revision record for the same (config, mode) fails, so the
+    batched engine's host-gap win cannot silently erode.
 
 Records keep absolute achieved rates, so cross-revision diffs carry
 the same box-noise caveat as any non-interleaved comparison — the
@@ -72,15 +78,39 @@ def _achieved(fam_rec) -> float:
     return float(v) if v else 0.0
 
 
+def _config_key(rec) -> str:
+    """Baseline grouping key: config, suffixed with the backward
+    dispatch mode when present — batched and per_node records of the
+    dispatch config baseline independently."""
+    config = rec.get("config", "?")
+    mode = rec.get("mode")
+    # a DISPLAY label, not an executable-cache key: both components
+    # are strings straight from the record, no coercion to hide
+    return f"{config}[{mode}]" if mode else config  # graftlint: disable=unstable-cache-key
+
+
+# a gap delta below this is timer jitter, not a regression — it gives
+# the dispatch-gap check a finite threshold even over a 0.0 baseline
+GAP_FLOOR_MS_PER_STEP = 0.01
+
+
+def _gap_ms(rec):
+    gap = rec.get("dispatch_gap")
+    if not isinstance(gap, dict):
+        return None
+    v = gap.get("ms_per_step")
+    return float(v) if v is not None else None
+
+
 def check(records, tol: float, only_config=None) -> dict:
-    """Diff the LATEST record per config against that config's ledger
-    history. Returns the verdict dict (see module docstring)."""
+    """Diff the LATEST record per (config, mode) against that group's
+    ledger history. Returns the verdict dict (see module docstring)."""
     by_config = {}
     for _ln, rec in records:
-        by_config.setdefault(rec.get("config", "?"), []).append(rec)
+        by_config.setdefault(_config_key(rec), []).append(rec)
     verdict = {"pass": True, "tol": tol, "configs": {}}
     for config, recs in sorted(by_config.items()):
-        if only_config and config != only_config:
+        if only_config and config.split("[", 1)[0] != only_config:
             continue
         latest = recs[-1]
         # baselines must share the latest record's DEVICE: achieved
@@ -120,6 +150,34 @@ def check(records, tol: float, only_config=None) -> dict:
             if gone:
                 out["missing_families"] = gone
                 out["pass"] = False
+        # dispatch-gap regression: the gap total is a COST, so the
+        # mirror of the bytes/s rule — latest above (1 + tol) x the
+        # best (lowest) prior-revision gap for this (config, mode)
+        # fails; same-rev priors report-only, same-device only. An
+        # absolute floor keeps a 0.0 baseline (the routine batched
+        # result: one fused dispatch per backward, zero gaps) from
+        # giving the check infinite sensitivity to timer jitter.
+        cur_gap = _gap_ms(latest)
+        if cur_gap is not None:
+            gout = {"ms_per_step": cur_gap, "ratio_vs_history": None,
+                    "baseline_rev": None, "regressed": False}
+            prior = [(_gap_ms(prev), prev.get("rev"))
+                     for prev in history]
+            prior = [p for p in prior if p[0] is not None]
+            other_rev = [p for p in prior if p[1] != latest.get("rev")]
+            pool = other_rev or prior
+            if pool:
+                best_gap, best_rev = min(pool)
+                if best_gap > 0:
+                    gout["ratio_vs_history"] = round(
+                        cur_gap / best_gap, 4)
+                gout["baseline_rev"] = best_rev
+                if best_rev != latest.get("rev") and cur_gap > max(
+                        best_gap * (1.0 + tol),
+                        best_gap + GAP_FLOOR_MS_PER_STEP):
+                    gout["regressed"] = True
+                    out["pass"] = False
+            out["dispatch_gap"] = gout
         verdict["configs"][config] = out
         verdict["pass"] = verdict["pass"] and out["pass"]
     if only_config and not verdict["configs"]:
@@ -129,19 +187,34 @@ def check(records, tol: float, only_config=None) -> dict:
 
 
 def trajectory(records) -> str:
-    """Human table: one line per (record, family) in ledger order."""
-    lines = [f"{'config':<16} {'rev':<19} {'family':<16} "
+    """Human table: one line per (record, family) in ledger order,
+    plus a gap line per record carrying a dispatch_gap and a sweep
+    line per recorded autotune sweep."""
+    lines = [f"{'config':<22} {'rev':<19} {'family':<16} "
              f"{'runs':>5} {'GB/s':>9} {'util_hbm':>9} {'util_flops':>10}"]
     for _ln, rec in records:
+        ckey = _config_key(rec)
         for family, f in sorted(rec["families"].items()):
             bps = f.get("achieved_bytes_per_s")
             uh, uf = f.get("utilization_hbm"), f.get("utilization_flops")
             lines.append(
-                f"{rec.get('config', '?'):<16} {rec.get('rev', '?'):<19} "
+                f"{ckey:<22} {rec.get('rev', '?'):<19} "
                 f"{family:<16} {f.get('runs', 0):>5} "
                 f"{'-' if not bps else f'{bps / 1e9:9.3f}':>9} "
                 f"{'-' if uh is None else f'{uh:9.4f}':>9} "
                 f"{'-' if uf is None else f'{uf:10.4f}':>10}")
+        gap = _gap_ms(rec)
+        if gap is not None:
+            lines.append(f"{ckey:<22} {rec.get('rev', '?'):<19} "
+                         f"{'(dispatch gap)':<16} "
+                         f"{gap:9.4f} ms/step")
+        for sw in rec.get("autotune_sweeps", ()):
+            lines.append(
+                f"{ckey:<22} {rec.get('rev', '?'):<19} (autotune "
+                f"{'|'.join(str(p) for p in sw.get('key', []))}: "
+                f"winner={tuple(sw.get('winner', ()))} "
+                f"validated={sw.get('window_validated')} "
+                f"persisted={sw.get('persisted')})")
     return "\n".join(lines)
 
 
