@@ -10,9 +10,28 @@ NIC, with the same master-endpoint rendezvous the launch CLI uses.
 
 Surface parity: init_rpc / rpc_sync / rpc_async / get_worker_info /
 get_all_worker_infos / get_current_worker_info / shutdown.
+
+Beyond parity (the fleet observability plane rides this layer):
+
+* **Rendezvous-free serving.** `serve()` starts a standalone call
+  server (same HMAC frames, same handler) and `call_endpoint()` talks
+  straight to an ``ip:port`` — no world_size, no master. The fleet
+  obs aggregator serves this way so fleet membership stays elastic.
+* **Trace stitching.** Call frames carry the caller's ambient trace
+  context; the server handler adopts it, so a request crossing
+  processes renders as ONE connected chrome-trace tree — the client's
+  `rpc.client` span and the server's `rpc.server` span share a
+  trace_id (stitched once the server's events ship to an aggregator
+  or exporter). RPC also reports itself: client/server latency
+  histograms and request counters (see README series table).
+* **Counted rejections.** Frames failing HMAC auth (or truncated
+  mid-frame) increment `paddle_tpu_rpc_rejected_frames_total{reason=
+  bad_mac|short_frame}` and log the peer address — auth misconfig and
+  network flake are distinguishable instead of silently dropped.
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import socket
@@ -26,6 +45,56 @@ from concurrent.futures import ThreadPoolExecutor
 WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 
 _DEFAULT_RPC_TIMEOUT = 120.0
+
+_log = logging.getLogger("paddle_tpu.distributed.rpc")
+
+
+class RpcAuthError(ConnectionError):
+    """Frame failed HMAC authentication (wrong/missing token)."""
+
+
+class RpcShortFrame(ConnectionError):
+    """Peer closed mid-frame (truncated length/mac/payload)."""
+
+
+# lazy observability handles: rpc must stay importable without pulling
+# the observability package at module import (and the disabled-mode
+# path through every recorder below is a flag check on these handles)
+_OBS = None
+
+
+def _obs():
+    global _OBS
+    if _OBS is None:
+        from ...observability import metrics as _m
+        from ...observability import tracing as _t
+        r = _m.registry()
+        _OBS = {
+            "m": _m, "t": _t,
+            "client": r.histogram(
+                "paddle_tpu_rpc_client_seconds",
+                "caller-side wall time of one RPC round trip "
+                "(connect + send + remote execution + receive)"),
+            "server": r.histogram(
+                "paddle_tpu_rpc_server_seconds",
+                "server-side wall time of one remote call's handler "
+                "execution"),
+            "requests": r.counter(
+                "paddle_tpu_rpc_requests_total",
+                "RPC calls by side (client|server) and terminal "
+                "status: ok, err (remote exception shipped back), "
+                "net_error (transport failed before a reply)",
+                ("side", "status")),
+            "rejected": r.counter(
+                "paddle_tpu_rpc_rejected_frames_total",
+                "inbound frames dropped before unpickling: bad_mac = "
+                "HMAC authentication failure (token misconfig or a "
+                "hostile peer), short_frame = peer closed mid-frame "
+                "(network flake, port scan); peer address is logged "
+                "at warning level",
+                ("reason",)),
+        }
+    return _OBS
 
 
 def _rpc_token() -> bytes:
@@ -66,7 +135,7 @@ def _recv_msg(sock):
         while len(buf) < n:
             chunk = sock.recv(min(1 << 20, n - len(buf)))
             if not chunk:
-                raise ConnectionError(f"rpc peer closed {what}")
+                raise RpcShortFrame(f"rpc peer closed {what}")
             buf += chunk
         return buf
 
@@ -77,22 +146,66 @@ def _recv_msg(sock):
     if not _hmac.compare_digest(mac, want):
         # authenticate BEFORE unpickling: reject unauthenticated peers
         # without ever deserializing their payload
-        raise ConnectionError("rpc frame failed HMAC authentication")
+        raise RpcAuthError("rpc frame failed HMAC authentication")
     return pickle.loads(buf)
+
+
+def _count_rejected(exc: ConnectionError, peer) -> None:
+    """Account an inbound frame the handler refused: counted metric +
+    peer-address log instead of a silent drop, so fleet debugging can
+    tell auth misconfig from network flake. Counting bypasses the
+    enabled flag (SLO-breach precedent): security accounting must not
+    depend on hot-path recording being on."""
+    reason = "bad_mac" if isinstance(exc, RpcAuthError) else "short_frame"
+    try:
+        _obs()["rejected"].labels(reason=reason)._value += 1
+    except Exception:
+        pass
+    addr = f"{peer[0]}:{peer[1]}" if isinstance(peer, tuple) \
+        and len(peer) >= 2 else repr(peer)
+    _log.warning("rpc frame rejected (%s) from %s: %s",
+                 reason, addr, exc)
 
 
 class _RpcHandler(socketserver.BaseRequestHandler):
     def handle(self):
         try:
             kind, body = _recv_msg(self.request)
+        except (RpcAuthError, RpcShortFrame) as e:
+            _count_rejected(e, self.client_address)
+            return
         except ConnectionError:
             return
         if kind == "call":
-            fn, args, kwargs = body
+            # frames are (fn, args, kwargs) pre-trace-context peers or
+            # (fn, args, kwargs, ctx) — ctx is the caller's
+            # (trace_id, span_id), adopted here so the server-side
+            # span joins the caller's tree (one connected cross-process
+            # trace once these events reach a common exporter)
+            fn, args, kwargs = body[0], body[1], body[2]
+            ctx = body[3] if len(body) > 3 else None
+            o = _obs()
+            t0 = time.perf_counter()
+            adopt = sp = None
+            if ctx is not None and o["t"].enabled():
+                adopt = o["t"].trace_context(ctx[0], ctx[1])
+                adopt.__enter__()
+                sp = o["t"].span("rpc.server",
+                                 fn=getattr(fn, "__name__", "?"))
+                sp.__enter__()
             try:
                 result = ("ok", fn(*args, **kwargs))
             except Exception as e:  # ship the exception back
                 result = ("err", e)
+            finally:
+                if sp is not None:
+                    sp.end()
+                if adopt is not None:
+                    adopt.__exit__(None, None, None)
+            if o["m"]._ENABLED:
+                o["server"].observe(time.perf_counter() - t0)
+                o["requests"].labels(side="server",
+                                     status=result[0]).inc()
             try:
                 _send_msg(self.request, result)
             except Exception:
@@ -211,18 +324,77 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     globals()["_world_size"] = world_size
 
 
+def _call_endpoint(ip, port, fn, args, kwargs, timeout, to=None):
+    """One authenticated call frame to ip:port — the shared client
+    path under rpc_sync/rpc_async (named workers) and call_endpoint
+    (rendezvous-free peers like the fleet obs aggregator). Ships the
+    ambient trace context so the server-side span joins the caller's
+    tree; records the client latency histogram + request counter."""
+    o = _obs()
+    sp = None
+    ctx = None
+    if o["t"].enabled():
+        sp = o["t"].span("rpc.client",
+                         fn=getattr(fn, "__name__", "?"),
+                         to=to if to is not None else f"{ip}:{port}")
+        sp.__enter__()
+        ctx = (sp.trace_id, sp.span_id)
+    t0 = time.perf_counter()
+    status = "net_error"
+    # untraced calls keep the legacy 3-tuple frame: a caller without
+    # trace context stays wire-compatible with a server running the
+    # pre-trace-context revision (mixed-revision fleets are exactly
+    # what the skew machinery upstream exists for)
+    body = (fn, tuple(args or ()), dict(kwargs or {}))
+    if ctx is not None:
+        body = body + (ctx,)
+    try:
+        with socket.create_connection((ip, int(port)),
+                                      timeout=timeout or None) as s:
+            _send_msg(s, ("call", body))
+            status, payload = _recv_msg(s)
+    finally:
+        if sp is not None:
+            sp.end()
+        if o["m"]._ENABLED:
+            o["client"].observe(time.perf_counter() - t0)
+            o["requests"].labels(side="client", status=status).inc()
+    if status == "err":
+        raise payload
+    return payload
+
+
 def _invoke(to, fn, args, kwargs, timeout):
     info = _workers.get(to)
     if info is None:
         raise ValueError(f"unknown rpc worker {to!r}; known: "
                          f"{sorted(_workers)}")
-    with socket.create_connection((info.ip, info.port),
-                                  timeout=timeout or None) as s:
-        _send_msg(s, ("call", (fn, tuple(args or ()), dict(kwargs or {}))))
-        status, payload = _recv_msg(s)
-    if status == "err":
-        raise payload
-    return payload
+    return _call_endpoint(info.ip, info.port, fn, args, kwargs,
+                          timeout, to=to)
+
+
+def call_endpoint(endpoint, fn, args=None, kwargs=None,
+                  timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking call straight to an `ip:port` (string or (ip, port)
+    tuple) without group rendezvous — the peer just needs a serve()d
+    call handler and the same HMAC token. Remote exceptions
+    propagate like rpc_sync."""
+    if isinstance(endpoint, str):
+        ip, port = endpoint.rsplit(":", 1)
+    else:
+        ip, port = endpoint
+    return _call_endpoint(ip, int(port), fn, args, kwargs, timeout)
+
+
+def serve(bind: str = "127.0.0.1", port: int = 0):
+    """Start a standalone call server (same frames, same handler as
+    init_rpc's, no rendezvous). Returns (server, "ip:port"); stop it
+    with server.shutdown(); server.server_close()."""
+    srv = _Server((bind, int(port)), _RpcHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    ip, p = srv.server_address[:2]
+    return srv, f"{ip}:{p}"
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
@@ -243,8 +415,14 @@ class _Future:
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
     """Non-blocking remote call returning a future with .wait()
-    (ref: rpc.py:183)."""
-    return _Future(_executor.submit(_invoke, to, fn, args, kwargs, timeout))
+    (ref: rpc.py:183). The caller's contextvars snapshot rides to the
+    executor thread, so the ambient trace context stitches the async
+    call into the caller's tree exactly like rpc_sync — without it the
+    rpc.client span would start a fresh, disconnected trace."""
+    import contextvars
+    ctx = contextvars.copy_context()
+    return _Future(_executor.submit(
+        ctx.run, _invoke, to, fn, args, kwargs, timeout))
 
 
 def get_worker_info(name):
@@ -259,10 +437,13 @@ def get_current_worker_info():
     return _current
 
 
-def shutdown():
-    """Barrier, then stop the local server (ref: rpc.py:278)."""
+def shutdown(graceful: bool = True):
+    """Barrier, then stop the local server (ref: rpc.py:278).
+    graceful=False skips the group barrier — for teardown paths where
+    peers may already be dead (a chaos kill) and waiting on every rank
+    would hang forever."""
     global _server, _executor, _master_sock
-    if _current is not None:
+    if graceful and _current is not None:
         _master_call(globals()["_master_endpoint"], "barrier",
                      ("shutdown", globals()["_world_size"], _current.rank))
     if _executor is not None:

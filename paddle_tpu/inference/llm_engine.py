@@ -535,6 +535,11 @@ class LLMEngine:
         first mismatch rolls the KV lease back. Greedy outputs stay
         bit-identical with speculation on or off (greedy decoding
         only: do_sample=True is refused)."""
+        # fleet identity plumbing: a bare engine process ships its
+        # series as process_role="engine" (weak suggestion — an
+        # enclosing Router or an explicit set_identity outranks it)
+        from ..observability import fleet as _ofleet
+        _ofleet.suggest_role("engine")
         cfg = model.config
         self.model = model
         self.fam = _family_for(model)

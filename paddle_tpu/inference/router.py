@@ -274,6 +274,13 @@ class Router:
         map (the router is a long-lived front-end; per-session state
         must not grow with total sessions ever seen — an evicted
         session just falls back to the prefix peek / least-loaded)."""
+        # fleet identity plumbing: a process fronting replicas ships
+        # its series as process_role="router" unless the launcher
+        # pinned something explicit (set_identity wins; suggested
+        # BEFORE the replica engines construct so their weaker
+        # "engine" suggestion does not name a router process)
+        from ..observability import fleet as _ofleet
+        _ofleet.suggest_role("router")
         self.replicas = ReplicaSet(engine_factory, n_replicas)
         self.affinity = bool(affinity)
         self.max_inflight = max_inflight

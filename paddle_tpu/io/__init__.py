@@ -55,11 +55,11 @@ def _merge_farewell(payload) -> None:
     parent: metric snapshot merges additively, worker-side trace
     events append to the parent ring verbatim (their pid distinguishes
     them in exports; perf_counter is CLOCK_MONOTONIC on Linux, so the
-    timestamps interleave correctly)."""
-    if not payload:
-        return
-    _om.registry().merge(payload.get("metrics"))
-    _ot.ingest(payload.get("trace"))
+    timestamps interleave correctly). The payload is a fleet bundle
+    (observability.fleet) — the worker farewell and the standing fleet
+    obs agent share one wire format and one merge path."""
+    from ..observability import fleet as _ofleet
+    _ofleet.merge_bundle_local(payload)
 
 
 class Dataset:

@@ -293,21 +293,23 @@ def worker_main(wid, num_workers, payload_bytes, idx_batches, out_queue,
             if not placed:      # consumer went away: free the payload
                 discard(payload)
                 return
-        # farewell carries this worker's metrics snapshot (None when
-        # observability is off). Stop-aware like the batch puts — an
-        # unbounded put would block against a full queue after early
-        # consumer exit and stall the parent's join-then-drain teardown
-        # — but always attempt at least ONCE: the parent sets stop the
-        # instant it consumes the last batch, and that common race must
-        # not drop the farewell (the parent's post-join drain merges it)
+        # farewell carries this worker's observability as a fleet
+        # bundle (None when observability is off) — the SAME wire
+        # format and merge path the standing fleet obs agent uses
+        # (observability.fleet), just one-shot. Stop-aware like the
+        # batch puts — an unbounded put would block against a full
+        # queue after early consumer exit and stall the parent's
+        # join-then-drain teardown — but always attempt at least ONCE:
+        # the parent sets stop the instant it consumes the last batch,
+        # and that common race must not drop the farewell (the
+        # parent's post-join drain merges it)
         snap = None
         if wm is not None or wt is not None:
-            snap = {}
-            if wm is not None:
-                from ..observability import metrics as _om
-                snap["metrics"] = _om.registry().snapshot()
-            if wt is not None:
-                snap["trace"] = wt.events()
+            from ..observability import fleet as _ofleet
+            _ofleet.set_identity(process=f"io-worker-{wid}",
+                                 role="io-worker")
+            snap = _ofleet.worker_farewell(metrics=wm is not None,
+                                           trace=wt is not None)
         while True:
             try:
                 out_queue.put(("done", wid, snap), timeout=0.2)

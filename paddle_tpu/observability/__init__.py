@@ -29,7 +29,12 @@ numbers from. Built-in instrumentation (recorded only while enabled):
 Sub-surfaces: `observability.slo` (declarative latency objectives
 evaluated from the registry), `observability.flight` (anomaly flight
 recorder — atomic metrics+trace bundles on slow steps, deadline
-misses, preemption storms, fault-point fires, SLO breaches).
+misses, preemption storms, fault-point fires, SLO breaches), and
+`observability.fleet` (the cross-process plane: per-process obs
+agents ship sequence-numbered metric deltas + trace events +
+heartbeats over the HMAC RPC layer to an aggregator that merges them
+under a `process` label and publishes fleet health — see README
+"Fleet observability").
 
 Quick start::
 
@@ -47,10 +52,10 @@ boundaries (the DataLoader does this automatically for its workers,
 shipping trace events alongside)."""
 from __future__ import annotations
 
-from . import flight, metrics, perf, slo, tracing  # noqa: F401
+from . import fleet, flight, metrics, perf, slo, tracing  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, registry,
-    DEFAULT_BUCKETS,
+    DEFAULT_BUCKETS, MergeSkewError,
 )
 from .tracing import (  # noqa: F401
     span, current_trace, trace_context, export_chrome_trace,
@@ -63,9 +68,9 @@ __all__ = [
     "reset", "to_prometheus", "to_json", "span", "current_trace",
     "trace_context", "trace_events", "trace_clear",
     "export_chrome_trace", "export_jsonl", "summary",
-    "metrics", "tracing", "slo", "flight", "perf", "SLO",
+    "metrics", "tracing", "slo", "flight", "perf", "fleet", "SLO",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "DEFAULT_BUCKETS",
+    "DEFAULT_BUCKETS", "MergeSkewError",
 ]
 
 
@@ -88,8 +93,12 @@ def snapshot() -> dict:
     return registry().snapshot()
 
 
-def merge(snap: dict) -> None:
-    registry().merge(snap)
+def merge(snap: dict, on_skew: str = "raise") -> list:
+    """Aggregate a snapshot() into the process-global registry; see
+    MetricsRegistry.merge for the schema-skew contract (raise a
+    MergeSkewError by default, or route skewed series to quarantined
+    names with on_skew="quarantine")."""
+    return registry().merge(snap, on_skew=on_skew)
 
 
 def reset() -> None:

@@ -35,7 +35,8 @@ from typing import Dict, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "enable", "disable", "enabled", "DEFAULT_BUCKETS",
-    "quantile_from_buckets", "fraction_le",
+    "quantile_from_buckets", "fraction_le", "MergeSkewError",
+    "quarantine_name",
 ]
 
 # module-global so instrumented call sites pay exactly one attribute
@@ -410,21 +411,123 @@ class MetricsRegistry:
             out[name] = rec
         return out
 
-    def merge(self, snap: dict) -> None:
+    def merge(self, snap: dict, on_skew: str = "raise") -> List[str]:
         """Aggregate a snapshot() (typically from a DataLoader worker
-        process) into this registry: counters and histograms add;
-        gauges add too (a worker gauge is that worker's contribution —
-        e.g. bytes in flight — so sum is the meaningful aggregate).
-        Merging bypasses the enabled flag: the child only has a
-        snapshot to ship because recording was on when it mattered."""
+        process or a fleet obs agent) into this registry: counters and
+        histograms add; gauges add too (a worker gauge is that worker's
+        contribution — e.g. bytes in flight — so sum is the meaningful
+        aggregate). Merging bypasses the enabled flag: the child only
+        has a snapshot to ship because recording was on when it
+        mattered.
+
+        Schema skew (a peer running a different revision ships a series
+        whose kind / label names / bucket boundaries / value shape
+        differ from the local registration) would silently corrupt
+        counts if merged additively. on_skew="raise" (default) raises
+        MergeSkewError before touching any series of the skewed metric;
+        on_skew="quarantine" merges the skewed metric under
+        quarantine_name(name, kind) with the INCOMING schema, leaving
+        the local series untouched — the fleet aggregator uses this so
+        one stale process cannot poison (or stall) the whole plane.
+        Returns the list of quarantined series names (empty normally).
+        Two-phase: every metric of the snapshot is resolved (including
+        quarantine routing) and every series' value shape validated
+        BEFORE any count is mutated, so a raise anywhere leaves the
+        registry's counts exactly as they were — no half-merged
+        snapshot (quarantine registrations made during the failed
+        resolve pass may remain, but they hold no counts)."""
         if not snap:
-            return
+            return []
+        if on_skew not in ("raise", "quarantine"):
+            raise ValueError(f"on_skew must be 'raise' or 'quarantine',"
+                             f" got {on_skew!r}")
+        quarantined: List[str] = []
+        resolved = []               # (metric, [(key, val)]) per name
         for name, rec in snap.items():
-            m = self._get_or_create(rec["kind"], name, rec["help"],
-                                    tuple(rec["labelnames"]),
-                                    rec.get("buckets"))
-            for key, val in rec["series"].items():
+            try:
+                kind = rec["kind"]
+                labelnames = tuple(rec["labelnames"])
+                series_in = rec["series"]
+            except (TypeError, KeyError) as e:
+                raise MergeSkewError(
+                    f"merge skew on {name!r}: malformed snapshot "
+                    f"record ({e!r})") from e
+            if kind not in _KIND_CLASS:
+                # a kind this revision doesn't know cannot be stored,
+                # quarantined or not — MergeSkewError either way so the
+                # caller's skew handling (not a bare KeyError) decides
+                raise MergeSkewError(
+                    f"merge skew on {name!r}: unknown metric kind "
+                    f"{kind!r} (peer runs a newer revision?)")
+            try:
+                m = self._get_or_create(kind, name, rec["help"],
+                                        labelnames, rec.get("buckets"))
+            except ValueError as e:
+                local = self.get(name)
+                detail = (
+                    f"merge skew on {name!r}: incoming "
+                    f"{rec['kind']}{labelnames}"
+                    + (f" buckets={tuple(rec['buckets'])}"
+                       if rec.get("buckets") is not None else "")
+                    + f" vs local {local.kind}{local.labelnames}"
+                    + (f" buckets={local.buckets}"
+                       if local.buckets is not None else ""))
+                if on_skew == "raise":
+                    raise MergeSkewError(detail) from e
+                qname = quarantine_name(name, rec["kind"])
+                try:
+                    m = self._get_or_create(
+                        rec["kind"], qname,
+                        rec["help"] + " (quarantined: schema skew "
+                        "against the local registration)",
+                        labelnames, rec.get("buckets"))
+                except ValueError as e2:
+                    # two DIFFERENT skewed schemas fighting over the
+                    # quarantine slot: no safe place left to put it
+                    raise MergeSkewError(
+                        detail + f"; quarantine slot {qname!r} is "
+                        "already taken by a different schema") from e2
+                quarantined.append(qname)
+            # validate every series' value TYPE and shape in the
+            # resolve pass — the mutation phase below must be unable
+            # to raise, or a malformed series mid-snapshot would leave
+            # earlier metrics half-added
+            series = []
+            for key, val in series_in.items():
                 key = tuple(key)
+                if len(key) != len(m.labelnames):
+                    raise MergeSkewError(
+                        f"merge skew on {name!r}: series key {key} has "
+                        f"{len(key)} label values, local schema has "
+                        f"{len(m.labelnames)} ({m.labelnames})")
+                if m.kind == "histogram":
+                    ok = (isinstance(val, dict)
+                          and isinstance(val.get("buckets"), list)
+                          and len(val["buckets"]) == len(m.buckets) + 1
+                          and all(isinstance(b, (int, float))
+                                  for b in val["buckets"])
+                          and isinstance(val.get("sum"), (int, float))
+                          and isinstance(val.get("count"), (int, float))
+                          and (not val["count"]
+                               or (isinstance(val.get("min"),
+                                              (int, float))
+                                   and isinstance(val.get("max"),
+                                                  (int, float)))))
+                    if not ok:
+                        raise MergeSkewError(
+                            f"merge skew on {name!r}: series {key} "
+                            "histogram value is malformed or its "
+                            "bucket count disagrees with the local "
+                            f"bounds ({len(m.buckets) + 1})")
+                elif not isinstance(val, (int, float)) \
+                        or isinstance(val, bool):
+                    raise MergeSkewError(
+                        f"merge skew on {name!r}: series {key} value "
+                        f"{type(val).__name__} is not numeric")
+                series.append((key, val))
+            resolved.append((m, series))
+        for m, series in resolved:  # mutation phase: cannot raise
+            for key, val in series:
                 child = m._children.get(key)
                 if child is None:
                     with m._lock:
@@ -440,6 +543,7 @@ class MetricsRegistry:
                         child._max = max(child._max, val["max"])
                 else:
                     child._value += val
+        return quarantined
 
     # -- exporters --
     def to_prometheus(self) -> str:
@@ -487,6 +591,31 @@ class MetricsRegistry:
                 rec["buckets"] = list(m.buckets)
             out[name] = rec
         return json.dumps(out, sort_keys=True)
+
+
+class MergeSkewError(ValueError):
+    """merge() found a snapshot series whose schema (kind, label names,
+    histogram bucket boundaries, or per-series value shape) differs
+    from the local registration. Merging it additively would silently
+    corrupt counts — a version-skewed peer's buckets would land in the
+    wrong bins — so the skew is surfaced instead: raised by default, or
+    routed to a quarantined series name with merge(on_skew=
+    "quarantine")."""
+
+
+def quarantine_name(name: str, kind: str) -> str:
+    """Series name a schema-skewed snapshot merges under in quarantine
+    mode — `_skew` spliced in BEFORE the convention-bearing suffix, so
+    the quarantined series still satisfies the naming rules (counters
+    end `_total`, histograms keep their unit suffix) and is grep-ably
+    derived from the original."""
+    if kind == "counter" and name.endswith("_total"):
+        return name[:-len("_total")] + "_skew_total"
+    if kind == "histogram":
+        for suf in ("_seconds", "_bytes", "_size"):
+            if name.endswith(suf):
+                return name[:-len(suf)] + "_skew" + suf
+    return name + "_skew"
 
 
 _GLOBAL = MetricsRegistry()

@@ -49,13 +49,22 @@ __all__ = [
     "span", "add_event", "events", "clear", "enable", "disable",
     "enabled", "set_capacity", "capacity", "export_chrome_trace",
     "export_jsonl", "current_trace", "trace_context", "new_trace_id",
-    "new_span_id", "ingest",
+    "new_span_id", "ingest", "appended_total", "events_with_total",
 ]
 
 _ENABLED = False
 _DEFAULT_CAPACITY = 65536
 _LOCK = threading.Lock()
 _RING: collections.deque = collections.deque(maxlen=_DEFAULT_CAPACITY)
+# events ever appended to the ring (monotonic — clear() does NOT reset
+# it): incremental consumers (the fleet obs agent) diff it against
+# their shipped high-water mark to know how many ring entries are new,
+# and how many scrolled out (or were cleared) before they could ship —
+# an honest drop count instead of a silent gap. Updated under _LOCK
+# together with the ring append, so events_with_total() can hand out a
+# CONSISTENT (ring copy, total) pair — the alignment incremental
+# consumers need to map ring positions to global event indices.
+_APPENDED = 0
 
 # ambient trace context: (trace_id, span_id) of the innermost open
 # span, or None at top level. contextvars (not a plain global) so
@@ -87,6 +96,26 @@ def set_capacity(n: int) -> None:
 
 def capacity() -> int:
     return _RING.maxlen
+
+
+def appended_total() -> int:
+    """Events ever appended (add_event + ingest), monotonic across
+    clear()/set_capacity(). `appended_total() - events-you-have-seen`
+    is the incremental-consumer read; the excess over `len(events())`
+    is what the ring dropped before anyone copied it out. For a copy
+    that is CONSISTENT with the total, use events_with_total()."""
+    return _APPENDED
+
+
+def events_with_total():
+    """(ring copy oldest-first, appended_total) captured atomically:
+    ring[i] is globally the (total - len(ring) + i)-th event ever
+    appended, so an incremental consumer holding a shipped high-water
+    mark can slice exactly the unshipped tail and count rotations as
+    drops — a racy separate read of the two could mis-align by
+    whatever landed in between."""
+    with _LOCK:
+        return list(_RING), _APPENDED
 
 
 def clear() -> None:
@@ -165,7 +194,13 @@ def add_event(name: str, ts_us: float, dur_us: float,
             ev["parent_id"] = trace[2]
     if args:
         ev["args"] = args
-    _RING.append(ev)      # deque.append is atomic under the GIL
+    global _APPENDED
+    # one uncontended lock per recorded event (noise next to the dict
+    # just built) buys the append-counter consistency the incremental
+    # consumers rely on; the disabled path never reaches here
+    with _LOCK:
+        _APPENDED += 1
+        _RING.append(ev)
 
 
 def ingest(evs) -> None:
@@ -176,7 +211,9 @@ def ingest(evs) -> None:
     mattered."""
     if not evs:
         return
+    global _APPENDED
     with _LOCK:
+        _APPENDED += len(evs)
         _RING.extend(evs)
 
 

@@ -160,6 +160,71 @@ class TestRegistry:
         assert out["sum"] == pytest.approx(5.0)
         assert out["min"] == 0.5 and out["max"] == 2.0
 
+    def test_merge_bucket_skew_raises_not_corrupts(self):
+        """A snapshot whose histogram bucket boundaries differ from
+        the local registration must raise MergeSkewError (bucket-wise
+        addition into the wrong bins is silent corruption), and the
+        local series must be untouched afterwards."""
+        obs.enable()
+        src = MetricsRegistry()
+        src.histogram("t_skw_seconds", "", buckets=(0.1, 1.0)) \
+            .observe(0.5)
+        dst = MetricsRegistry()
+        dst.histogram("t_skw_seconds", "", buckets=(0.5, 2.0)) \
+            .observe(0.2)
+        with pytest.raises(obs.MergeSkewError, match="merge skew"):
+            dst.merge(src.snapshot())
+        out = dst.snapshot()["t_skw_seconds"]["series"][()]
+        assert out["count"] == 1 and out["buckets"] == [1, 0, 0]
+
+    def test_merge_label_schema_skew_raises_not_corrupts(self):
+        obs.enable()
+        src = MetricsRegistry()
+        src.counter("t_skw_total", "", ("old_label",)) \
+            .labels(old_label="x").inc(3)
+        dst = MetricsRegistry()
+        dst.counter("t_skw_total", "", ("new_label",)) \
+            .labels(new_label="y").inc(1)
+        with pytest.raises(obs.MergeSkewError, match="merge skew"):
+            dst.merge(src.snapshot())
+        assert dst.snapshot()["t_skw_total"]["series"] == {("y",): 1}
+
+    def test_merge_skew_quarantine_mode(self):
+        """on_skew="quarantine": both skew directions merge under the
+        convention-preserving *_skew name, local series untouched —
+        the fleet aggregator's stance (one stale peer must not stall
+        the plane)."""
+        obs.enable()
+        src = MetricsRegistry()
+        src.histogram("t_skwq_seconds", "", buckets=(0.1,)).observe(0.05)
+        src.counter("t_skwq_total", "", ("lbl",)).labels(lbl="a").inc(2)
+        dst = MetricsRegistry()
+        dst.histogram("t_skwq_seconds", "", buckets=(0.5,)).observe(0.2)
+        dst.counter("t_skwq_total", "").inc(7)
+        q = dst.merge(src.snapshot(), on_skew="quarantine")
+        assert sorted(q) == ["t_skwq_skew_seconds", "t_skwq_skew_total"]
+        snap = dst.snapshot()
+        # local series untouched
+        assert snap["t_skwq_seconds"]["series"][()]["count"] == 1
+        assert snap["t_skwq_total"]["series"][()] == 7
+        # quarantined series carry the INCOMING schema + values
+        assert snap["t_skwq_skew_seconds"]["series"][()]["count"] == 1
+        assert snap["t_skwq_skew_total"]["series"][("a",)] == 2
+        # clean merges still return no quarantines
+        clean = MetricsRegistry()
+        clean.counter("t_skwq_clean_total", "").inc()
+        assert dst.merge(clean.snapshot(), on_skew="quarantine") == []
+
+    def test_merge_malformed_series_shape_raises(self):
+        obs.enable()
+        src = MetricsRegistry()
+        src.histogram("t_skwm_seconds", "").observe(0.5)
+        snap = src.snapshot()
+        snap["t_skwm_seconds"]["series"][()]["buckets"].append(1)
+        dst = MetricsRegistry()
+        with pytest.raises(obs.MergeSkewError, match="bucket count"):
+            dst.merge(snap)
+
     def test_merge_applies_while_disabled(self):
         # the parent may have turned recording off by the time a worker
         # farewell arrives; the shipped history still counts

@@ -21,6 +21,13 @@ Data sources (exactly one):
     python tools/obs_top.py --demo --once
     python tools/obs_top.py --json /run/paddle_tpu_metrics.json
     python tools/obs_top.py --bundle /var/log/flight/bundle_000001_* --once
+    python tools/obs_top.py --json /run/fleet.json --fleet
+
+--fleet renders only the fleet panel (per-process heartbeat age /
+staleness, bundle seq, inflight, tok/s) from a fleet aggregator's
+export (`observability.fleet.FleetAggregator.export_json`); without
+the flag the panel still appears under a full frame whenever the doc
+carries fleet series.
 
 --once prints one frame and exits (scriptable); without it the screen
 refreshes until Ctrl-C. Percentiles are estimated from the exported
@@ -105,6 +112,50 @@ def _hist_quantiles(doc, name, qs=(0.5, 0.95), prev=None):
 
 def _ms(x):
     return "-" if x is None else f"{x * 1e3:8.2f}ms"
+
+
+def render_fleet(doc, prev=None, dt=None) -> str:
+    """The fleet panel: one line per process from an aggregator export
+    (`FleetAggregator.to_json()` / `export_json`) — up/STALE from the
+    heartbeat-age vs process-up gauges, last accepted bundle seq,
+    inflight (that process's running-queue depth), token totals with a
+    between-frames tok/s when watching live — plus a fleet-plane
+    self-accounting line (bundles, duplicates, quarantined series,
+    agent drops). Empty string when the doc carries no fleet series."""
+    ages = {s["labels"]["process"]: s["value"] for s in
+            _series(doc, "paddle_tpu_fleet_heartbeat_age_seconds")}
+    if not ages:
+        return ""
+    lines = ["== fleet =="]
+    for proc in sorted(ages):
+        up = _value(doc, "paddle_tpu_fleet_process_up", process=proc)
+        seq = _value(doc, "paddle_tpu_fleet_last_seq", process=proc)
+        infl = _value(doc, "paddle_tpu_engine_queue_depth",
+                      queue="running", process=proc)
+        tok = _counter_sum(doc, "paddle_tpu_engine_events_total",
+                           event="decode_tokens", process=proc)
+        tps = None
+        if prev is not None and dt:
+            tps = (tok - _counter_sum(
+                prev, "paddle_tpu_engine_events_total",
+                event="decode_tokens", process=proc)) / dt
+        lines.append(
+            f"  {proc:<16} {'up' if up else 'STALE':<6} "
+            f"hb={ages[proc]:6.1f}s  seq={int(seq or 0):>4}  "
+            f"inflight={int(infl or 0):>3}  tok={int(tok):>8}"
+            + (f"  ({tps:8.1f} tok/s)" if tps is not None else ""))
+    bundles = _counter_sum(doc, "paddle_tpu_fleet_bundles_total")
+    dups = _counter_sum(doc, "paddle_tpu_fleet_duplicate_bundles_total")
+    quar = _counter_sum(doc, "paddle_tpu_fleet_quarantined_series_total")
+    drops = _counter_sum(doc,
+                         "paddle_tpu_fleet_agent_dropped_events_total")
+    totals = f"  bundles={int(bundles)}  dups={int(dups)}"
+    if quar:
+        totals += f"  quarantined={int(quar)}"
+    if drops:
+        totals += f"  agent drops={int(drops)}"
+    lines.append(totals)
+    return "\n".join(lines)
 
 
 def render(doc, prev=None, dt=None) -> str:
@@ -256,6 +307,10 @@ def render(doc, prev=None, dt=None) -> str:
         for s in fl:
             lines.append(f"  {s['labels']['reason']:<16} "
                          f"{int(s['value']):>4}")
+
+    fleet = render_fleet(doc, prev, dt)
+    if fleet:
+        lines.append(fleet)
     return "\n".join(lines)
 
 
@@ -301,6 +356,11 @@ def main():
                      help="run a synthetic workload, render one frame")
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render only the fleet panel (point --json at "
+                         "a FleetAggregator.export_json file for a "
+                         "live per-process heartbeat/inflight/capacity "
+                         "view)")
     ap.add_argument("--interval", type=float, default=2.0)
     args = ap.parse_args()
     if not (args.json or args.bundle or args.demo):
@@ -308,12 +368,13 @@ def main():
 
     if args.demo:
         _run_demo()
+    renderer = render_fleet if args.fleet else render
     prev = t_prev = None
     while True:
         doc = _load(args)
         now = time.perf_counter()
-        frame = render(doc, prev,
-                       None if t_prev is None else now - t_prev)
+        frame = renderer(doc, prev,
+                         None if t_prev is None else now - t_prev)
         if args.once or args.demo:
             print(frame)
             return 0

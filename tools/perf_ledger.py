@@ -31,7 +31,14 @@ hot path in this repo is bandwidth-dominated, see BENCH_EXTRA).
     and their `dispatch_gap.ms_per_step` is checked the same way
     bytes/s is — a latest gap total ABOVE (1 + tol) x the best
     prior-revision record for the same (config, mode) fails, so the
-    batched engine's host-gap win cannot silently erode.
+    batched engine's host-gap win cannot silently erode;
+  * records carrying a fleet `process_role` (observability.fleet's
+    `append_capacity_ledger` writes one per process) are baselined per
+    (config, process_role), and their `capacity.req_per_s` /
+    `capacity.tok_per_s` follow the bytes/s rule — a role's achieved
+    rate dropping below (1 - tol) x the best prior-revision record
+    fails, naming the role the elastic scaler is about to mis-size
+    from.
 
 Records keep absolute achieved rates, so cross-revision diffs carry
 the same box-noise caveat as any non-interleaved comparison — the
@@ -81,12 +88,17 @@ def _achieved(fam_rec) -> float:
 def _config_key(rec) -> str:
     """Baseline grouping key: config, suffixed with the backward
     dispatch mode when present — batched and per_node records of the
-    dispatch config baseline independently."""
+    dispatch config baseline independently — and with the fleet
+    process_role when present (observability.fleet capacity records:
+    prefill replicas and decode replicas of one fleet config baseline
+    independently, the way dispatch modes do)."""
     config = rec.get("config", "?")
     mode = rec.get("mode")
-    # a DISPLAY label, not an executable-cache key: both components
+    role = rec.get("process_role")
+    # a DISPLAY label, not an executable-cache key: all components
     # are strings straight from the record, no coercion to hide
-    return f"{config}[{mode}]" if mode else config  # graftlint: disable=unstable-cache-key
+    key = f"{config}[{mode}]" if mode else config  # graftlint: disable=unstable-cache-key
+    return f"{key}@{role}" if role else key  # graftlint: disable=unstable-cache-key
 
 
 # a gap delta below this is timer jitter, not a regression — it gives
@@ -110,7 +122,8 @@ def check(records, tol: float, only_config=None) -> dict:
         by_config.setdefault(_config_key(rec), []).append(rec)
     verdict = {"pass": True, "tol": tol, "configs": {}}
     for config, recs in sorted(by_config.items()):
-        if only_config and config.split("[", 1)[0] != only_config:
+        if only_config and config.split("[", 1)[0].split("@", 1)[0] \
+                != only_config:
             continue
         latest = recs[-1]
         # baselines must share the latest record's DEVICE: achieved
@@ -178,6 +191,36 @@ def check(records, tol: float, only_config=None) -> dict:
                     gout["regressed"] = True
                     out["pass"] = False
             out["dispatch_gap"] = gout
+        # fleet capacity regression: achieved rates are the bytes/s
+        # rule again — the latest record's req/s / tok/s below
+        # (1 - tol) x the best prior-revision record for the same
+        # (config, process_role) fails, so a fleet role cannot quietly
+        # lose capacity between revisions (the elastic scaler sizes
+        # fleets from these numbers). Same-rev priors report-only,
+        # same-device only, like every other check here.
+        cap = latest.get("capacity")
+        if isinstance(cap, dict):
+            out["capacity"] = {}
+            for rate_key in ("req_per_s", "tok_per_s"):
+                cur_rate = cap.get(rate_key)
+                rout = {"value": cur_rate, "ratio_vs_history": None,
+                        "baseline_rev": None, "regressed": False}
+                prior = [(prev.get("capacity", {}).get(rate_key),
+                          prev.get("rev")) for prev in history
+                         if isinstance(prev.get("capacity"), dict)]
+                prior = [p for p in prior if p[0]]
+                other_rev = [p for p in prior
+                             if p[1] != latest.get("rev")]
+                pool = other_rev or prior
+                if pool and cur_rate:
+                    best, best_rev = max(pool)
+                    rout["ratio_vs_history"] = round(cur_rate / best, 4)
+                    rout["baseline_rev"] = best_rev
+                    if best_rev != latest.get("rev") \
+                            and cur_rate / best < 1.0 - tol:
+                        rout["regressed"] = True
+                        out["pass"] = False
+                out["capacity"][rate_key] = rout
         verdict["configs"][config] = out
         verdict["pass"] = verdict["pass"] and out["pass"]
     if only_config and not verdict["configs"]:
@@ -208,6 +251,15 @@ def trajectory(records) -> str:
             lines.append(f"{ckey:<22} {rec.get('rev', '?'):<19} "
                          f"{'(dispatch gap)':<16} "
                          f"{gap:9.4f} ms/step")
+        cap = rec.get("capacity")
+        if isinstance(cap, dict):
+            req, tok = cap.get("req_per_s"), cap.get("tok_per_s")
+            lines.append(
+                f"{ckey:<22} {rec.get('rev', '?'):<19} "
+                f"{'(capacity)':<16} "
+                f"req/s={'-' if req is None else f'{req:.3f}'} "
+                f"tok/s={'-' if tok is None else f'{tok:.1f}'} "
+                f"window={cap.get('window_s', '-')}s")
         for sw in rec.get("autotune_sweeps", ()):
             lines.append(
                 f"{ckey:<22} {rec.get('rev', '?'):<19} (autotune "
