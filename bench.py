@@ -368,18 +368,33 @@ def _dispatch_batch_summary():
             "max": val["max"]}
 
 
+def _graph_cache_summary():
+    """paddle_tpu_backward_graph_cache_total counts for the BENCH
+    line: whole-graph trace cache hits/misses/bypasses. None when the
+    whole-graph engine recorded nothing."""
+    from paddle_tpu import observability as obs
+    if not obs.enabled():
+        return None
+    rec = obs.snapshot().get("paddle_tpu_backward_graph_cache_total")
+    out = {k[0]: int(v)
+           for k, v in (rec or {}).get("series", {}).items() if v}
+    return out or None
+
+
 def bench_dispatch(on_tpu):
     """Eager dispatch latency with the backward dispatch-mode A/B
-    (ISSUE 10): batched (fused single-consumer runs through
-    autograd.dispatch_queue) vs per_node (the legacy walker) vs the
-    whole-graph TrainStep — interleaved best-of-N windows in ONE
-    session, so the `eager_over_trainstep <= 1.5` claim and the
-    batched-vs-per-node delta are self-verifying. A dedicated
-    attribution pass per mode captures the dispatch-gap summary
-    (count, total, p50/p95, top ops — the NAMED host gaps) and, for
-    batched, the fused-run length histogram; both modes land as
-    separate records in perf_ledger.jsonl (tools/perf_ledger.py
-    --check flags a dispatch-gap regression per (config, mode))."""
+    (ISSUE 10/13): whole_graph (fan-in-crossing fused runs + the
+    whole-graph trace cache, the default) vs batched (PR 10
+    single-consumer chains) vs per_node (the legacy walker) vs the
+    compiled TrainStep — interleaved best-of-N windows in ONE session,
+    so the `eager_over_trainstep <= 1.2` claim and the inter-mode
+    deltas are self-verifying. Windows stop early once the ordering is
+    decisive (see below). A dedicated attribution pass per mode
+    captures the dispatch-gap summary (count, total, p50/p95, top ops
+    — the NAMED host gaps), the fused-run length histogram, and — for
+    whole_graph — the graph-cache hit/miss counts; each mode lands as
+    its own record in perf_ledger.jsonl (tools/perf_ledger.py --check
+    flags a dispatch-gap regression per (config, mode))."""
     import jax
     import paddle_tpu as pt
     from paddle_tpu import observability as obs
@@ -416,8 +431,9 @@ def bench_dispatch(on_tpu):
                 loss = eager_step()
             float(loss.numpy())
 
-    run_eager("per_node", 2)    # warm per-op executables
-    run_eager("batched", 2)     # warm the fused chain executable
+    run_eager("per_node", 2)      # warm per-op executables
+    run_eager("batched", 2)       # warm the fused chain executable
+    run_eager("whole_graph", 2)   # warm the whole-graph executable
 
     # the TrainStep variant gets ITS OWN modules/optimizer: the jitted
     # step donates its state, and the interleaved windows would feed
@@ -451,21 +467,42 @@ def bench_dispatch(on_tpu):
     # phase of the shared box, min-reduce de-biases the contention.
     # Observability is OFF for the timed windows — per_node records
     # one gap per grad node and TrainStep records nothing, so leaving
-    # it on would bias exactly the ratios this bench pins
+    # it on would bias exactly the ratios this bench pins.
+    # Early exit (the PR 7 deflake pattern): noise only ever INFLATES
+    # a window, so once a full window improves no minimum AND the
+    # mins already show the claimed orderings (both fused modes at or
+    # under per_node, whole_graph within the <=1.2 TrainStep target
+    # — whole_graph vs batched is NOT a claim: on this pure-chain
+    # model both dispatch the identical fused call and whole_graph
+    # pays its O(nodes) planning, so their ordering is noise),
+    # further windows can only confirm — stop instead of always
+    # burning all 8 on this noisy box. A window that still shows a
+    # flipped ordering keeps sampling (it is only ever noise).
     obs_was_on = obs.enabled()
     obs.disable()
     best = {"train": float("inf"), "per_node": float("inf"),
-            "batched": float("inf")}
+            "batched": float("inf"), "whole_graph": float("inf")}
+    windows_run = 0
     try:
-        for _ in range(windows):
-            for variant in ("train", "per_node", "batched"):
+        for w in range(windows):
+            improved = False
+            for variant in ("train", "per_node", "batched",
+                            "whole_graph"):
                 t0 = time.perf_counter()
                 if variant == "train":
                     run_train(steps)
                 else:
                     run_eager(variant, steps)
-                best[variant] = min(best[variant],
-                                    time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                if dt < best[variant]:
+                    best[variant] = dt
+                    improved = True
+            windows_run = w + 1
+            if (w >= 2 and not improved
+                    and best["whole_graph"] <= best["per_node"]
+                    and best["batched"] <= best["per_node"]
+                    and best["whole_graph"] <= 1.2 * best["train"]):
+                break               # decisively ordered — stop early
     finally:
         if obs_was_on:
             obs.enable()
@@ -475,45 +512,57 @@ def bench_dispatch(on_tpu):
     # its own (separate from the uninstrumented timed windows above)
     gap_by_mode = {}
     ledger_modes = []
-    for mode in ("per_node", "batched"):
+    for mode in ("per_node", "batched", "whole_graph"):
         obs.reset()
         run_eager(mode, steps)
         summ = _dispatch_gap_summary() or {"count": 0, "total_ms": 0.0}
-        if mode == "batched":
+        if mode != "per_node":
             batch = _dispatch_batch_summary()
             if batch:
                 summ["batch_size"] = batch
-        gap_by_mode[mode] = summ
-        total_ms = summ.get("total_ms", 0.0) or 0.0
-        ledger_modes.append({
+        rec = {
             "mode": mode,
             "families": perf.family_records(),
-            "dispatch_gap": {
-                "steps": steps,
-                "count": summ.get("count", 0),
-                "total_ms": round(total_ms, 3),
-                "ms_per_step": round(total_ms / steps, 4),
-            },
-        })
+            "dispatch_gap": None,       # filled below
+        }
+        if mode == "whole_graph":
+            gc = _graph_cache_summary()
+            if gc:
+                summ["graph_cache"] = gc
+                rec["graph_cache"] = gc
+        gap_by_mode[mode] = summ
+        total_ms = summ.get("total_ms", 0.0) or 0.0
+        rec["dispatch_gap"] = {
+            "steps": steps,
+            "count": summ.get("count", 0),
+            "total_ms": round(total_ms, 3),
+            "ms_per_step": round(total_ms / steps, 4),
+        }
+        ledger_modes.append(rec)
 
-    dt_t, dt_p, dt_b = best["train"], best["per_node"], best["batched"]
+    dt_t, dt_p = best["train"], best["per_node"]
+    dt_b, dt_w = best["batched"], best["whole_graph"]
     return {
         "metric": "eager_dispatch_steps_per_sec",
-        "value": round(steps / dt_b, 1),
+        "value": round(steps / dt_w, 1),
         "unit": "steps/s",
-        "vs_baseline": round(dt_t / dt_b, 4),
+        "vs_baseline": round(dt_t / dt_w, 4),
         "_ledger_modes": ledger_modes,
         "extra": {
             "trainstep_steps_per_sec": round(steps / dt_t, 1),
             "per_node_steps_per_sec": round(steps / dt_p, 1),
-            "eager_over_trainstep_time": round(dt_b / dt_t, 2),
+            "batched_steps_per_sec": round(steps / dt_b, 1),
+            "eager_over_trainstep_time": round(dt_w / dt_t, 2),
+            "eager_over_trainstep_batched": round(dt_b / dt_t, 2),
             "eager_over_trainstep_per_node": round(dt_p / dt_t, 2),
+            "whole_graph_over_batched_time": round(dt_w / dt_b, 4),
             "batched_over_per_node_time": round(dt_b / dt_p, 4),
             "exec_cache_entries": exec_cache_size(),
             "fused_chain_entries": dq.chain_cache_size(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "steps": steps,
             "windows": windows,
+            "windows_run": windows_run,
             "dispatch_gap": gap_by_mode,
         },
     }
@@ -1310,6 +1359,8 @@ def _append_perf_ledger(path, name, result, modes=None):
             rec["mode"] = m["mode"]
             rec["families"] = m["families"]
             rec["dispatch_gap"] = m["dispatch_gap"]
+            if m.get("graph_cache"):
+                rec["graph_cache"] = m["graph_cache"]
             records.append(rec)
     else:
         fams = perf.family_records()

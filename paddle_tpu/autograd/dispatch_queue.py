@@ -1,59 +1,73 @@
-"""Batched backward dispatch engine (ROADMAP item 4, second ceiling).
+"""Batched backward dispatch engine (ROADMAP item 4, third ceiling).
 
 The per-node walker in ``tape.run_backward`` pays host work per
-GradNode: cotangent slot assembly (``jnp.zeros`` allocated per dead
-slot, ``jnp.ones`` per implicit seed), hook/target bookkeeping through
+GradNode: cotangent slot assembly, hook/target bookkeeping through
 dict-backed accumulation slots, queue management, and — dominating all
-of it — one XLA dispatch per node (the jitted per-op bwd executable).
-PR 8's dispatch-gap profiler put numbers on exactly that host gap
-(``paddle_tpu_dispatch_gap_seconds``, per-op attributed). This module
-is the fix the telemetry was built for:
+of it — one XLA dispatch per node. PR 8's dispatch-gap profiler put
+numbers on exactly that host gap; PR 10 batched maximal runs of
+consecutive SINGLE-CONSUMER nodes into one fused jitted call and met
+the <=1.5 eager-over-TrainStep bar. What remained was structural:
+fan-in junctions (a tensor consumed by several ops), root-seeded
+interior nodes, and non-empty ready queues all ended a run, so real
+models still fragmented into many fused sub-chains and the measured
+remainder was pure host dispatch. This module closes that
+(cf. FusionStitching, PAPERS.md — the win comes from fusing *across*
+fan-in/fan-out junctions, not stopping at them):
 
-* **Dispatch queue + fusion-at-dispatch** (cf. FusionStitching,
-  PAPERS.md; SURVEY §7.3 async dispatch queue): ready nodes stage into
-  the queue, and a maximal run of consecutive single-consumer nodes is
-  dispatched as ONE jitted call — the per-node vjp bodies chained
-  inside a single trace, cached per chain signature (compile family
-  ``backward_fused``). One XLA dispatch replaces ``len(run)`` of them,
-  and the inter-node host bookkeeping (slot dicts, pending counts,
-  queue churn, per-node zero building) vanishes from the hot loop:
-  intermediate cotangents flow inside the executable.
+* **Whole-graph fusion (mode ``whole_graph``, the default)**: a fused
+  run no longer ends at a multi-consumer node. Segment formation
+  simulates the per-node FIFO walk forward and absorbs every
+  consecutively-ready fusable node — fan-in cotangent accumulation
+  happens *inside* the fused trace (each junction's incoming edges
+  accumulate in the exact per-node FIFO order, so sums associate
+  identically and gradients stay bit-identical), root seeds and
+  already-ready queue entries ride along as host-seed operands. In the
+  steady state one backward = ONE fused dispatch.
 
-* **Const caches**: per-aval zero-cotangent and seed-ones caches
-  replace the per-dispatch eager allocations (the tape walker shares
-  them, so the per-node A/B baseline gets the same fix — satellite of
-  ISSUE 10).
+* **Whole-graph trace cache**: fused executables are cached per graph
+  signature — per node in dispatch order: the exec-cache entry ``uid``
+  (monotonic, never reused — ids can't alias even across entry
+  eviction; entries are additionally pinned by the cached executable),
+  output arity, host-seed slot layout, and full edge routing
+  (in-segment accumulation targets vs emitted leaf/boundary
+  cotangents). A steady-state eager train loop computes the signature
+  (O(nodes) cheap host work), hits the cache, packs seeds + per-node
+  primals, and dispatches once. ``clear_chain_cache()`` clears it (the
+  chain and whole-graph caches are one cache).
 
-* **Observability**: each dispatch call records its run length into
-  ``paddle_tpu_dispatch_batch_size`` (fused runs > 1, degraded
-  dispatches = 1), and dispatch gaps keep their per-op attribution so
-  the bench A/B shows WHERE the host time went, not just the total.
+* **Degradation ladder** — only genuinely host-coupled nodes break a
+  segment, and they break it *locally*: a node with tensor hooks /
+  ``retain_grad`` / a ``paddle.grad`` target on its outputs ends the
+  current segment, fires its host work when popped, and may then HEAD
+  the next segment; nodes without ``fuse_info`` (PyLayer,
+  RNG-consuming, uncacheable ops), with non-inexact outputs, float0
+  host seeds, or leaf hooks dispatch per-node; a segment whose
+  composed trace fails is disabled (kept in-cache pinning its entries)
+  and its head dispatches per-node from then on. ``create_graph``
+  backward stays on the per-node tape path entirely.
 
-Degradation contract — outputs stay bit-identical to the per-node
-walker. A node joins a fused run only when fusion cannot be observed:
+* **Observability**: each dispatch records its run length into
+  ``paddle_tpu_dispatch_batch_size`` (whole-graph runs = the graph
+  size), dispatch gaps keep per-op attribution, and
+  ``paddle_tpu_backward_graph_cache_total{outcome=hit|miss|bypass}``
+  records, per backward in whole_graph mode, whether the entire graph
+  dispatched as one cached fused call (hit), one freshly traced call
+  (miss), or fragmented (bypass) — steady-state O(1) dispatch is a
+  monotonically growing ``hit`` count.
 
-* it carries ``fuse_info`` (an exec-cache entry + captured
-  primals/nondiffs — ops recorded through the registry's cached path;
-  PyLayer, RNG-consuming and uncacheable ops never do),
-* every output aval is inexact (float0 cotangents stay host-side),
-* no hooks on its leaf edges, and — for non-head positions — exactly
-  one consumer edge, not root-seeded, and no hooks / ``retain_grad`` /
-  grad-target on its output tensors,
-* the ready queue is empty, so fused FIFO dispatch order is EXACTLY
-  the per-node order (leaf-grad accumulation order preserved —
-  bit-identical sums).
-
-Everything else (multi-consumer fan-in, hooks mid-chain,
-``create_graph``, a chain whose composed trace fails) degrades to the
-per-node path mid-walk. ``PADDLE_TPU_BACKWARD_DISPATCH=per_node`` (or
-``set_dispatch_mode``/``backward_dispatch_mode``) restores the old
-walker wholesale — ``bench.py --config dispatch`` A/Bs the two modes
-in one session.
+Modes: ``whole_graph`` (default) > ``batched`` (the PR 10
+single-consumer-chain engine, kept verbatim as an A/B rung) >
+``per_node`` (the legacy walker). ``PADDLE_TPU_BACKWARD_DISPATCH`` /
+``set_dispatch_mode`` / ``backward_dispatch_mode`` select;
+``bench.py --config dispatch`` A/Bs all three against TrainStep in one
+session. Gradients are bit-identical across all modes — pinned by
+tests/test_backward_dispatch.py.
 """
 from __future__ import annotations
 
 import os
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -64,16 +78,17 @@ import numpy as np
 # mode control
 # ---------------------------------------------------------------------------
 _MODE_ENV = "PADDLE_TPU_BACKWARD_DISPATCH"
-_VALID_MODES = ("batched", "per_node")
-_mode = os.environ.get(_MODE_ENV, "batched")
+_VALID_MODES = ("whole_graph", "batched", "per_node")
+_mode = os.environ.get(_MODE_ENV, "whole_graph")
 if _mode not in _VALID_MODES:
-    _mode = "batched"
+    _mode = "whole_graph"
 
 
 def dispatch_mode() -> str:
-    """Current backward dispatch mode: 'batched' (default) or
-    'per_node' (the pre-ISSUE-10 walker, kept as the A/B baseline and
-    the always-correct fallback)."""
+    """Current backward dispatch mode: 'whole_graph' (default —
+    fan-in-crossing fused runs + the whole-graph trace cache),
+    'batched' (the PR 10 single-consumer-chain engine) or 'per_node'
+    (the pre-ISSUE-10 walker, the always-correct fallback)."""
     return _mode
 
 
@@ -91,7 +106,7 @@ def set_dispatch_mode(mode: str) -> str:
 
 class backward_dispatch_mode:
     """Context manager pinning the backward dispatch mode (the bench
-    A/B and the bit-identical test suite run both modes through it)."""
+    A/B and the bit-identical test suite run all modes through it)."""
 
     def __init__(self, mode: str):
         self._new = mode
@@ -106,10 +121,9 @@ class backward_dispatch_mode:
 
 
 # ---------------------------------------------------------------------------
-# const caches (satellite: the measured hot spot — jnp.zeros per dead
-# output slot / jnp.ones per implicit seed were eager device
-# allocations on EVERY dispatch; arrays are immutable, so one per aval
-# serves every backward)
+# const caches (satellite of ISSUE 10: jnp.zeros per dead output slot /
+# jnp.ones per implicit seed were eager device allocations on EVERY
+# dispatch; arrays are immutable, so one per aval serves every backward)
 # ---------------------------------------------------------------------------
 _FLOAT0 = jax.dtypes.float0
 _ZEROS: Dict[Tuple, Any] = {}
@@ -161,19 +175,23 @@ def clear_const_caches() -> None:
 
 
 # ---------------------------------------------------------------------------
-# fused-chain executable cache
+# fused-segment executable cache (chains AND whole graphs — a linear
+# chain is the degenerate fan-in-free segment, so both modes share one
+# cache and one builder)
 # ---------------------------------------------------------------------------
-MAX_CHAIN = 64          # jit arg-count guard; runs longer than this split
-_CHAIN_CACHE: Dict[Tuple, Any] = {}     # key -> _FusedChain | None
+MAX_CHAIN = 64          # batched-mode run cap (PR 10 A/B rung)
+MAX_GRAPH = 256         # whole-graph segment cap: bigger graphs split
+                        # into consecutive fused calls (still O(n/256))
+_CHAIN_CACHE: Dict[Tuple, "_FusedChain"] = {}
 _CHAIN_CACHE_MAX = 256
 
 
 class _FusedChain:
-    """One compiled backward run: the chained vjp bodies of N
-    consecutive single-consumer grad nodes behind one jitted callable.
-    Holds strong refs to the exec-cache entries it traced through —
-    the cache key uses their ids, so pinning them makes id reuse
-    impossible while the chain is cached.
+    """One compiled backward segment: the vjp bodies of N grad nodes —
+    a linear chain or a fan-in-crossing whole-graph region — composed
+    behind one jitted callable. Holds strong refs to the exec-cache
+    entries it traced through (belt and braces over the never-reused
+    entry uids in the cache key).
 
     Compile telemetry (family ``backward_fused``) uses a first-call
     shim like perf.CompileTimed but deliberately does NOT keep the AOT
@@ -192,11 +210,9 @@ class _FusedChain:
         self.entries = entries
         self.pending = True
         # flips True when the composed trace fails (concrete-path-only
-        # grads, exotic op): the chain dispatches per-node from then
-        # on. The disabled chain STAYS in the cache holding its entry
-        # refs — a bare None sentinel would not pin them, and an
-        # exec-cache eviction followed by id reuse could silently
-        # degrade a brand-new fusable chain that hashes to this key.
+        # grads, exotic op): the segment dispatches per-node from then
+        # on. The disabled segment STAYS in the cache holding its
+        # entry refs — a bare None sentinel would not pin them.
         self.disabled = False
 
     def __call__(self, *args):
@@ -223,8 +239,33 @@ class _FusedChain:
         return out
 
 
+# heads whose whole-graph segment previously composed into an
+# untraceable body (entry uid -> True). Without this, a graph holding
+# one exotic op pays the cascade on EVERY backward: each suffix
+# segment from each successive head re-plans O(remaining) host work
+# (and, on the first backward, re-traces) before hitting its disabled
+# cache entry — O(n^2) per step and up to n distinct cache keys
+# churning the trim. The memo skips whole-graph formation from a
+# known-bad head outright: the head dispatches per-node (exactly the
+# disabled outcome) and the first head PAST the bad region still
+# fuses. False positives are bounded — a uid suppressed by one graph
+# costs other graphs at most that single head's membership.
+_DISABLED_HEAD_UIDS: Dict[int, bool] = {}
+_DISABLED_HEAD_UIDS_MAX = 1024
+
+
+def _note_disabled_head(entry) -> None:
+    if len(_DISABLED_HEAD_UIDS) >= _DISABLED_HEAD_UIDS_MAX:
+        _DISABLED_HEAD_UIDS.clear()
+    _DISABLED_HEAD_UIDS[entry.uid] = True
+
+
 def clear_chain_cache() -> None:
+    """Drop every cached fused backward executable — chains and
+    whole-graph segments live in the same cache — plus the
+    disabled-head memo that fronts it."""
     _CHAIN_CACHE.clear()
+    _DISABLED_HEAD_UIDS.clear()
 
 
 def chain_cache_size() -> int:
@@ -232,71 +273,98 @@ def chain_cache_size() -> int:
 
 
 def _build_fused(descs):
-    """Trace-time composition: each node's cotangent contraction is
-    re-derived from its captured primals exactly like the per-node
-    ``entry.bwd`` executable does, but inside ONE trace — XLA sees the
-    whole run and the intermediate cotangents never surface to the
-    host. descs: per node (entry, cont_pos, out_avals|None,
-    seed_idx|None); head (out_avals None) receives its full cotangent
-    slot vector as an input, later nodes build zero slots in-trace and
-    take the previous node's continuation cotangent at seed_idx."""
+    """Trace-time composition of one fused segment: each node's
+    cotangent contraction is re-derived from its captured primals
+    exactly like the per-node ``entry.bwd`` executable does, but
+    inside ONE trace — XLA sees the whole region and intermediate
+    cotangents (including fan-in accumulations) never surface to the
+    host.
 
-    def fused(head_cots, packs):
+    descs, per node in per-node FIFO dispatch order:
+    ``(entry, out_avals, seed_slots, edge_plan)`` where seed_slots
+    names the output slots receiving host-side seed values (root
+    seeds, contributions from nodes dispatched before this segment,
+    hook-transformed head cotangents) and edge_plan routes each input
+    cotangent: ``("a", node_pos, out_idx)`` accumulates into a later
+    in-segment node's slot — ``g`` if first, else ``acc + g``, in edge
+    order, which IS the per-node FIFO accumulation order, so fan-in
+    sums associate bit-identically — ``("o",)`` emits (leaf edge or
+    out-of-segment boundary), ``("d",)`` drops (stop edge)."""
+
+    def fused(seed_vals, packs):
+        acc = [[None] * len(d[1]) for d in descs]
+        si = 0
+        for pos, (_e, _avals, seed_slots, _plan) in enumerate(descs):
+            for j in seed_slots:
+                acc[pos][j] = seed_vals[si]
+                si += 1
         outs = []
-        nxt = None
-        cots = head_cots
-        for (entry, cont_pos, out_avals, seed_idx), (primals, nondiffs) \
-                in zip(descs, packs):
-            if out_avals is not None:
-                slots = [jnp.zeros(a.shape, a.dtype) for a in out_avals]
-                slots[seed_idx] = nxt
-                cots = tuple(slots)
+        for pos, ((entry, out_avals, _seeds, edge_plan),
+                  (primals, nondiffs)) in enumerate(zip(descs, packs)):
+            cots = tuple(
+                a if a is not None else jnp.zeros(av.shape, av.dtype)
+                for a, av in zip(acc[pos], out_avals))
 
             def _fwd(*d, _e=entry, _nd=nondiffs):
                 return _e._run_raw(d, _nd)
 
             _, vf = jax.vjp(_fwd, *primals)
-            in_cots = vf(tuple(cots))
-            for j, g in enumerate(in_cots):
-                if j != cont_pos:
+            in_cots = vf(cots)
+            for plan, g in zip(edge_plan, in_cots):
+                kind = plan[0]
+                if kind == "o":
                     outs.append(g)
-            if cont_pos is not None:
-                nxt = in_cots[cont_pos]
+                elif kind == "a":
+                    cur = acc[plan[1]][plan[2]]
+                    acc[plan[1]][plan[2]] = g if cur is None else cur + g
         return tuple(outs)
 
     return jax.jit(fused)
 
 
-def _chain_key(chain, cont_positions):
-    """Chain-shape cache key. id(entry) is INTENTIONAL identity
-    keying (cf. dy2static's _bound_cache): an exec-cache entry fully
-    determines the node's traced bwd body, entries are long-lived on
-    their OpDef, and _FusedChain pins every entry it traced through —
-    so an id can never be reused while its key is live, and two
-    backwards over the same op signatures hit the same executable."""
-    parts = []
-    for i, (node, cont_pos) in enumerate(zip(chain, cont_positions)):
-        entry = node.fuse_info[0]
-        seed_idx = (-1 if i == 0 else
-                    chain[i - 1].edges[cont_positions[i - 1]].out_idx)
-        parts.append((id(entry), len(node.edges),  # graftlint: disable=unstable-cache-key
-                      -1 if cont_pos is None else cont_pos, seed_idx))
-    return tuple(parts)
-
-
-def _get_fused(chain, cont_positions):
-    """Fused executable for this chain shape (possibly disabled, when
-    a previous attempt found the composition untraceable)."""
-    key = _chain_key(chain, cont_positions)
-    if key in _CHAIN_CACHE:
-        return _CHAIN_CACHE[key], key
+def _segment_plan(segment, head_slots, cot):
+    """descs + graph-signature cache key + flat host-seed values for a
+    segment (nodes in dispatch order). The key is the whole-graph
+    signature: per node (entry uid, output arity, host-seed slot
+    layout, edge routing with in-segment parents as positional
+    accumulation targets) — entry uids are monotonic and never reused
+    (ops.registry), so two backwards over the same op signatures and
+    topology hit the same executable and a changed exec-cache entry,
+    topology, routing, or seed layout can never alias."""
+    pos = {id(n): i for i, n in enumerate(segment)}
     descs = []
-    for i, (node, cont_pos) in enumerate(zip(chain, cont_positions)):
-        entry = node.fuse_info[0]
-        seed_idx = (None if i == 0 else
-                    chain[i - 1].edges[cont_positions[i - 1]].out_idx)
-        out_avals = None if i == 0 else tuple(node.out_avals)
-        descs.append((entry, cont_pos, out_avals, seed_idx))
+    key_parts = []
+    seed_vals: List[Any] = []
+    for i, n in enumerate(segment):
+        entry = n.fuse_info[0]
+        slots = head_slots if i == 0 else cot.get(id(n))
+        if slots is None:
+            seed_slots: Tuple[int, ...] = ()
+        else:
+            seed_slots = tuple(j for j, s in enumerate(slots)
+                               if s is not None)
+            seed_vals.extend(slots[j] for j in seed_slots)
+        plan = []
+        for e in n.edges:
+            if e.kind == "node" and id(e.node) in pos:
+                plan.append(("a", pos[id(e.node)], e.out_idx))
+            elif e.kind == "stop":
+                plan.append(("d",))
+            else:
+                plan.append(("o",))
+        plan = tuple(plan)
+        descs.append((entry, tuple(n.out_avals), seed_slots, plan))
+        key_parts.append((entry.uid, len(n.out_avals), seed_slots, plan))
+    return descs, tuple(key_parts), seed_vals
+
+
+def _get_fused(descs, key):
+    """(fused executable, cache_hit) for this segment signature —
+    possibly disabled, when a previous attempt found the composition
+    untraceable."""
+    hit = _CHAIN_CACHE.get(key)
+    if hit is not None:
+        return hit, True
     fused = _FusedChain(_build_fused(descs),
                         tuple(d[0] for d in descs))
     if len(_CHAIN_CACHE) >= _CHAIN_CACHE_MAX:
@@ -304,11 +372,11 @@ def _get_fused(chain, cont_positions):
         for k in list(_CHAIN_CACHE)[:_CHAIN_CACHE_MAX // 2]:
             del _CHAIN_CACHE[k]
     _CHAIN_CACHE[key] = fused
-    return fused, key
+    return fused, False
 
 
 # ---------------------------------------------------------------------------
-# the batched walker
+# fusability predicates
 # ---------------------------------------------------------------------------
 _INEXACT_MEMO: Dict[Any, bool] = {}
 
@@ -339,49 +407,134 @@ def _head_fusable(node) -> bool:
             and not _leaf_hooked(node))
 
 
+def _grow_chain(node, ok):
+    """PR 10 batched-mode run formation: follow the single node-edge
+    continuation while each next node passes ``ok`` (single consumer,
+    not root-seeded, clean outputs). Returns the run or None."""
+    chain = [node]
+    cur = node
+    while len(chain) < MAX_CHAIN:
+        cont = None
+        for e in cur.edges:
+            if e.kind == "node":
+                if cont is not None:
+                    cont = None
+                    break
+                cont = e
+        if cont is None:
+            break
+        nxt = cont.node
+        if not ok(nxt):
+            break
+        chain.append(nxt)
+        cur = nxt
+    return chain if len(chain) > 1 else None
+
+
+def _grow_graph(node, queue, pending, ok):
+    """Whole-graph segment formation: simulate the per-node FIFO walk
+    forward from ``node`` (already popped, output hooks fired),
+    absorbing every consecutively-ready node that passes ``ok``. The
+    simulation copies the ready queue and decrements pending counts
+    copy-on-write, so the real walk state is untouched until the fused
+    dispatch actually succeeds. Because pops come strictly from the
+    FIFO front, the absorbed nodes are exactly the per-node dispatch
+    prefix — fused order == per-node order, and the first
+    ``min(pops, len(queue))`` entries of the real queue are the
+    absorbed already-ready nodes.
+
+    Returns (segment | None, absorbed_from_queue_count)."""
+    segment = [node]
+    sim_queue = deque(queue)
+    sim_pending: Dict[int, int] = {}
+    pops = 0
+    i = 0
+    while len(segment) < MAX_GRAPH:
+        cur = segment[i]
+        for e in cur.edges:
+            if e.kind == "node":
+                nid = id(e.node)
+                left = sim_pending.get(nid, pending.get(nid, 0)) - 1
+                sim_pending[nid] = left
+                if left == 0:
+                    sim_queue.append(e.node)
+        i += 1
+        if not sim_queue:
+            break
+        nxt = sim_queue[0]
+        if not ok(nxt):
+            break
+        sim_queue.popleft()
+        segment.append(nxt)
+        pops += 1
+    if len(segment) < 2:
+        return None, 0
+    return segment, min(pops, len(queue))
+
+
+# ---------------------------------------------------------------------------
+# the batched walker (modes whole_graph and batched)
+# ---------------------------------------------------------------------------
 def run_batched(node_by_id, consumers, cot, node_store, seed,
                 target_ids, target_results, accumulate_leaf_grads,
                 retain_graph):
-    """The batched-mode hot loop of ``tape.run_backward`` (roots
-    already seeded; ``seed`` is the tape's accumulation closure over
+    """The fused-mode hot loop of ``tape.run_backward`` (roots already
+    seeded; ``seed`` is the tape's accumulation closure over
     ``cot``/``node_store``). Same semantics as the per-node walker —
     FIFO dispatch order, hook/retain/target handling, leaf
-    accumulation order — with maximal single-consumer runs dispatched
-    as one fused call."""
-    from collections import deque
-
+    accumulation order — with fusable regions dispatched as one fused
+    call: whole-graph segments across fan-in junctions in whole_graph
+    mode, maximal single-consumer runs in batched mode."""
     from . import tape
     from ..observability import metrics as _om
     from ..observability import perf as _pf
 
+    whole = _mode == "whole_graph"
     pending = dict(consumers)
     queue = deque(n for nid, n in node_by_id.items()
                   if pending.get(nid, 0) == 0)
     root_seeded = frozenset(cot)
+    n_total = len(node_by_id)
     fusable_memo: Dict[int, bool] = {}
+    n_dispatches = 0
+    first_whole_hit: Optional[bool] = None
+
+    def clean_outputs(n) -> bool:
+        for ref in n.out_tensor_refs:
+            t = ref() if ref is not None else None
+            if t is not None and (
+                    t._hooks or t._retain_grad
+                    or (target_ids and id(t) in target_ids)):
+                return False
+        return True
 
     def nonhead_fusable(n) -> bool:
         nid = id(n)
         v = fusable_memo.get(nid)
         if v is None:
-            v = (consumers.get(nid, 0) == 1
-                 and nid not in root_seeded
-                 and _head_fusable(n))
-            if v:
-                for ref in n.out_tensor_refs:
-                    t = ref() if ref is not None else None
-                    if t is not None and (
-                            t._hooks or t._retain_grad
-                            or (target_ids and id(t) in target_ids)):
-                        v = False
-                        break
+            v = _head_fusable(n) and clean_outputs(n)
+            if v and not whole:
+                # batched (chain) mode keeps the PR 10 restrictions:
+                # exactly one consumer, no root seed riding along
+                v = (consumers.get(nid, 0) == 1
+                     and nid not in root_seeded)
             fusable_memo[nid] = v
         return v
 
+    def candidate_ok(n) -> bool:
+        # host-seed float0 check stays OUT of the memo: seeds can grow
+        # between a failed segment attempt and the next (per-node
+        # dispatches in between), and float0 slots must degrade
+        if not nonhead_fusable(n):
+            return False
+        slots = cot.get(id(n))
+        return slots is None or not any(
+            s is not None and is_float0(s) for s in slots)
+
     def apply_leaf_edge(e, g):
         """Leaf-edge cotangent handling — identical to the per-node
-        walker's edge loop body (hooks fired by the caller where they
-        can exist)."""
+        walker's edge loop body (in-segment nodes never carry leaf
+        hooks, so fused post-processing runs no user code here)."""
         t = e.tensor_ref() if e.tensor_ref is not None else None
         if t is None:
             return
@@ -401,58 +554,61 @@ def run_batched(node_by_id, consumers, cot, node_store, seed,
         if pending[id(e.node)] == 0:
             queue.append(e.node)
 
+    def release(n):
+        n.vjp_fn = None
+        n.replay_fn = None
+        n.primal_arrays = None
+        n.record_vjp = None
+        n.fuse_info = None
+
     last_dispatch = None
     while queue:
         node = queue.popleft()
         slots = cot.get(id(node))
         if slots is None:
             slots = [None] * len(node.out_avals)
-        cots = [s if s is not None else zero_cotangent_array(a)
-                for s, a in zip(slots, node.out_avals)]
-        # hooks / retain_grad / targets on this node's outputs — the
-        # head of a run is mid-dispatch, so these fire exactly like
-        # the per-node walker (before the device call)
+        # hooks / retain_grad / targets on this node's outputs fire
+        # exactly like the per-node walker (before the device call),
+        # materializing only the slots they observe — untouched None
+        # slots stay symbolic and become in-trace zeros when the node
+        # heads a fused segment
         for i, ref in enumerate(node.out_tensor_refs):
             t = ref() if ref is not None else None
             if t is None:
                 continue
+            is_target = target_ids and id(t) in target_ids
+            if not (t._hooks or t._retain_grad or is_target):
+                continue
+            if slots[i] is None:
+                slots[i] = zero_cotangent_array(node.out_avals[i])
             if t._hooks:
-                cots[i] = tape._apply_hooks(t._hooks, cots[i], False)
+                slots[i] = tape._apply_hooks(t._hooks, slots[i], False)
                 fusable_memo.clear()
-            if t._retain_grad or (target_ids and id(t) in target_ids):
-                if target_ids and id(t) in target_ids:
-                    r = target_results[target_ids[id(t)]]
-                    target_results[target_ids[id(t)]] = (
-                        cots[i] if r is None else r + cots[i])
-                if t._retain_grad and accumulate_leaf_grads:
-                    tape._apply_leaf_grad(t, cots[i], False)
+            if is_target:
+                r = target_results[target_ids[id(t)]]
+                target_results[target_ids[id(t)]] = (
+                    slots[i] if r is None else r + slots[i])
+            if t._retain_grad and accumulate_leaf_grads:
+                tape._apply_leaf_grad(t, slots[i], False)
 
-        # chain construction: only when the queue is empty does fusing
-        # the successor preserve exact FIFO order (and with it the
-        # bit-identical leaf accumulation order)
-        chain = None
-        cont_positions: List[Optional[int]] = []
-        if not queue and _head_fusable(node) \
-                and not any(is_float0(c) for c in cots):
-            chain = [node]
-            cur = node
-            while len(chain) < MAX_CHAIN:
-                cont_pos = None
-                for j, e in enumerate(cur.edges):
-                    if e.kind == "node":
-                        if cont_pos is not None:
-                            cont_pos = None
-                            break
-                        cont_pos = j
-                if cont_pos is None:
-                    break
-                nxt = cur.edges[cont_pos].node
-                if not nonhead_fusable(nxt):
-                    break
-                cont_positions.append(cont_pos)
-                chain.append(nxt)
-                cur = nxt
-            cont_positions.append(None)     # last node: no continuation
+        # segment formation: whole_graph mode absorbs across fan-in
+        # junctions and the live ready queue (the simulation preserves
+        # exact FIFO order); batched mode keeps the PR 10 rule — runs
+        # form only while the queue is empty, along single-consumer
+        # continuations
+        segment = None
+        absorbed_q = 0
+        if _head_fusable(node) and not any(
+                s is not None and is_float0(s) for s in slots):
+            if whole:
+                # known-bad head (its composed segment failed to trace
+                # before): dispatch per-node without re-planning — the
+                # first head past the bad region still fuses
+                if node.fuse_info[0].uid not in _DISABLED_HEAD_UIDS:
+                    segment, absorbed_q = _grow_graph(
+                        node, queue, pending, candidate_ok)
+            elif not queue:
+                segment = _grow_chain(node, candidate_ok)
 
         enabled = _om._ENABLED
         if enabled:
@@ -461,47 +617,61 @@ def run_batched(node_by_id, consumers, cot, node_store, seed,
                 _pf.note_dispatch_gap(now - last_dispatch, node.name)
 
         dispatched_fused = False
-        if chain is not None and len(chain) > 1:
-            fused, key = _get_fused(chain, cont_positions)
-            if not fused.disabled:
+        if segment is not None:
+            descs, key, seed_vals = _segment_plan(segment, slots, cot)
+            fused, cache_hit = _get_fused(descs, key)
+            if fused.disabled:
+                if whole:
+                    _note_disabled_head(node.fuse_info[0])
+            else:
                 packs = tuple((n.fuse_info[1], n.fuse_info[2])
-                              for n in chain)
+                              for n in segment)
                 try:
-                    outs = fused(tuple(cots), packs)
+                    outs = fused(tuple(seed_vals), packs)
                     dispatched_fused = True
                 except Exception:
                     # untraceable composition (concrete-path-only
                     # grads, exotic op): remember and degrade — the
-                    # per-node path below redispatches this head
+                    # per-node path below redispatches this head, and
+                    # (whole mode) the head memo stops future
+                    # backwards from re-planning the doomed segment.
+                    # Chain (batched) mode keeps PR 10 behavior
+                    # verbatim: disabled hits re-plan, never memoize.
                     fused.disabled = True
+                    if whole:
+                        _note_disabled_head(node.fuse_info[0])
         if dispatched_fused:
+            n_dispatches += 1
+            if n_dispatches == 1 and len(segment) == n_total:
+                first_whole_hit = cache_hit
             if enabled:
                 last_dispatch = time.perf_counter()
-                _pf.note_dispatch_batch(len(chain))
+                _pf.note_dispatch_batch(len(segment))
+            # the absorbed already-ready nodes are exactly the next
+            # `absorbed_q` FIFO entries (see _grow_graph)
+            for _ in range(absorbed_q):
+                queue.popleft()
             oi = 0
-            for n, cont_pos in zip(chain, cont_positions):
-                for j, e in enumerate(n.edges):
-                    if j == cont_pos:
-                        continue
+            for n, (_e, _avals, _seeds, plan) in zip(segment, descs):
+                for e, p in zip(n.edges, plan):
+                    if p[0] != "o":
+                        continue        # in-trace accumulation / stop
                     g = outs[oi]
                     oi += 1
-                    if e.kind == "stop":
-                        continue
                     if e.kind == "leaf":
                         apply_leaf_edge(e, g)
-                    else:               # only the last node has these
+                    else:       # out-of-segment boundary node edge
                         seed_node_edge(e, g)
                 if not retain_graph:
-                    n.vjp_fn = None
-                    n.replay_fn = None
-                    n.primal_arrays = None
-                    n.record_vjp = None
-                    n.fuse_info = None
-            cot.pop(id(node), None)
+                    release(n)
+                cot.pop(id(n), None)
             continue
 
         # per-node dispatch (degraded or unfused) — the original walker
+        cots = [s if s is not None else zero_cotangent_array(a)
+                for s, a in zip(slots, node.out_avals)]
         in_cots = node.vjp_fn(tuple(cots))
+        n_dispatches += 1
         if enabled:
             last_dispatch = time.perf_counter()
             _pf.note_dispatch_batch(1)
@@ -518,9 +688,11 @@ def run_batched(node_by_id, consumers, cot, node_store, seed,
             else:
                 seed_node_edge(e, g)
         if not retain_graph:
-            node.vjp_fn = None
-            node.replay_fn = None
-            node.primal_arrays = None
-            node.record_vjp = None
-            node.fuse_info = None
+            release(node)
         cot.pop(id(node), None)
+
+    if whole and _om._ENABLED and n_dispatches:
+        if n_dispatches == 1 and first_whole_hit is not None:
+            _pf.note_graph_cache("hit" if first_whole_hit else "miss")
+        else:
+            _pf.note_graph_cache("bypass")

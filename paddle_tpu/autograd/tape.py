@@ -389,10 +389,11 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
 
     if roots:
         node_by_id, consumers = _collect_graph(roots)
-        if not create_graph and _dq.dispatch_mode() == "batched":
-            # ISSUE 10 tentpole: the dispatch-queue engine — fused
-            # single-consumer runs, const caches, bit-identical
-            # degradation to the per-node semantics below
+        if not create_graph and _dq.dispatch_mode() != "per_node":
+            # ISSUE 10/13 tentpole: the dispatch-queue engine — fused
+            # whole-graph (or single-consumer-chain) runs, const
+            # caches, bit-identical degradation to the per-node
+            # semantics below
             _dq.run_batched(node_by_id, consumers, cot, node_store,
                             seed, target_ids, target_results,
                             accumulate_leaf_grads, retain_graph)

@@ -195,10 +195,17 @@ class Tensor:
 
     # ---- in-place data management (optimizer update path) ----
     def _set_data(self, arr):
-        """Replace the underlying buffer (used by optimizers / load)."""
+        """Replace the underlying buffer (used by optimizers / load).
+        Device arrays rebind directly: jnp.asarray's dtype
+        canonicalization walk cost ~80us per call on the fused
+        optimizer's per-param update path (ISSUE 13 profile), and a
+        jax.Array is already exactly what `_data` holds."""
         if isinstance(arr, Tensor):
             arr = arr._data
-        self._data = jnp.asarray(arr)
+        if isinstance(arr, jax.Array):
+            self._data = arr
+        else:
+            self._data = jnp.asarray(arr)
         return self
 
     def set_value(self, value):

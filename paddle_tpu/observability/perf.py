@@ -65,7 +65,7 @@ from . import metrics as _m
 __all__ = [
     "CostModel", "read_cost_model", "CompileTimed", "record_compile",
     "observe_roofline", "note_dispatch_gap", "note_dispatch_batch",
-    "family_records",
+    "note_graph_cache", "family_records",
     "reset_window", "device_peaks", "set_device_peaks", "lookup",
     "PEAK_BF16_FLOPS", "HBM_BYTES_PER_SEC", "VALIDATED_BW_WINDOW",
     "DISPATCH_GAP_BUCKETS",
@@ -248,12 +248,23 @@ def _metrics():
                 ("op",)),
             "batch": r.histogram(
                 "paddle_tpu_dispatch_batch_size",
-                "grad nodes per backward dispatch call in the batched "
-                "dispatch engine: fused single-consumer runs observe "
+                "grad nodes per backward dispatch call in the fused "
+                "dispatch engine: whole-graph and chain runs observe "
                 "their length, per-node degradations (hooks, "
-                "fan-in, unfusable ops) observe 1; the per_node A/B "
+                "unfusable ops) observe 1; the per_node A/B "
                 "mode records nothing",
-                buckets=(1, 2, 4, 8, 16, 32, 64)),
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)),
+            "graph_cache": r.counter(
+                "paddle_tpu_backward_graph_cache_total",
+                "whole-graph backward trace cache outcomes, one per "
+                "backward in whole_graph dispatch mode: hit = the "
+                "entire grad graph dispatched as one cached fused "
+                "executable, miss = one freshly traced fused "
+                "executable, bypass = the graph fragmented into "
+                "multiple dispatches (host-coupled nodes, degraded "
+                "segments) — steady-state O(1) dispatch shows as a "
+                "monotonically growing hit count",
+                ("outcome",)),
         }
     return _METRICS
 
@@ -346,6 +357,13 @@ def note_dispatch_batch(n_nodes: int) -> None:
     `n_nodes` grad nodes (1 = degraded per-node dispatch). Caller
     guards on the metrics flag like note_dispatch_gap."""
     _metrics()["batch"].observe(n_nodes)
+
+
+def note_graph_cache(outcome: str) -> None:
+    """One whole-graph backward cache outcome (hit|miss|bypass) from
+    the dispatch engine, recorded once per backward in whole_graph
+    mode. Caller guards on the metrics flag like note_dispatch_gap."""
+    _metrics()["graph_cache"].labels(outcome=outcome).inc()
 
 
 def family_records() -> Dict[str, dict]:

@@ -37,13 +37,34 @@ OPS: Dict[str, "OpDef"] = {}
 
 class OpDef:
     __slots__ = ("name", "fn", "sig", "amp_policy", "n_grad_exempt",
-                 "tags", "cacheable", "exec_cache", "eager_check")
+                 "tags", "cacheable", "exec_cache", "eager_check",
+                 "pos_names", "n_required")
 
     def __init__(self, name, fn, amp_policy=None, tags=(),
                  cacheable=True):
         self.name = name
         self.fn = fn
         self.sig = inspect.signature(fn)
+        # fully-positional fast binding (ISSUE 13 profile:
+        # inspect.Signature.bind cost ~18us per eager op dispatch —
+        # pure host overhead on the hottest path). Precomputed here:
+        # parameter names in order and the required-arg count, valid
+        # only for plain positional-or-keyword signatures. Python
+        # guarantees defaulted params follow required ones, so a
+        # positional-only call with n_required <= len(args) <=
+        # len(pos_names) binds as dict(zip(names, args)) — byte-for-
+        # byte what sig.bind().arguments produces. Everything else
+        # (kwargs, *args/**kwargs signatures, arity errors) falls back
+        # to sig.bind.
+        _params = list(self.sig.parameters.values())
+        if all(p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+               for p in _params):
+            self.pos_names = tuple(p.name for p in _params)
+            self.n_required = sum(
+                1 for p in _params if p.default is inspect.Parameter.empty)
+        else:
+            self.pos_names = None
+            self.n_required = 0
         # amp_policy: None (follow input), 'white' (bf16-friendly),
         # 'black' (force fp32), 'keep' (never cast)
         self.amp_policy = amp_policy
@@ -123,8 +144,18 @@ def _rng_restore(stamp):
         G._default_generator.set_state(stamp[1])
 
 
+import itertools as _itertools  # noqa: E402
+
+# monotonic executable-entry ids: the backward fusion caches
+# (autograd.dispatch_queue) key fused-segment signatures on entry
+# identity, and a counter can never be reused the way id() can after
+# an LRU eviction — so a whole-graph cache key can never alias a dead
+# entry even without pinning (the fused executables pin anyway)
+_ENTRY_UIDS = _itertools.count(1)
+
+
 class _ExecEntry:
-    __slots__ = ("fwd", "bwd", "out_tree", "bwd_ok", "_run_raw")
+    __slots__ = ("fwd", "bwd", "out_tree", "bwd_ok", "_run_raw", "uid")
 
     def __init__(self, fwd, bwd):
         self.fwd = fwd
@@ -136,6 +167,7 @@ class _ExecEntry:
         # grads then re-derive eagerly from concrete primals
         self.bwd_ok = True
         self._run_raw = None
+        self.uid = next(_ENTRY_UIDS)
 
 
 _UNFINGERPRINTABLE = object()
@@ -282,8 +314,13 @@ def _set_op_profiling(on: bool) -> None:
 
 def _dispatch(opdef: OpDef, args, kwargs):
     """The eager per-op path (ad_func analog)."""
-    bound = opdef.sig.bind(*args, **kwargs)
-    arguments = dict(bound.arguments)
+    names = opdef.pos_names
+    if (names is not None and not kwargs
+            and opdef.n_required <= len(args) <= len(names)):
+        arguments = dict(zip(names, args))
+    else:
+        bound = opdef.sig.bind(*args, **kwargs)
+        arguments = dict(bound.arguments)
 
     # --- AMP logic (ref: eager_gen.py template "AMP Logic") ---
     from ..amp.state import maybe_cast_inputs
@@ -433,11 +470,15 @@ def _dispatch(opdef: OpDef, args, kwargs):
                            [leaves[i] for i in diff_pos], out_avals,
                            replay_fn=g, primal_arrays=list(primals))
     if entry is not None:
-        # batched-dispatch fusion handle: the dispatch queue re-derives
-        # this node's cotangent contraction from (entry._run_raw,
-        # primals, nondiffs) inside a fused trace — the same packing
-        # entry.bwd jits per-node, chained across consecutive
-        # single-consumer nodes instead (tape.dispatch_queue)
+        # fused-dispatch handle: the dispatch queue re-derives this
+        # node's cotangent contraction from (entry._run_raw, primals,
+        # nondiffs) inside a fused trace — the same packing entry.bwd
+        # jits per-node, composed across whole graph regions instead
+        # (autograd.dispatch_queue). Multi-consumer outputs fuse too:
+        # fan-in cotangent accumulation happens inside the fused body,
+        # so the handle is attached for EVERY exec-cached node — only
+        # nodes without an entry (PyLayer, RNG-consuming, uncacheable
+        # signatures, record_apply) always dispatch per-node.
         node.fuse_info = (entry, primals, tuple(nondiff_arrs))
 
     out = jax.tree_util.tree_unflatten(out_tree, list(flat_out))

@@ -262,6 +262,20 @@ class Optimizer:
     # ------------------------------------------------------------------
     _FUSED_FAIL = object()
 
+    def _lr32(self, lr):
+        """Cached f32 device scalar for the step's learning rate: the
+        python-float -> device conversion dispatches an XLA convert
+        (~90us measured on the CPU box) and the lr is constant across
+        steps for fixed-lr training — one conversion per VALUE, not
+        per step. Schedulers that change lr every step just refresh
+        the one-entry cache (same cost as before)."""
+        hit = self.__dict__.get("_lr32_cache")
+        if hit is not None and hit[0] == lr:
+            return hit[1]
+        lr32 = jnp.asarray(lr, jnp.float32)
+        self.__dict__["_lr32_cache"] = (lr, lr32)
+        return lr32
+
     def _fused_step_apply(self, params_grads, lr) -> bool:
         import os
         if not params_grads or os.environ.get(
@@ -300,12 +314,17 @@ class Optimizer:
         # instance-level hypers (self.beta1/epsilon/rho/...) are traced
         # into the executable as constants exactly like group hypers —
         # fingerprint them so mid-training mutation recompiles instead
-        # of being silently ignored on the fused path
+        # of being silently ignored on the fused path. Keyed on dtype
+        # OBJECTS, not str(dtype): np.dtype hashes fast and is exactly
+        # as discriminating, while the str() form paid a numpy
+        # name-building pass per param per step (~100us/step on the
+        # bench MLP — the same lesson registry._cache_key learned in
+        # ISSUE 10)
         key = (self._hyper_fingerprint(),) + tuple(
-            (w.shape, str(w.dtype), str(g.dtype),
-             tuple(sorted((k, v.shape, str(v.dtype))
+            (w.shape, w.dtype, g.dtype,
+             tuple(sorted((k, v.shape, v.dtype)
                           for k, v in s.items())),
-             has_mw, p._data.dtype.name if has_mw else None,
+             has_mw, p._data.dtype if has_mw else None,
              hyper_fp(grp))
             for (p, grp, has_mw), w, g, s in zip(infos, work, garrs,
                                                  states))
@@ -344,7 +363,7 @@ class Optimizer:
             # would dereference deleted state buffers. Donation covers
             # ONLY the accumulator states (see the donation-safety
             # contract above): params/grads are externally visible.
-            lr32 = jnp.asarray(lr, jnp.float32)
+            lr32 = self._lr32(lr)
             import time as _time
             t_compile = _time.perf_counter()
             try:
@@ -365,7 +384,7 @@ class Optimizer:
             if _om._ENABLED:
                 _fused_counter("compile")
                 _fused_compile_time(_time.perf_counter() - t_compile)
-        lr32 = jnp.asarray(lr, jnp.float32)
+        lr32 = self._lr32(lr)
         new_w, new_s, casts = entry(lr32, work, garrs, states)
         for (p, _, has_mw), nw, ns, cast in zip(infos, new_w, new_s,
                                                 casts):

@@ -1,8 +1,10 @@
-"""Batched backward dispatch (ISSUE 10): bit-identical-gradients suite
-(batched vs per_node across hooks, retain_graph, create_graph,
-multi-consumer fan-in, dead output slots, the fused-optimizer
-end-to-end path), mode controls, fused-chain degradation, and the
-bandwidth-window-validated autotune sweep."""
+"""Batched + whole-graph backward dispatch (ISSUE 10/13):
+bit-identical-gradients suite (whole_graph vs batched vs per_node
+across hooks, retain_graph, create_graph, multi-consumer fan-in, dead
+output slots, the fused-optimizer end-to-end path), mode controls,
+fused-segment degradation, the whole-graph trace cache
+(hit/miss/bypass telemetry, invalidation), the backward compile-family
+budget, and the bandwidth-window-validated autotune sweep."""
 import os
 
 import numpy as np
@@ -21,7 +23,7 @@ def _clean():
     yield
     obs.disable()
     obs.reset()
-    dq.set_dispatch_mode("batched")
+    dq.set_dispatch_mode("whole_graph")
 
 
 def _params(seed=0, n=16):
@@ -44,13 +46,16 @@ def _bit_identical(a, b):
 # ---------------------------------------------------------------------------
 class TestBitIdenticalGradients:
     def _both_modes(self, fn):
+        """Run `fn` under every dispatch mode; gradients must be
+        bit-identical to the per_node reference in all of them."""
         with dq.backward_dispatch_mode("per_node"):
             a = fn()
-        with dq.backward_dispatch_mode("batched"):
-            b = fn()
-        assert len(a) == len(b)
-        for ga, gb in zip(a, b):
-            assert _bit_identical(ga, gb)
+        for mode in ("batched", "whole_graph"):
+            with dq.backward_dispatch_mode(mode):
+                b = fn()
+            assert len(a) == len(b)
+            for ga, gb in zip(a, b):
+                assert _bit_identical(ga, gb), mode
         return a
 
     def test_linear_chain(self):
@@ -63,7 +68,7 @@ class TestBitIdenticalGradients:
         self._both_modes(run)
 
     def test_hooks_fire_identically(self):
-        fired = {"per_node": 0, "batched": 0}
+        fired = {"per_node": 0, "batched": 0, "whole_graph": 0}
 
         def run():
             mode = dq.dispatch_mode()
@@ -78,7 +83,8 @@ class TestBitIdenticalGradients:
             loss.backward()
             return [w1.grad.numpy(), w2.grad.numpy()]
         self._both_modes(run)
-        assert fired["per_node"] == fired["batched"] == 1
+        assert fired["per_node"] == fired["batched"] \
+            == fired["whole_graph"] == 1
 
     def test_leaf_hook_identical(self):
         def run():
@@ -260,15 +266,291 @@ class TestFusion:
 
 
 # ---------------------------------------------------------------------------
+# whole-graph fusion (ISSUE 13): fan-in crossing, graph trace cache,
+# degradation ladder
+# ---------------------------------------------------------------------------
+class TestWholeGraph:
+    def _snap(self, name):
+        return obs.snapshot()[name]["series"]
+
+    def _graph_cache(self):
+        # zero-valued rows are label sets other tests registered
+        # before obs.reset() (reset zeroes values but keeps series)
+        s = self._snap("paddle_tpu_backward_graph_cache_total")
+        return {k[0]: int(v) for k, v in s.items() if v}
+
+    def _fan_in_loss(self, w1, w2, x):
+        # y feeds THREE consumers: the PR 10 chain engine fragments
+        # here, the whole-graph engine accumulates y's cotangent
+        # inside the fused trace
+        y = pt.ops.tanh(pt.matmul(x, w1))
+        return (y * y + pt.ops.tanh(y) + pt.matmul(y, w2)).mean()
+
+    def test_fan_in_fuses_into_one_dispatch(self):
+        dq.clear_chain_cache()
+        obs.enable()
+        w1, w2, x = _params()
+        with dq.backward_dispatch_mode("whole_graph"):
+            self._fan_in_loss(w1, w2, x).backward()
+        batch = self._snap("paddle_tpu_dispatch_batch_size")[()]
+        assert batch["count"] == 1          # the WHOLE graph, one call
+        assert batch["max"] == batch["sum"] >= 6
+        gap = self._snap("paddle_tpu_dispatch_gap_seconds")[()]
+        assert gap["count"] == 0
+        assert self._graph_cache() == {"miss": 1}
+
+    def test_chain_mode_fragments_the_same_graph(self):
+        # the A/B rung: batched (PR 10) stops at the fan-in junction,
+        # whole_graph does not — same graph, different dispatch counts
+        dq.clear_chain_cache()
+        obs.enable()
+        w1, w2, x = _params()
+        with dq.backward_dispatch_mode("batched"):
+            self._fan_in_loss(w1, w2, x).backward()
+        batch = self._snap("paddle_tpu_dispatch_batch_size")[()]
+        assert batch["count"] > 1
+        # chain mode records no whole-graph cache outcomes
+        assert self._graph_cache() == {}
+
+    def test_steady_state_hits_graph_cache(self):
+        dq.clear_chain_cache()
+        obs.enable()
+        w1, w2, x = _params()
+        with dq.backward_dispatch_mode("whole_graph"):
+            for _ in range(3):
+                self._fan_in_loss(w1, w2, x).backward()
+                w1.clear_gradient()
+                w2.clear_gradient()
+        assert self._graph_cache() == {"miss": 1, "hit": 2}
+        assert dq.chain_cache_size() == 1   # one whole-graph entry
+
+    def test_root_seeded_interior_and_queue_absorption(self):
+        # two roots backward()ed together: the second root is an
+        # interior node of the first's graph AND sits ready in the
+        # queue when the walk starts — both PR 10 exclusions (root
+        # seeds, non-empty queue) must now ride the fused run
+        def run():
+            w1, w2, x = _params()
+            h = pt.ops.tanh(pt.matmul(x, w1))
+            loss = (pt.matmul(h, w2) ** 2).mean() + h.sum()
+            loss.backward()
+            return [w1.grad.numpy(), w2.grad.numpy()]
+        with dq.backward_dispatch_mode("per_node"):
+            ref = run()
+        obs.enable()
+        with dq.backward_dispatch_mode("whole_graph"):
+            got = run()
+        for a, b in zip(ref, got):
+            assert _bit_identical(a, b)
+        batch = self._snap("paddle_tpu_dispatch_batch_size")[()]
+        assert batch["count"] == 1          # still ONE fused dispatch
+        assert self._graph_cache().get("bypass", 0) == 0
+
+    def test_mid_graph_hook_degrades_only_locally(self):
+        # a hook on one interior tensor splits the graph into two
+        # fused segments around the hooked node — it does NOT collapse
+        # the backward to per-node, and the hooked node itself heads
+        # the second segment after its hook fires host-side
+        dq.clear_chain_cache()
+        obs.enable()
+        fired = []
+        w1, w2, x = _params()
+        with dq.backward_dispatch_mode("whole_graph"):
+            h = pt.ops.tanh(pt.matmul(x, w1))
+            h.register_hook(lambda g: fired.append(1) or g * 2)
+            loss = (pt.matmul(h, w2) ** 2).mean()
+            loss.backward()
+        assert fired == [1]
+        batch = self._snap("paddle_tpu_dispatch_batch_size")[()]
+        assert batch["count"] == 2          # two segments, no 1-runs
+        assert batch["min"] >= 2
+        assert batch["sum"] == 5            # every node dispatched
+        assert self._graph_cache() == {"bypass": 1}
+
+    def test_cache_invalidation_on_topology_change(self):
+        dq.clear_chain_cache()
+        obs.enable()
+        w1, w2, x = _params()
+        with dq.backward_dispatch_mode("whole_graph"):
+            self._fan_in_loss(w1, w2, x).backward()
+            w1.clear_gradient()
+            w2.clear_gradient()
+            # different topology (extra consumer of y) must MISS
+            y = pt.ops.tanh(pt.matmul(x, w1))
+            (y * y + pt.ops.tanh(y) + pt.matmul(y, w2)
+             + y.sum()).mean().backward()
+        gc = self._graph_cache()
+        assert gc["miss"] == 2 and "hit" not in gc
+        assert dq.chain_cache_size() == 2
+
+    def test_cache_invalidation_on_exec_entry_change(self):
+        # a re-created exec-cache entry has a NEW uid: the whole-graph
+        # key must miss instead of silently reusing a trace derived
+        # from the dead entry
+        dq.clear_chain_cache()
+        obs.enable()
+        w1, w2, x = _params()
+        with dq.backward_dispatch_mode("whole_graph"):
+            self._fan_in_loss(w1, w2, x).backward()
+            w1.clear_gradient()
+            w2.clear_gradient()
+            pt.ops.tanh.op_def.exec_cache.clear()   # entries rebuild
+            self._fan_in_loss(w1, w2, x).backward()
+        gc = self._graph_cache()
+        assert gc["miss"] == 2 and "hit" not in gc
+
+    def test_clear_chain_cache_clears_graph_cache(self):
+        dq.clear_chain_cache()
+        obs.enable()
+        w1, w2, x = _params()
+        with dq.backward_dispatch_mode("whole_graph"):
+            self._fan_in_loss(w1, w2, x).backward()
+            w1.clear_gradient()
+            w2.clear_gradient()
+            dq.clear_chain_cache()          # ONE cache for both tiers
+            assert dq.chain_cache_size() == 0
+            self._fan_in_loss(w1, w2, x).backward()
+        assert self._graph_cache() == {"miss": 2}
+
+    def test_disabled_segment_memoizes_head(self):
+        # an untraceable whole-graph composition must not cost a
+        # re-plan (O(remaining) host work) on every later backward:
+        # the head's entry uid is memoized, the head dispatches
+        # per-node outright, and the REMAINDER still fuses
+        dq.clear_chain_cache()
+        obs.enable()
+        w1, w2, x = _params()
+        with dq.backward_dispatch_mode("whole_graph"):
+            loss = self._fan_in_loss(w1, w2, x)
+            loss.backward(retain_graph=True)        # miss, whole graph
+            (_key, fused), = dq._CHAIN_CACHE.items()
+            fused.disabled = True                   # simulate bad trace
+            w1.clear_gradient()
+            w2.clear_gradient()
+            loss.backward(retain_graph=True)        # disabled hit
+            assert dq._DISABLED_HEAD_UIDS           # head memoized
+            w1.clear_gradient()
+            w2.clear_gradient()
+            loss.backward()                         # memo: no re-plan
+        gc = self._graph_cache()
+        # first backward covered the whole graph; the two degraded
+        # ones fragmented (head per-node + fused remainder)
+        assert gc == {"miss": 1, "bypass": 2}
+        batch = self._snap("paddle_tpu_dispatch_batch_size")[()]
+        assert batch["min"] == 1                    # the degraded head
+        # N + 2*(1 + (N-1)) = 3N nodes dispatched over the 3 backwards
+        assert batch["sum"] == 3 * batch["max"]
+        assert w1.grad is not None
+        dq.clear_chain_cache()
+        assert not dq._DISABLED_HEAD_UIDS           # cleared with cache
+
+    def test_retain_graph_whole_graph_bit_identical(self):
+        def run():
+            w1, w2, x = _params()
+            loss = self._fan_in_loss(w1, w2, x)
+            loss.backward(retain_graph=True)
+            loss.backward()
+            return [w1.grad.numpy(), w2.grad.numpy()]
+        with dq.backward_dispatch_mode("per_node"):
+            ref = run()
+        with dq.backward_dispatch_mode("whole_graph"):
+            got = run()
+        for a, b in zip(ref, got):
+            assert _bit_identical(a, b)
+
+    def test_create_graph_second_order_fan_in(self):
+        def run():
+            w1, w2, x = _params()
+            loss = self._fan_in_loss(w1, w2, x)
+            (g,) = pt.autograd.grad(loss, [w1], create_graph=True)
+            (gg,) = pt.autograd.grad(g.sum(), [w1])
+            return [gg.numpy()]
+        with dq.backward_dispatch_mode("per_node"):
+            ref = run()
+        with dq.backward_dispatch_mode("whole_graph"):
+            got = run()
+        assert _bit_identical(ref[0], got[0])
+
+    def test_dead_output_slot_fan_in(self):
+        def run():
+            w1, _, x = _params()
+            h = pt.matmul(x, w1)
+            a, b = pt.split(h, 2, axis=1)   # b's cotangent slot dead
+            loss = (a ** 2).mean() + (a * a).sum()
+            loss.backward()
+            return [w1.grad.numpy()]
+        with dq.backward_dispatch_mode("per_node"):
+            ref = run()
+        with dq.backward_dispatch_mode("whole_graph"):
+            got = run()
+        assert _bit_identical(ref[0], got[0])
+
+
+# ---------------------------------------------------------------------------
+# backward compile-family budget (ISSUE 13 satellite): steady-state
+# eager training is O(1) executables and O(1) dispatches per step
+# ---------------------------------------------------------------------------
+class TestBackwardFamilyBudget:
+    BUDGET = 2      # ONE whole-graph executable expected for a fixed
+                    # MLP train loop; 2 leaves headroom for a seed-
+                    # layout variant, never a per-step zoo
+
+    def test_mlp_train_loop_is_one_fused_dispatch_per_step(self):
+        dq.clear_chain_cache()
+        rng = np.random.default_rng(11)
+        layers = [pt.nn.Linear(16, 16) for _ in range(3)]
+        for lyr in layers:
+            for p in lyr.parameters():
+                p.set_value(pt.to_tensor(
+                    rng.standard_normal(p.shape).astype(np.float32)))
+        params = [p for lyr in layers for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-3, parameters=params)
+        x = pt.to_tensor(rng.standard_normal((4, 16)).astype(np.float32))
+
+        def step():
+            h = x
+            for lyr in layers[:-1]:
+                h = pt.ops.tanh(lyr(h))
+            loss = (layers[-1](h) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        with dq.backward_dispatch_mode("whole_graph"):
+            for _ in range(2):              # warmup: trace + compile
+                step()
+            obs.enable()
+            for _ in range(3):              # steady state, observed
+                step()
+        snap = obs.snapshot()
+        gc = {k[0]: int(v) for k, v in snap[
+            "paddle_tpu_backward_graph_cache_total"]["series"].items()
+            if v}
+        assert gc == {"hit": 3}             # every step: cached whole graph
+        batch = snap["paddle_tpu_dispatch_batch_size"]["series"][()]
+        assert batch["count"] == 3          # EXACTLY 1 fused call/step
+        assert batch["min"] == batch["max"] >= 6
+        comp = snap["paddle_tpu_compile_total"]["series"]
+        fused_compiles = sum(v for (fam,), v in comp.items()
+                             if fam == "backward_fused" and v)
+        # steady state compiled NOTHING new (warmup predates obs)
+        assert fused_compiles == 0
+        # the process-global cache holds the one whole-graph entry
+        # this loop uses (other tests' entries were cleared above)
+        assert dq.chain_cache_size() <= self.BUDGET
+
+
+# ---------------------------------------------------------------------------
 # mode controls
 # ---------------------------------------------------------------------------
 class TestModeControls:
-    def test_default_is_batched(self):
-        assert dq.dispatch_mode() == "batched"
+    def test_default_is_whole_graph(self):
+        assert dq.dispatch_mode() == "whole_graph"
+        assert dq._VALID_MODES == ("whole_graph", "batched", "per_node")
 
     def test_set_and_restore(self):
         old = dq.set_dispatch_mode("per_node")
-        assert old == "batched"
+        assert old == "whole_graph"
         assert dq.dispatch_mode() == "per_node"
         dq.set_dispatch_mode(old)
 
@@ -281,7 +563,7 @@ class TestModeControls:
             with dq.backward_dispatch_mode("per_node"):
                 assert dq.dispatch_mode() == "per_node"
                 raise RuntimeError("boom")
-        assert dq.dispatch_mode() == "batched"
+        assert dq.dispatch_mode() == "whole_graph"
 
 
 # ---------------------------------------------------------------------------

@@ -443,6 +443,30 @@ class TestPerfLedger:
         assert g["baseline_rev"] == "rev_a"
         assert not g["regressed"]
 
+    def test_whole_graph_mode_and_graph_cache_ride_the_ledger(
+            self, tmp_path):
+        # ISSUE 13: whole_graph records baseline per (config, mode)
+        # like the PR 10 modes, and their graph-cache counts are
+        # echoed in the verdict and the trajectory (report-only)
+        pl = _perf_ledger()
+        p = str(tmp_path / "ledger.jsonl")
+        recs = [
+            _ledger_record("rev_a", "dispatch", {}, mode="whole_graph",
+                           gap_ms_per_step=0.0),
+            _ledger_record("rev_b", "dispatch", {}, mode="whole_graph",
+                           gap_ms_per_step=0.004),
+        ]
+        recs[-1]["graph_cache"] = {"hit": 20, "miss": 1}
+        self._write(p, recs)
+        records, _ = pl.load(p)
+        v = pl.check(records, tol=0.2)
+        assert v["pass"]            # 0.004 is under the absolute floor
+        out = v["configs"]["dispatch[whole_graph]"]
+        assert out["graph_cache"] == {"hit": 20, "miss": 1}
+        traj = pl.trajectory(records)
+        assert "(graph cache)" in traj
+        assert "hit=20 miss=1 bypass=0" in traj
+
     def test_dispatch_gap_regression_fails_per_mode(self, tmp_path):
         pl = _perf_ledger()
         p = str(tmp_path / "ledger.jsonl")
@@ -566,3 +590,14 @@ class TestObsTopRooflinePanel:
         frame = obs_top.render(doc, prev, dt=1.0)
         # the between-frames window holds 3 gaps, not the cumulative 4
         assert "n=3" in frame
+
+    def test_renders_graph_cache_line(self):
+        obs_top = self._obs_top()
+        obs.enable()
+        for _ in range(9):
+            perf.note_graph_cache("hit")
+        perf.note_graph_cache("miss")
+        frame = obs_top.render(json.loads(obs.to_json()))
+        assert "graph cache" in frame
+        assert "90.0%" in frame
+        assert "9 hit / 1 miss / 0 bypass" in frame

@@ -413,24 +413,42 @@ def _collect(loader):
 
 class TestSelfHealingDataLoader:
     def test_worker_killed_mid_epoch_heals(self):
+        # the FATAL healing contract: hard-exit (SIGKILL-equivalent:
+        # no error report, no cleanup) worker 0 the first time it
+        # reaches batch 2. The respawn batch NUMBER is load-dependent
+        # — the hard exit can kill the queue's feeder thread before
+        # batch 0's pickle ever reaches the pipe, in which case the
+        # parent (correctly) respawns at batch 0 — so only the respawn
+        # itself is asserted; the real contract is the batch-exact
+        # healed epoch checked below. The /dev/shm accounting lives in
+        # its own (flaky-listed) test so THIS correctness contract can
+        # never ride out a timing race un-asserted.
         ds = ShmDs(n=24)
         serial = _collect(DataLoader(ds, batch_size=4, num_workers=0))
-        # hard-exit (SIGKILL-equivalent: no error report, no cleanup)
-        # worker 0 the first time it reaches batch 2. The respawn batch
-        # NUMBER is load-dependent — the hard exit can kill the queue's
-        # feeder thread before batch 0's pickle ever reaches the pipe,
-        # in which case the parent (correctly) respawns at batch 0 —
-        # so only the respawn itself is asserted; the real contract is
-        # the batch-exact healed epoch checked below.
-        #
-        # The shm-leak assert is best-of-2: _process_worker documents a
-        # real residual window (a hard kill landing strictly between
-        # segment creation in _pack and the payload reaching the
-        # parent's queue loses that batch's segment names with the
-        # dead worker), so under full-suite load one attempt can
-        # legitimately leak a segment. A SYSTEMATIC leak still fails
-        # both attempts; the healed-epoch exactness is asserted on
-        # every attempt.
+        with faults.inject("io.worker.batch", exit_code=1, times=1,
+                           match={"bi": 2, "attempt": 0}):
+            with pytest.warns(UserWarning,
+                              match="respawning at batch"):
+                healed = _collect(DataLoader(ds, batch_size=4,
+                                             num_workers=2))
+        assert len(healed) == len(serial) == 6
+        for (sx, sy), (px, py) in zip(serial, healed):
+            np.testing.assert_array_equal(sx, px)
+            np.testing.assert_array_equal(sy, py)
+
+    def test_worker_kill_shm_leak_accounting(self):
+        # the shm-leak accounting for the same kill scenario, split
+        # out (ISSUE 13) so its timing race never exempts the healing
+        # contract above: _process_worker documents a real residual
+        # window (a hard kill landing strictly between segment
+        # creation in _pack and the payload reaching the parent's
+        # queue loses that batch's segment names with the dead
+        # worker), so one attempt can legitimately leak a segment —
+        # best-of-2, and the test is on tools/known_failures.json's
+        # "flaky" list (reported, not fatal) because the race loses
+        # both attempts under load on the shared box. A SYSTEMATIC
+        # leak still fails both attempts everywhere else.
+        ds = ShmDs(n=24)
         leaked = None
         for _attempt in range(2):
             before = _shm_segments()
@@ -440,10 +458,7 @@ class TestSelfHealingDataLoader:
                                   match="respawning at batch"):
                     healed = _collect(DataLoader(ds, batch_size=4,
                                                  num_workers=2))
-            assert len(healed) == len(serial) == 6
-            for (sx, sy), (px, py) in zip(serial, healed):
-                np.testing.assert_array_equal(sx, px)
-                np.testing.assert_array_equal(sy, py)
+            assert len(healed) == 6
             leaked = None if before is None \
                 else _shm_segments() - before
             if not leaked:

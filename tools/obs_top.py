@@ -275,7 +275,10 @@ def render(doc, prev=None, dt=None) -> str:
                 s["labels"]["bound"]] = s["value"]
     gap = _hist_quantiles(doc, "paddle_tpu_dispatch_gap_seconds",
                           prev=prev)
-    if roof or gap:
+    gc_name = "paddle_tpu_backward_graph_cache_total"
+    gc = {o: _counter_sum(doc, gc_name, outcome=o)
+          for o in ("hit", "miss", "bypass")}
+    if roof or gap or any(gc.values()):
         lines.append("== roofline ==")
         for fam, bounds in sorted(roof.items()):
             lines.append(f"  {fam:<16} " + "  ".join(
@@ -283,6 +286,12 @@ def render(doc, prev=None, dt=None) -> str:
         if gap:
             lines.append(f"  dispatch gap   p50={_ms(gap['p50'])}  "
                          f"p95={_ms(gap['p95'])}  n={gap['count']}")
+        if any(gc.values()):
+            total = sum(gc.values())
+            lines.append(
+                f"  graph cache    hit={gc['hit'] / total:6.1%}  "
+                f"({int(gc['hit'])} hit / {int(gc['miss'])} miss / "
+                f"{int(gc['bypass'])} bypass backwards)")
 
     comp = _series(doc, "paddle_tpu_compile_total")
     if comp:

@@ -27,11 +27,15 @@ hot path in this repo is bandwidth-dominated, see BENCH_EXTRA).
     running — the regression observability itself would otherwise
     hide);
   * records carrying a backward dispatch `mode` (bench.py --config
-    dispatch writes one per mode) are baselined per (config, mode),
-    and their `dispatch_gap.ms_per_step` is checked the same way
-    bytes/s is — a latest gap total ABOVE (1 + tol) x the best
-    prior-revision record for the same (config, mode) fails, so the
-    batched engine's host-gap win cannot silently erode;
+    dispatch writes one per mode: per_node, batched, whole_graph) are
+    baselined per (config, mode), and their
+    `dispatch_gap.ms_per_step` is checked the same way bytes/s is — a
+    latest gap total ABOVE (1 + tol) x the best prior-revision record
+    for the same (config, mode) fails, so the fused engines' host-gap
+    win cannot silently erode; a whole_graph record's `graph_cache`
+    hit/miss/bypass counts ride the record and are echoed in the
+    verdict (report-only: steady-state O(1) dispatch shows as hits
+    dominating);
   * records carrying a fleet `process_role` (observability.fleet's
     `append_capacity_ledger` writes one per process) are baselined per
     (config, process_role), and their `capacity.req_per_s` /
@@ -191,6 +195,13 @@ def check(records, tol: float, only_config=None) -> dict:
                     gout["regressed"] = True
                     out["pass"] = False
             out["dispatch_gap"] = gout
+        # whole-graph trace-cache counts ride along report-only: the
+        # steady-state claim (hits dominate) is pinned by tests; here
+        # the verdict just keeps the observability next to the gap it
+        # explains
+        gc = latest.get("graph_cache")
+        if isinstance(gc, dict):
+            out["graph_cache"] = gc
         # fleet capacity regression: achieved rates are the bytes/s
         # rule again — the latest record's req/s / tok/s below
         # (1 - tol) x the best prior-revision record for the same
@@ -251,6 +262,13 @@ def trajectory(records) -> str:
             lines.append(f"{ckey:<22} {rec.get('rev', '?'):<19} "
                          f"{'(dispatch gap)':<16} "
                          f"{gap:9.4f} ms/step")
+        gc = rec.get("graph_cache")
+        if isinstance(gc, dict):
+            lines.append(
+                f"{ckey:<22} {rec.get('rev', '?'):<19} "
+                f"{'(graph cache)':<16} "
+                + " ".join(f"{k}={gc.get(k, 0)}"
+                           for k in ("hit", "miss", "bypass")))
         cap = rec.get("capacity")
         if isinstance(cap, dict):
             req, tok = cap.get("req_per_s"), cap.get("tok_per_s")
