@@ -1160,6 +1160,87 @@ def bench_router_serving(on_tpu):
     }
 
 
+def bench_comms(on_tpu):
+    """Collective microbench sweep (op x payload size) over the full
+    device mesh (main() forces the 8-device CPU mesh when the config is
+    requested on a CPU box). Eager collectives run with observability
+    ON, so every timed window carries a real completion edge
+    (observability.comms blocks on the result inside the timing span)
+    — the achieved bytes/s per op is launch→completion algorithmic
+    bandwidth, not dispatch fiction. The per-op windows land in the
+    perf ledger as `comms_<op>` families, so `tools/perf_ledger.py
+    --check` baselines achieved comms bandwidth per (config, op) via
+    the existing per-family bytes/s rule."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import comms
+    import paddle_tpu.distributed as dist
+
+    g = dist.new_group()        # the default (world) group
+    n = g.nranks
+    iters = 20 if on_tpu else 6
+    # per-rank payload bytes; dim1 stays divisible by n for
+    # reduce_scatter/all_to_all chunking
+    sizes = (1 << 14, 1 << 18, 1 << 20) if on_tpu \
+        else (1 << 14, 1 << 18)
+
+    def make(nbytes):
+        elems = max(nbytes // 4 // n * n, n)
+        return jnp.zeros((n, elems), jnp.float32)
+
+    # op runners take a fresh rank-major Tensor each call so in-place
+    # mutation (_set_data) can't alias across iterations
+    import paddle_tpu as pt
+    ops = {
+        "all_reduce": lambda x: dist.all_reduce(pt.to_tensor(x)),
+        "all_gather": lambda x: dist.all_gather(pt.to_tensor(x)),
+        "reduce_scatter": lambda x: dist.reduce_scatter(
+            pt.to_tensor(x)),
+        "broadcast": lambda x: dist.broadcast(pt.to_tensor(x), src=0),
+        "all_to_all": lambda x: dist.all_to_all(pt.to_tensor(x)),
+    }
+    payloads = {nb: make(nb) for nb in sizes}
+    # warm every (op, payload) executable OUTSIDE the measured window,
+    # then reset so the ledger families cover only steady-state calls
+    for fn in ops.values():
+        for x in payloads.values():
+            fn(x)
+    obs.reset()
+    per_op = {}
+    for name, fn in ops.items():
+        t0 = time.perf_counter()
+        for x in payloads.values():
+            for _ in range(iters):
+                fn(x)
+        per_op[name] = {"wall_s": round(time.perf_counter() - t0, 4)}
+    fams = comms.family_records()
+    total_bytes = total_s = 0.0
+    for name in ops:
+        rec = fams.get("comms_" + name) or {}
+        bps = rec.get("achieved_bytes_per_s")
+        per_op[name]["bytes_per_s"] = bps
+        per_op[name]["runs"] = rec.get("runs", 0)
+        if bps and rec.get("seconds"):
+            total_bytes += bps * rec["seconds"]
+            total_s += rec["seconds"]
+    agg = total_bytes / total_s if total_s > 0 else 0.0
+    dev = jax.devices()[0]
+    return {
+        "metric": "comms_bytes_per_sec",
+        "value": round(agg, 1),
+        "unit": "bytes/s",
+        "vs_baseline": 1.0,     # baselined by the perf ledger per op
+        "extra": {
+            "per_op": per_op,
+            "devices": n,
+            "iters": iters,
+            "payload_bytes": list(sizes),
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+        },
+    }
+
+
 def bench_lint(on_tpu):
     """Static-analysis trajectory: run graftlint over paddle_tpu/ +
     tools/ against the checked-in baseline, write the full machine
@@ -1203,6 +1284,7 @@ def bench_lint(on_tpu):
 CONFIGS = {
     "gpt2s": bench_gpt2_small,
     "lint": bench_lint,
+    "comms": bench_comms,
     "gpt1p3b": bench_gpt_1p3b,
     "resnet50": bench_resnet50,
     "bert": bench_bert_base,
@@ -1363,7 +1445,12 @@ def _append_perf_ledger(path, name, result, modes=None):
                 rec["graph_cache"] = m["graph_cache"]
             records.append(rec)
     else:
+        from paddle_tpu.observability import comms as _comms
         fams = perf.family_records()
+        # collective windows ride as comms_<op> pseudo-families, so
+        # tools/perf_ledger.py --check's per-family bytes/s rule
+        # baselines comms bandwidth per (config, op) with no new rule
+        fams.update(_comms.family_records())
         if fams:
             rec = dict(base)
             rec["families"] = fams
@@ -1527,6 +1614,22 @@ def main():
                     help=argparse.SUPPRESS)   # internal: --gate child
     args = ap.parse_args()
 
+    if args.config == "comms" and not args.all:
+        # the comms sweep wants the 8-device mesh; on a CPU box that
+        # means the forced host-platform device count, and it must be
+        # in the env BEFORE the first backend query (jax is imported
+        # below; sitecustomize may have imported the module already,
+        # but XLA flags are read at backend init). Scoped to a
+        # comms-only invocation: the flag is process-global, and
+        # forcing it under --all would silently re-topology every
+        # OTHER config's ledger baseline — --all runs comms in a
+        # child process instead (see the main loop).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     import jax
     on_tpu = jax.devices()[0].platform != "cpu"
     if args.window_server:
@@ -1539,6 +1642,32 @@ def main():
     from paddle_tpu import observability as obs
     names = list(CONFIGS) if args.all else [args.config]
     for name in names:
+        if name == "comms" and args.all:
+            # device topology is process-global: the comms sweep's
+            # forced 8-device mesh must not re-topology the other
+            # configs of an --all run, so it gets its own process
+            # (which appends its own ledger records)
+            import subprocess
+            import sys
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--config", "comms", "--ledger", args.ledger]
+            if args.no_obs:
+                cmd.append("--no-obs")
+            if args.no_ledger:
+                cmd.append("--no-ledger")
+            child = subprocess.run(cmd, capture_output=True, text=True)
+            line = (child.stdout.strip().splitlines() or [""])[-1]
+            if child.returncode == 0 and line:
+                print(line, flush=True)
+            else:
+                print(json.dumps({
+                    "metric": "comms_bytes_per_sec", "value": None,
+                    "unit": "bytes/s", "vs_baseline": 0.0,
+                    "extra": {"error": "comms child failed",
+                              "rc": child.returncode,
+                              "stderr": child.stderr[-500:]}}),
+                    flush=True)
+            continue
         if not args.no_obs:
             # per-config window so each BENCH line carries ITS series
             # (step-latency histogram summary, preemption / fused-step

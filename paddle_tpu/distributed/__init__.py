@@ -6,7 +6,7 @@ groups are mesh axes; collectives are XLA/GSPMD; hybrid parallel is
 sharding placement + a host-driven pipeline schedule.
 """
 from .communication import (  # noqa: F401
-    ReduceOp, Group, new_group, get_group, is_initialized,
+    ReduceOp, Group, Work, new_group, get_group, is_initialized,
     destroy_process_group, all_reduce, all_gather, all_to_all, alltoall,
     reduce, broadcast, reduce_scatter, scatter, barrier, send, recv,
     isend, irecv, P2POp, batch_isend_irecv,

@@ -21,9 +21,24 @@ function has TWO modes:
    This single-controller rendering keeps the reference API shape
    (tests exercise it on the 8-device CPU mesh).
 
-Async `sync_op=False` returns a completed-Task shim: XLA dispatch is
+Async `sync_op=False` returns a `Work` handle: XLA dispatch is
 already async (the reference's async Task maps onto XLA async
-collectives, SURVEY §5.8).
+collectives, SURVEY §5.8); `wait()` blocks on the result and is the
+collective's observable COMPLETION edge — with observability on it
+closes the timing span, so async collectives measure launch→completion
+instead of reading as infinitely fast launches.
+
+Observability (README "Collective & mesh observability"): every public
+collective records through `observability.comms` —
+`paddle_tpu_collective_seconds{op,group}` latency (eager collectives
+only, completion-edge timed: sync collectives block on the result
+inside the timing window when observability is enabled — the roofline
+blocking-timed-launch precedent), payload bytes, algorithmic-bandwidth
+gauges against the ICI/DCN peak tables, and per-call `comms.arrival`
+events the fleet aggregator matches cross-rank for straggler
+attribution. In-trace collectives are count-only (host code runs once
+at trace time — a timing there would be fiction). One flag check per
+call when observability is off.
 """
 from __future__ import annotations
 
@@ -34,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import comms as _comms
+from ..observability import metrics as _om
 
 
 class ReduceOp:
@@ -164,25 +181,48 @@ def _in_trace(group: Group) -> bool:
     return all(a in names for a in axes)
 
 
-class _Task:
-    """Completed-task shim (XLA dispatch is already async)."""
+class Work:
+    """Async collective handle (completed-task shim for control flow —
+    XLA dispatch is already async). `wait()` blocks on the result and
+    CLOSES the observability timing span, so a `sync_op=False`
+    collective's measured latency covers launch→completion, never just
+    the launch. Idempotent: the first `wait()` records the sample,
+    repeats return immediately without double-counting."""
 
-    def __init__(self, result=None):
+    def __init__(self, result=None, rec=None):
         self._result = result
+        self._rec = rec
 
     def wait(self):
         if self._result is not None:
             jax.block_until_ready(
                 self._result._data if isinstance(self._result, Tensor)
                 else self._result)
+        rec, self._rec = self._rec, None
+        if rec is not None:
+            # result already blocked on above; if wait() is called
+            # long after completion the sample is an upper bound —
+            # wait() IS the caller-observable completion instant
+            _comms.finish(rec)
         return True
 
     def is_completed(self):
         return True
 
 
+_Task = Work        # legacy alias (pre-completion-edge name)
+
+
 def _unwrap(t):
     return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _nbytes(x) -> int:
+    """Payload bytes of an array/tracer (0 when unknowable)."""
+    try:
+        return int(x.size) * x.dtype.itemsize
+    except Exception:
+        return 0
 
 
 def _rankmajor(x, group: Group):
@@ -197,13 +237,21 @@ def _rankmajor(x, group: Group):
     return jax.device_put(x, NamedSharding(group.mesh, spec))
 
 
-def _finish(tensor, out, sync_op):
-    """Write result back in-place (paddle collectives mutate) and wrap."""
+def _finish(tensor, out, sync_op, rec=None):
+    """Write result back in-place (paddle collectives mutate) and wrap.
+    `rec` is the comms timing record: sync collectives close it here
+    with a completion edge (blocking on `out` — only ever reached with
+    observability enabled); async collectives hand it to the Work so
+    `wait()` closes it."""
     if isinstance(tensor, Tensor):
         tensor._set_data(out)
-        return _Task(tensor) if not sync_op else tensor
-    t = Tensor._wrap(out)
-    return _Task(t) if not sync_op else t
+        result = tensor
+    else:
+        result = Tensor._wrap(out)
+    if sync_op:
+        _comms.finish(rec, out)
+        return result
+    return Work(result, rec)
 
 
 # --------------------------------------------------------------------------
@@ -213,6 +261,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     group = _resolve_group(group)
     x = _unwrap(tensor)
     if _in_trace(group):
+        if _om._ENABLED:
+            _comms.count("all_reduce", group.axis_name, _nbytes(x))
         if op == ReduceOp.SUM:
             return Tensor._wrap(jax.lax.psum(x, group.mesh_axis))
         if op == ReduceOp.MAX:
@@ -222,6 +272,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if op == ReduceOp.AVG:
             return Tensor._wrap(jax.lax.pmean(x, group.mesh_axis))
         raise NotImplementedError("PROD inside trace")
+    rec = _comms.start("all_reduce", group.axis_name,
+                       _nbytes(x) // group.nranks) \
+        if _om._ENABLED else None
     x = _rankmajor(x, group)
     if op == ReduceOp.AVG:
         red = jnp.mean(x, axis=0, keepdims=True)
@@ -229,7 +282,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         red = _REDUCE_FNS[op][0](x, axis=0, keepdims=True)
     out = jnp.broadcast_to(red, x.shape)
     out = jax.device_put(out, x.sharding)
-    return _finish(tensor, out, sync_op)
+    return _finish(tensor, out, sync_op, rec)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -238,26 +291,36 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     if _in_trace(group):
         # every rank computes the reduction; dst semantics are a
         # multi-process artifact
+        if _om._ENABLED:
+            _comms.count("reduce", group.axis_name, _nbytes(x))
         return Tensor._wrap(jax.lax.psum(x, group.mesh_axis))
+    rec = _comms.start("reduce", group.axis_name,
+                       _nbytes(x) // group.nranks) \
+        if _om._ENABLED else None
     x = _rankmajor(x, group)
     dst_idx = group.get_group_rank(dst) if dst in group.ranks else dst
     red = _reduce_dim0(x, op)
     out = x.at[dst_idx].set(red)
-    return _finish(tensor, out, sync_op)
+    return _finish(tensor, out, sync_op, rec)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     group = _resolve_group(group)
     x = _unwrap(tensor)
     if _in_trace(group):
+        if _om._ENABLED:
+            _comms.count("broadcast", group.axis_name, _nbytes(x))
         src_idx = group.get_group_rank(src) if src in group.ranks else src
         out = jax.lax.all_gather(x, group.mesh_axis)[src_idx]
         return Tensor._wrap(out)
+    rec = _comms.start("broadcast", group.axis_name,
+                       _nbytes(x) // group.nranks) \
+        if _om._ENABLED else None
     x = _rankmajor(x, group)
     src_idx = group.get_group_rank(src) if src in group.ranks else src
     out = jnp.broadcast_to(x[src_idx:src_idx + 1], x.shape)
     out = jax.device_put(out, x.sharding)
-    return _finish(tensor, out, sync_op)
+    return _finish(tensor, out, sync_op, rec)
 
 
 def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
@@ -269,12 +332,17 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
         tensor, tensor_list = tensor_list, None
     x = _unwrap(tensor)
     if _in_trace(group):
+        if _om._ENABLED:
+            _comms.count("all_gather", group.axis_name, _nbytes(x))
         out = jax.lax.all_gather(x, group.mesh_axis)  # [G, ...]
         if tensor_list is not None:
             for i in range(group.nranks):
                 tensor_list.append(Tensor._wrap(out[i]))
-            return _Task() if not sync_op else None
+            return Work() if not sync_op else None
         return Tensor._wrap(out.reshape((-1,) + x.shape[1:]))
+    rec = _comms.start("all_gather", group.axis_name,
+                       _nbytes(x) // group.nranks) \
+        if _om._ENABLED else None
     x = _rankmajor(x, group)
     g = group.nranks
     # out[r] = concat of every rank's local tensor
@@ -287,8 +355,11 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
         per = out[0].reshape((g,) + x.shape[1:])
         for i in range(g):
             tensor_list.append(Tensor._wrap(per[i]))
-        return _Task() if not sync_op else None
-    return _finish(None, out, sync_op)
+        if sync_op:
+            _comms.finish(rec, per)
+            return None
+        return Work(Tensor._wrap(per), rec)
+    return _finish(None, out, sync_op, rec)
 
 
 def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
@@ -305,12 +376,16 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
     else:
         x = _unwrap(src)
     if _in_trace(group):
+        if _om._ENABLED:
+            _comms.count("reduce_scatter", group.axis_name, _nbytes(x))
         out = jax.lax.psum_scatter(x, group.mesh_axis, tiled=True)
         if dst is not None:
             dst._set_data(out)
-            return _Task(dst) if not sync_op else dst
+            return Work(dst) if not sync_op else dst
         return Tensor._wrap(out)
     g = group.nranks
+    rec = _comms.start("reduce_scatter", group.axis_name,
+                       _nbytes(x) // g) if _om._ENABLED else None
     x = _rankmajor(x, group)
     red = _reduce_dim0(x, op)
     # scatter: rank r gets chunk r (local dim0 must divide by G)
@@ -318,8 +393,11 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
     out = jax.device_put(out, x.sharding)
     if dst is not None:
         dst._set_data(out)
-        return _Task(dst) if not sync_op else dst
-    return _finish(None, out, sync_op)
+        if sync_op:
+            _comms.finish(rec, out)
+            return dst
+        return Work(dst, rec)
+    return _finish(None, out, sync_op, rec)
 
 
 def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
@@ -330,26 +408,38 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
         # tensor style: [G, d, ...] rank-major, each local split into G
         x = _unwrap(out_tensor_list)
         if _in_trace(group):
+            if _om._ENABLED:
+                _comms.count("all_to_all", group.axis_name, _nbytes(x))
             out = jax.lax.all_to_all(
                 x.reshape((g, x.shape[0] // g) + x.shape[1:]),
                 group.mesh_axis, split_axis=0, concat_axis=0, tiled=False)
             return Tensor._wrap(out.reshape(x.shape))
+        rec = _comms.start("all_to_all", group.axis_name,
+                           _nbytes(x) // g) if _om._ENABLED else None
         x = _rankmajor(x, group)
         d = x.shape[1]
         blocks = x.reshape((g, g, d // g) + x.shape[2:])
         out = jnp.swapaxes(blocks, 0, 1).reshape(x.shape)
         out = jax.device_put(out, x.sharding)
-        return _finish(None, out, sync_op)
+        return _finish(None, out, sync_op, rec)
     # list style (in_tensor_list = G tensors on "this rank")
     x = jnp.stack([_unwrap(t) for t in in_tensor_list])
     if _in_trace(group):
+        if _om._ENABLED:
+            _comms.count("all_to_all", group.axis_name, _nbytes(x))
         out = jax.lax.all_to_all(x, group.mesh_axis, split_axis=0,
                                  concat_axis=0, tiled=True)
         outs = jnp.split(out, g, axis=0)
+        rec = None
     else:
+        rec = _comms.start("all_to_all", group.axis_name, _nbytes(x)) \
+            if _om._ENABLED else None
         outs = [x[i] for i in range(g)]  # degenerate single-controller view
     out_tensor_list.extend(Tensor._wrap(o) for o in outs)
-    return _Task() if not sync_op else None
+    if sync_op:
+        _comms.finish(rec, outs[-1] if outs else None)
+        return None
+    return Work(outs[-1] if outs else None, rec)
 
 
 alltoall = all_to_all
@@ -359,22 +449,31 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     group = _resolve_group(group)
     g = group.nranks
     if tensor_list is not None:
+        rec = _comms.start(
+            "scatter", group.axis_name,
+            sum(_nbytes(_unwrap(t)) for t in tensor_list) // g) \
+            if _om._ENABLED else None
         out = _rankmajor(jnp.stack([_unwrap(t) for t in tensor_list]),
                          group)
-        return _finish(tensor, out, sync_op)
+        return _finish(tensor, out, sync_op, rec)
     else:
         x = _unwrap(tensor)
+        rec = _comms.start("scatter", group.axis_name,
+                           _nbytes(x) // g) if _om._ENABLED else None
         x = _rankmajor(x, group)
         src_idx = group.get_group_rank(src) if src in group.ranks else src
         # src's local tensor is split into G chunks
         chunks = x[src_idx].reshape((g, x.shape[1] // g) + x.shape[2:])
         out = jax.device_put(chunks, x.sharding)
-    return _finish(tensor, out, sync_op)
+    return _finish(tensor, out, sync_op, rec)
 
 
 def barrier(group=None):
     group = _resolve_group(group)
+    rec = _comms.start("barrier", group.axis_name, 0) \
+        if _om._ENABLED else None
     jax.block_until_ready(jnp.zeros(()))
+    _comms.finish(rec)
     return None
 
 
@@ -409,13 +508,22 @@ def _global_rank(group, rank):
 
 def send(tensor, dst=0, group=None, sync_op=True):
     group = _resolve_group(group)
+    x = _unwrap(tensor)
+    rec = _comms.start("send", group.axis_name, _nbytes(x)) \
+        if _om._ENABLED else None
     _P2P_BUF.setdefault(group.id, _collections.deque()).append(
-        (_global_rank(group, dst), _unwrap(tensor)))
-    return _Task() if not sync_op else None
+        (_global_rank(group, dst), x))
+    if sync_op:
+        _comms.finish(rec, x)
+        return None
+    return Work(None, rec)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     group = _resolve_group(group)
+    rec = _comms.start("recv", group.axis_name,
+                       _nbytes(_unwrap(tensor))) \
+        if _om._ENABLED else None
     buf = _P2P_BUF.get(group.id)
     if not buf:
         raise RuntimeError(
@@ -432,7 +540,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
             if dst == me:
                 del buf[i]
                 tensor._set_data(v)
-                return _Task(tensor) if not sync_op else tensor
+                return _finish(tensor, v, sync_op, rec)
         raise RuntimeError(
             f"recv(src={src}) on group {group.id}: no outstanding send "
             f"addressed to rank {me}; pending destinations: "
@@ -445,7 +553,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
             RuntimeWarning, stacklevel=2)
     _, v = buf.popleft()
     tensor._set_data(v)
-    return _Task(tensor) if not sync_op else tensor
+    return _finish(tensor, v, sync_op, rec)
 
 
 isend = send
@@ -463,6 +571,13 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
+    if _om._ENABLED and p2p_op_list:
+        # the constituent send/recv calls count their own bytes; this
+        # counts the batch dispatch itself
+        _comms.count(
+            "batch_isend_irecv",
+            _resolve_group(p2p_op_list[0].group).axis_name, 0,
+            mode="eager")
     tasks = []
     for op in p2p_op_list:
         tasks.append(op.op(op.tensor, op.peer, group=op.group,
@@ -472,8 +587,12 @@ def batch_isend_irecv(p2p_op_list):
 
 # ---- in-trace helpers used by the parallel layers ------------------------
 def ppermute(x, group: Group, perm):
-    """collective_permute on the per-rank view (in-trace only)."""
+    """collective_permute on the per-rank view (in-trace only —
+    count-only telemetry: the host code here runs once at trace time,
+    so a timing would be fiction)."""
     x = _unwrap(x)
+    if _om._ENABLED:
+        _comms.count("ppermute", group.axis_name, _nbytes(x))
     return Tensor._wrap(jax.lax.ppermute(x, group.mesh_axis, perm))
 
 

@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
+from ...observability import comms as _comms
+from ...observability import metrics as _om
 
 
 def _fsdp_spec(shape, axis: str, mesh) -> P:
@@ -102,6 +104,13 @@ class HybridParallelOptimizer:
             sh = NamedSharding(mesh, spec)
 
             def _shard_grad(g, _sh=sh):
+                if _om._ENABLED:
+                    # the ZeRO stage>=2 grad commit IS the reference's
+                    # bucket reduce-scatter, emitted by GSPMD at grad
+                    # production (async reshard: count-only)
+                    _comms.note_reshard(
+                        "reduce_scatter", self._sharding_axis,
+                        int(g._data.size) * g._data.dtype.itemsize)
                 out = Tensor._wrap(jax.device_put(g._data, _sh))
                 out.stop_gradient = True
                 return out
@@ -166,6 +175,11 @@ class HybridParallelOptimizer:
         for p in self._inner_opt._all_params():
             sh = saved.get(id(p))
             if sh is not None:
+                if _om._ENABLED and self._shard_states:
+                    # the shard-update-allgather cycle's gather leg
+                    _comms.note_reshard(
+                        "all_gather", self._sharding_axis,
+                        int(p._data.size) * p._data.dtype.itemsize)
                 p._data = jax.device_put(p._data, sh)
 
     def clear_grad(self, *a, **kw):

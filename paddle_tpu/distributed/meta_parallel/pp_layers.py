@@ -26,6 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...core.tensor import Tensor
 from ...nn.layer import Layer
 from ...nn.layers.container import LayerList
+from ...observability import comms as _comms
+from ...observability import metrics as _om
 from ...ops.registry import OpDef
 from ...ops import registry as _op_registry
 from ..topology import get_hybrid_communicate_group
@@ -229,6 +231,13 @@ class PipelineLayer(Layer):
         mesh = self._stage_meshes[dst_stage]
         if mesh is None:
             return x
+        if _om._ENABLED:
+            # pipeline stage transfer = the reference's activation
+            # send/recv, rendered as an async device_put between stage
+            # sub-meshes: count + bytes + marker, no made-up timing
+            _comms.note_reshard(
+                "pp_transfer", f"stage{dst_stage}",
+                int(x._data.size) * x._data.dtype.itemsize)
         src_sh = x._data.sharding
         spec = P()
         if isinstance(src_sh, NamedSharding) and all(
@@ -255,6 +264,12 @@ class PipelineLayer(Layer):
         if mesh is None or ct is None:
             return ct
         data = ct._data if isinstance(ct, Tensor) else ct
+        if _om._ENABLED:
+            # the scheduled B unit's grad send (see _transfer)
+            _comms.note_reshard(
+                "pp_transfer",
+                f"stage{self.stage_of_part(dst_part)}",
+                int(data.size) * data.dtype.itemsize)
         spec = P()
         sh = data.sharding
         if isinstance(sh, NamedSharding) and all(

@@ -28,6 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...observability import comms as _comms
+from ...observability import metrics as _om
+
 _NEG_INF = -1e30
 
 
@@ -102,6 +105,15 @@ def ring_attention_impl(q, k, v, mesh: Mesh = None, axis: str = "sep",
     if qa.shape[1] % n:
         raise ValueError(
             f"seq {qa.shape[1]} not divisible by {axis} size {n}")
+    if _om._ENABLED:
+        # count-only (the ring's ppermutes execute inside shard_map —
+        # host timing there would be trace-time fiction): the scan runs
+        # n steps, each rotating this device's K and V blocks once
+        try:
+            kv_bytes = (ka.size + va.size) * ka.dtype.itemsize // n
+        except Exception:
+            kv_bytes = 0
+        _comms.count("ppermute", axis, kv_bytes * n, n=2 * n)
     d = qa.shape[-1]
     sm_scale = softmax_scale if softmax_scale is not None \
         else 1.0 / np.sqrt(d)

@@ -18,6 +18,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
 from ...nn.layer import Layer
+from ...observability import comms as _comms
+from ...observability import metrics as _om
 from ..topology import get_hybrid_communicate_group
 from .mp_layers import (
     ColumnParallelLinear, RowParallelLinear, _dist_reshard, _mesh,
@@ -31,9 +33,22 @@ def _seq_spec(ndim, axis="mp"):
     return P(*spec)
 
 
+def _note(op, axis, t):
+    # GSPMD reshard boundary: count + bytes + zero-duration marker
+    # (the emitted collective is async and may be fused/elided by XLA
+    # — no honest host timing exists; see observability.comms)
+    if _om._ENABLED:
+        try:
+            nbytes = int(t._data.size) * t._data.dtype.itemsize
+        except Exception:
+            nbytes = 0
+        _comms.note_reshard(op, axis, nbytes)
+
+
 def scatter(x, axis="mp"):
     """Shard the sequence dim across the mp group (ScatterOp:85)."""
     t = x if isinstance(x, Tensor) else Tensor(x)
+    _note("scatter", axis, t)
     return _dist_reshard(
         t, dst_sharding=NamedSharding(_mesh(), _seq_spec(t.ndim, axis)))
 
@@ -41,6 +56,7 @@ def scatter(x, axis="mp"):
 def all_gather(x, axis="mp"):
     """Replicate the sequence dim (AllGatherOp:111)."""
     t = x if isinstance(x, Tensor) else Tensor(x)
+    _note("all_gather", axis, t)
     return _dist_reshard(t, dst_sharding=NamedSharding(_mesh(), P()))
 
 
@@ -52,8 +68,12 @@ AllGatherOp = all_gather
 def reduce_scatter(x, axis="mp"):
     """Partial-sum -> sequence-sharded (ReduceScatterOp:127). GSPMD: a
     reshard to the seq-sharded spec after a row-parallel matmul lowers to
-    reduce-scatter."""
-    return scatter(x, axis)
+    reduce-scatter. (Same reshard as scatter(), noted under its own op
+    label so the collective counters stay semantically honest.)"""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    _note("reduce_scatter", axis, t)
+    return _dist_reshard(
+        t, dst_sharding=NamedSharding(_mesh(), _seq_spec(t.ndim, axis)))
 
 
 class ColumnSequenceParallelLinear(ColumnParallelLinear):

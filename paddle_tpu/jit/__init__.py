@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..core.generator import rng_scope, next_key
 from ..nn.layer import Layer
+from ..observability import comms as _cm
 from ..observability import metrics as _om
 from ..observability import perf as _pf
 from ..ops.registry import OpDef
@@ -475,9 +476,14 @@ class TrainStep:
             # steps (compile + queue fill) are skipped.
             now = time.perf_counter()
             if self._last_step_t is not None and step_id >= 2:
-                _pf.observe_roofline("train_step",
-                                     now - self._last_step_t,
+                period = now - self._last_step_t
+                _pf.observe_roofline("train_step", period,
                                      self._step_fn.expected)
+                # goodput decomposition over the same period: comms =
+                # host-timed collective seconds since the last step,
+                # compute = roofline-implied device time (known peaks
+                # only), stall = the remainder
+                _cm.note_train_step(period, self._step_fn.expected)
             self._last_step_t = now
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         from ..utils.watchdog import watchdog

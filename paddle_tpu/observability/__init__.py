@@ -52,7 +52,7 @@ boundaries (the DataLoader does this automatically for its workers,
 shipping trace events alongside)."""
 from __future__ import annotations
 
-from . import fleet, flight, metrics, perf, slo, tracing  # noqa: F401
+from . import comms, fleet, flight, metrics, perf, slo, tracing  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, registry,
     DEFAULT_BUCKETS, MergeSkewError,
@@ -68,7 +68,8 @@ __all__ = [
     "reset", "to_prometheus", "to_json", "span", "current_trace",
     "trace_context", "trace_events", "trace_clear",
     "export_chrome_trace", "export_jsonl", "summary",
-    "metrics", "tracing", "slo", "flight", "perf", "fleet", "SLO",
+    "metrics", "tracing", "slo", "flight", "perf", "fleet", "comms",
+    "SLO",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_BUCKETS", "MergeSkewError",
 ]
@@ -108,10 +109,13 @@ def reset() -> None:
     (pinned by test_reset_clears_metrics_and_trace_ring). Use
     `trace_clear()` for the narrow ring-only clear. The perf-ledger
     window accumulators move with it (each bench config's ledger
-    record covers exactly its own window)."""
+    record covers exactly its own window — the collective window in
+    observability.comms included; its per-process call-seq counters
+    survive, see comms.reset_window)."""
     registry().reset()
     tracing.clear()
     perf.reset_window()
+    comms.reset_window()
 
 
 def to_prometheus() -> str:

@@ -68,6 +68,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from . import flight as _fl
 from . import metrics as _m
 from . import tracing as _t
 
@@ -470,10 +471,21 @@ class FleetAggregator:
     HMAC RPC layer; `ingest()` can also be called directly (tests, an
     in-process fleet)."""
 
-    def __init__(self, stale_after_s: float = 10.0):
+    # straggler-attribution state bound: arrival keys tracked at once
+    ARRIVAL_KEY_CAP = 4096
+
+    def __init__(self, stale_after_s: float = 10.0,
+                 straggler_threshold_s: float = 0.25):
         self.stale_after_s = float(stale_after_s)
+        self.straggler_threshold_s = float(straggler_threshold_s)
         self.registry = _m.MetricsRegistry()
         self._procs: Dict[str, dict] = {}
+        # cross-rank collective arrivals: (op, group, seq) ->
+        # {"procs": {process: ts_us}, "fired": bool}; insertion-ordered
+        # so the cap evicts the oldest keys
+        self._arrivals: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._straggler_cur: Dict[str, str] = {}    # op -> flagged proc
         self._lock = threading.Lock()
         self._server = None
         self.endpoint: Optional[str] = None
@@ -527,6 +539,22 @@ class FleetAggregator:
                 "highest bundle sequence number accepted from the "
                 "process",
                 ("process",)),
+            "skew": h.gauge(
+                "paddle_tpu_collective_skew_seconds",
+                "cross-rank arrival skew of the op's most recently "
+                "matched collective: max - min of the per-process "
+                "comms.arrival timestamps sharing one (op, group, "
+                "call-seq) key (perf_counter is CLOCK_MONOTONIC — "
+                "cross-process comparable on one host)",
+                ("op",)),
+            "straggler": h.gauge(
+                "paddle_tpu_collective_straggler",
+                "one-hot straggler attribution per collective op: 1 "
+                "on the process whose arrival trailed the rest by "
+                "more than the straggler threshold, 0 elsewhere; no "
+                "row is set while skew stays under the threshold (a "
+                "clean fleet names no straggler)",
+                ("op", "process")),
         }
 
     # -- ingest --
@@ -590,8 +618,14 @@ class FleetAggregator:
                         _bump(self._h["quarantined"], len(q),
                               process=proc)
             tr = bundle.get("trace")
+            skew_triggers = []
             if tr:
+                # ingest BEFORE straggler matching: a skew-triggered
+                # flight bundle must already hold this bundle's spans
+                # (the slow comms.<op> span ships alongside the late
+                # arrival that crosses the threshold)
                 _t.ingest(tr)
+                skew_triggers = self._note_arrivals(proc, tr)
             st["last_seen"] = now
             st["last_seq"] = seq
             st["bundles"] += 1
@@ -618,7 +652,73 @@ class FleetAggregator:
                         snap, "paddle_tpu_engine_events_total", proc,
                         event="decode_tokens"),
                 }
+        # flight dumps happen OUTSIDE the lock: a bundle write is disk
+        # I/O at exactly the moment every other rank's agent is
+        # shipping — holding the lock across it would stall the whole
+        # plane into ship-failure rollbacks. The once-per-key `fired`
+        # flag was committed under the lock, so no duplicate dump can
+        # race in between.
+        for detail in skew_triggers:
+            _fl.trigger("collective_skew", detail=detail)
         return {"ok": True, "seq": seq, "rejected_metrics": rejected}
+
+    # -- cross-rank straggler attribution (called under self._lock) --
+    def _note_arrivals(self, proc: str, events) -> list:
+        """Match `comms.arrival` events from this bundle against other
+        processes' arrivals sharing the same (op, group, call-seq) key:
+        publish the per-op skew gauge, flag the straggler one-hot once
+        skew crosses the threshold, and (when the flight recorder is
+        armed with collective_skew_s) return at most one
+        `collective_skew` trigger detail per key for the caller to
+        dump after releasing the lock."""
+        triggers = []
+        for ev in events:
+            if ev.get("name") != "comms.arrival":
+                continue
+            a = ev.get("args") or {}
+            op, group, seq = a.get("op"), a.get("group"), a.get("seq")
+            ts = ev.get("ts")
+            if op is None or group is None or seq is None or ts is None:
+                continue
+            key = (str(op), str(group), int(seq))
+            ent = self._arrivals.get(key)
+            if ent is None:
+                while len(self._arrivals) >= self.ARRIVAL_KEY_CAP:
+                    self._arrivals.popitem(last=False)
+                ent = self._arrivals[key] = {"procs": {}, "fired": False}
+            ent["procs"][proc] = float(ts)
+            if len(ent["procs"]) < 2:
+                continue            # skew needs two ranks, honestly
+            procs = ent["procs"]
+            slow = max(procs, key=procs.get)
+            skew = (procs[slow] - min(procs.values())) / 1e6
+            op = key[0]
+            self._h["skew"].labels(op=op)._value = skew
+            cur = self._straggler_cur.get(op)
+            if skew >= self.straggler_threshold_s:
+                if cur != slow:
+                    if cur is not None:
+                        self._h["straggler"].labels(
+                            op=op, process=cur)._value = 0.0
+                    self._h["straggler"].labels(
+                        op=op, process=slow)._value = 1.0
+                    self._straggler_cur[op] = slow
+            elif cur is not None:
+                # the fleet recovered: clear the stale attribution so
+                # a long-healed straggler doesn't read as current
+                self._h["straggler"].labels(
+                    op=op, process=cur)._value = 0.0
+                del self._straggler_cur[op]
+            if not ent["fired"]:
+                cfg = _fl.config()
+                thr = cfg.collective_skew_s if cfg is not None else None
+                if _fl._ARMED and thr is not None and skew >= thr:
+                    ent["fired"] = True
+                    triggers.append({
+                        "op": op, "group": key[1], "seq": key[2],
+                        "skew_s": round(skew, 6), "straggler": slow,
+                        "arrivals_us": dict(procs)})
+        return triggers
 
     # -- health --
     def processes(self) -> Dict[str, dict]:
@@ -796,7 +896,9 @@ def _git_rev() -> str:
 
 
 def serve_aggregator(bind: str = "127.0.0.1", port: int = 0,
-                     stale_after_s: float = 10.0) -> FleetAggregator:
+                     stale_after_s: float = 10.0,
+                     straggler_threshold_s: float = 0.25
+                     ) -> FleetAggregator:
     """Start an aggregator in THIS process, serving on the HMAC RPC
     call handler (no rendezvous — agents connect straight to
     `.endpoint`, so fleet membership is elastic: processes join by
@@ -808,7 +910,8 @@ def serve_aggregator(bind: str = "127.0.0.1", port: int = 0,
         raise RuntimeError(
             "a fleet aggregator is already serving in this process "
             f"at {_AGGREGATOR.endpoint}; close() it first")
-    agg = FleetAggregator(stale_after_s=stale_after_s)
+    agg = FleetAggregator(stale_after_s=stale_after_s,
+                          straggler_threshold_s=straggler_threshold_s)
     r = _rpc()
     server, endpoint = r.serve(bind=bind, port=port)
     agg._server = server

@@ -15,8 +15,11 @@ trigger site — the recorder does nothing until `arm()`:
 Wired triggers (grep `_fl._ARMED` / `flight.trigger` for ground
 truth): LLMEngine.step latency over threshold, request deadline miss,
 a preemption storm inside one step, any resilience fault point firing
-(capture_faults), and SLO breaches found by `slo.evaluate()`. Anything
-else can call `flight.trigger(reason, detail=...)` directly.
+(capture_faults), SLO breaches found by `slo.evaluate()`, and — in a
+fleet aggregator process — cross-rank collective arrival skew over
+`collective_skew_s` (the straggler attribution plane, see README
+"Collective & mesh observability"). Anything else can call
+`flight.trigger(reason, detail=...)` directly.
 
 A bundle is one directory, written to a hidden tmp name and renamed
 into place (the checkpoint atomicity idiom — a crash mid-dump never
@@ -57,22 +60,25 @@ _LAST_DUMP = -float("inf")      # perf_counter of the last bundle
 _BUNDLES_COUNTER = None
 
 TRIGGER_REASONS = ("step_latency", "deadline_miss", "preempt_storm",
-                   "fault_point", "slo_breach", "manual")
+                   "fault_point", "slo_breach", "collective_skew",
+                   "manual")
 
 
 class FlightConfig:
     __slots__ = ("dir", "retention", "step_latency_threshold_s",
-                 "preempt_storm", "capture_faults", "min_interval_s")
+                 "preempt_storm", "capture_faults", "min_interval_s",
+                 "collective_skew_s")
 
     def __init__(self, dir, retention=8, step_latency_threshold_s=None,
                  preempt_storm=None, capture_faults=False,
-                 min_interval_s=0.0):
+                 min_interval_s=0.0, collective_skew_s=None):
         self.dir = str(dir)
         self.retention = max(1, int(retention))
         self.step_latency_threshold_s = step_latency_threshold_s
         self.preempt_storm = preempt_storm
         self.capture_faults = capture_faults
         self.min_interval_s = float(min_interval_s)
+        self.collective_skew_s = collective_skew_s
 
 
 def _bundles_counter():
@@ -89,11 +95,17 @@ def arm(dir: str, retention: int = 8,
         step_latency_threshold_s: Optional[float] = None,
         preempt_storm: Optional[int] = None,
         capture_faults: bool = False,
-        min_interval_s: float = 0.0) -> FlightConfig:
-    """Arm the recorder (see module docstring for the knobs)."""
+        min_interval_s: float = 0.0,
+        collective_skew_s: Optional[float] = None) -> FlightConfig:
+    """Arm the recorder (see module docstring for the knobs).
+    collective_skew_s: cross-rank arrival skew (seconds) over which
+    the FleetAggregator dumps a `collective_skew` bundle — at most
+    once per (op, group, call-seq) key, so a single straggling
+    collective yields a single bundle."""
     global _ARMED, _CFG, _SEQ
     cfg = FlightConfig(dir, retention, step_latency_threshold_s,
-                       preempt_storm, capture_faults, min_interval_s)
+                       preempt_storm, capture_faults, min_interval_s,
+                       collective_skew_s)
     os.makedirs(cfg.dir, exist_ok=True)
     # resume numbering past bundles a previous incarnation left behind
     # (a postmortem tool restarts by definition — colliding names

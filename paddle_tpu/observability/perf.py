@@ -67,7 +67,9 @@ __all__ = [
     "observe_roofline", "note_dispatch_gap", "note_dispatch_batch",
     "note_graph_cache", "family_records",
     "reset_window", "device_peaks", "set_device_peaks", "lookup",
+    "interconnect_peaks", "set_interconnect_peaks",
     "PEAK_BF16_FLOPS", "HBM_BYTES_PER_SEC", "VALIDATED_BW_WINDOW",
+    "ICI_BYTES_PER_SEC", "DCN_BYTES_PER_SEC",
     "DISPATCH_GAP_BUCKETS",
 ]
 
@@ -94,6 +96,28 @@ HBM_BYTES_PER_SEC = {
 # to utilization numbers so low reads get interpreted honestly
 VALIDATED_BW_WINDOW = {
     "v5e": (233e9, 314e9), "v5litepod": (233e9, 314e9),
+}
+
+# per-chip aggregate ONE-WAY interconnect bandwidth (spec): ICI is the
+# sum over the chip's inter-chip links (v5e: 4 links x 45 GB/s, v4/v5p:
+# 6 links), DCN the chip's share of the host NIC (hosts split ~25 GB/s
+# over their chips). The collective observability layer
+# (observability.comms) reads these the way the roofline gauges read
+# the HBM table: STRICTLY — an unknown device publishes no
+# link-utilization series, and algorithmic bandwidth stands alone as
+# an absolute gauge. Spec caveat mirrors VALIDATED_BW_WINDOW: these
+# are link peaks, not what a congested fabric delivers.
+ICI_BYTES_PER_SEC = {
+    "v5e": 1.8e11, "v5litepod": 1.8e11,   # 4 x 45 GB/s
+    "v5p": 5.4e11,                        # 6 x 90 GB/s
+    "v4": 2.7e11,                         # 6 x 45 GB/s
+    "v3": 1.4e11,
+    "v6e": 3.6e11,                        # 4 x 90 GB/s
+}
+
+DCN_BYTES_PER_SEC = {
+    "v5e": 3.1e9, "v5litepod": 3.1e9, "v6e": 3.1e9, "v3": 3.1e9,
+    "v4": 6.2e9, "v5p": 6.2e9,
 }
 
 
@@ -123,6 +147,41 @@ def set_device_peaks(flops: Optional[float] = None,
         _PEAK_OVERRIDE = None
     else:
         _PEAK_OVERRIDE = (float(flops or 0.0), float(bytes_per_sec or 0.0))
+
+
+# operator/test override for the interconnect denominators:
+# {"ici": x, "dcn": y} or None
+_INTERCONNECT_OVERRIDE: Optional[dict] = None
+
+
+def set_interconnect_peaks(ici: Optional[float] = None,
+                           dcn: Optional[float] = None) -> None:
+    """Pin the interconnect peak denominators explicitly (tests on the
+    CPU box, sessions that measured their fabric). Call with no
+    arguments to clear the override."""
+    global _INTERCONNECT_OVERRIDE
+    if ici is None and dcn is None:
+        _INTERCONNECT_OVERRIDE = None
+    else:
+        _INTERCONNECT_OVERRIDE = {"ici": float(ici or 0.0),
+                                  "dcn": float(dcn or 0.0)}
+
+
+def interconnect_peaks(device=None) -> Optional[dict]:
+    """{"ici": bytes/s, "dcn": bytes/s} for the backend device, or None
+    when the device kind matches no table entry — the collective
+    link-utilization gauges publish NOTHING on unknown devices, the
+    device_peaks() convention."""
+    if _INTERCONNECT_OVERRIDE is not None:
+        return _INTERCONNECT_OVERRIDE
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    ici = lookup(device, ICI_BYTES_PER_SEC)
+    dcn = lookup(device, DCN_BYTES_PER_SEC)
+    if ici is None and dcn is None:
+        return None
+    return {"ici": ici or 0.0, "dcn": dcn or 0.0}
 
 
 def device_peaks(device=None) -> Optional[Tuple[float, float]]:

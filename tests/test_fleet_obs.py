@@ -374,9 +374,12 @@ class TestAgentShipping:
         procs = agg.processes()
         assert procs["poff"]["last_seq"] == 1
         # no series shipped: the fleet registry holds only the
-        # aggregator's own bookkeeping
+        # aggregator's own bookkeeping (fleet health + the cross-rank
+        # collective attribution gauges it publishes itself)
         names = set(agg.registry.snapshot())
-        assert all(n.startswith("paddle_tpu_fleet_") for n in names)
+        assert all(n.startswith("paddle_tpu_fleet_")
+                   or n.startswith("paddle_tpu_collective_")
+                   for n in names)
         agg.close()
 
     def test_ring_rotation_drops_are_counted(self):

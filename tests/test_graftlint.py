@@ -414,6 +414,47 @@ class TestObsDiscipline:
         """, rules={"stats-key-naming"}, readme=_README)
         assert fs == []
 
+    def test_dark_collective_flagged(self):
+        fs = analyze("""
+            def all_reduce(tensor, op=0, group=None, sync_op=True):
+                return tensor
+
+            def barrier(group=None):
+                return None
+        """, rules={"collective-instrumentation"},
+            path="paddle_tpu/distributed/communication.py")
+        assert rule_ids(fs) == ["collective-instrumentation"] * 2
+        assert "all_reduce" in fs[0].message
+        assert "barrier" in fs[1].message
+
+    def test_instrumented_collective_clean(self):
+        fs = analyze("""
+            def all_reduce(tensor, op=0, group=None, sync_op=True):
+                rec = _comms.start("all_reduce", "world", 4)
+                _comms.finish(rec, tensor)
+                return tensor
+
+            def ppermute(x, group, perm):
+                _comms.count("ppermute", "world", 4)
+                return x
+
+            def axis_index(group):      # no payload: exempt
+                return 0
+
+            def _private_helper(sync_op=True):   # private: exempt
+                return None
+        """, rules={"collective-instrumentation"},
+            path="paddle_tpu/distributed/communication.py")
+        assert fs == []
+
+    def test_collective_rule_scoped_to_communication_module(self):
+        fs = analyze("""
+            def all_reduce(tensor, sync_op=True):
+                return tensor
+        """, rules={"collective-instrumentation"},
+            path="paddle_tpu/other/module.py")
+        assert fs == []
+
     def test_stats_rule_scoped_to_engine_stats_modules(self):
         # an unrelated stats dict (HostEmbedding.stats) is NOT audited
         fs = analyze("""

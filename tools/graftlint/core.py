@@ -254,7 +254,8 @@ def write_baseline(path: str, entries: List[dict]) -> None:
                               "down by fixing a site and re-running "
                               "`python -m tools.graftlint --update-"
                               "baseline`; new findings always fail.",
-                   "entries": entries}, f, indent=1, sort_keys=False)
+                   "entries": entries}, f, indent=1, sort_keys=False,
+                  ensure_ascii=False)
         f.write("\n")
 
 

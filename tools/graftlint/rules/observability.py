@@ -223,6 +223,53 @@ class FaultPointNaming(Rule):
 
 
 @register
+class CollectiveInstrumentation(Rule):
+    id = "collective-instrumentation"
+    family = "obs"
+    severity = "error"
+    invariant = ("every public collective in "
+                 "distributed/communication.py records through the "
+                 "observability comms layer (a comms.start/finish/"
+                 "count call in its body) — a future collective "
+                 "cannot ship dark")
+    history = ("PR 14: the communication layer ran dark through 13 "
+               "PRs (zero spans/series across every collective) right "
+               "as the multi-process GSPMD fleet work starts "
+               "depending on collective latency, bandwidth and "
+               "straggler lines")
+
+    # collectives without the sync_op signature marker that must still
+    # record (barrier blocks, ppermute moves payload in-trace);
+    # axis_index is deliberately absent — it reads a rank index, no
+    # payload moves
+    EXTRA_COLLECTIVES = ("barrier", "ppermute", "batch_isend_irecv")
+
+    def check(self, mod):
+        if not mod.path.endswith("distributed/communication.py"):
+            return
+        for node in mod.tree.body:
+            if not isinstance(node, ast.FunctionDef) or \
+                    node.name.startswith("_"):
+                continue
+            params = {a.arg for a in (node.args.args
+                                      + node.args.kwonlyargs)}
+            if "sync_op" not in params and \
+                    node.name not in self.EXTRA_COLLECTIVES:
+                continue
+            records = any(
+                isinstance(n, ast.Call)
+                and (U.dotted(n.func) or "").split(".")[0]
+                in ("comms", "_comms")
+                for n in ast.walk(node))
+            if not records:
+                yield self.finding(
+                    mod, node.lineno,
+                    f"public collective '{node.name}' never records "
+                    "through the observability comms layer "
+                    "(observability.comms start/finish or count)")
+
+
+@register
 class StatsKeyNaming(Rule):
     id = "stats-key-naming"
     family = "obs"

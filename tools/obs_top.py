@@ -114,6 +114,58 @@ def _ms(x):
     return "-" if x is None else f"{x * 1e3:8.2f}ms"
 
 
+def _hist_quantiles_by(doc, name, label, qs=(0.5, 0.95), prev=None):
+    """Per-label-value percentile estimates for a labeled histogram,
+    summing bucket vectors across the remaining label dimensions
+    (e.g. paddle_tpu_collective_seconds{op,group} aggregated per op).
+    Between-frames deltas with `prev`, like _hist_quantiles."""
+    rec = doc.get(name)
+    if not rec or rec.get("kind") != "histogram":
+        return {}
+
+    def collect(d):
+        acc = {}
+        for s in (d.get(name) or {}).get("series", []):
+            key = s["labels"].get(label)
+            if key is None:
+                continue
+            v = s["value"]
+            cur = acc.get(key)
+            if cur is None:
+                acc[key] = {"buckets": list(v["buckets"]),
+                            "lo": v["min"], "hi": v["max"]}
+            else:
+                cur["buckets"] = [a + b for a, b in
+                                  zip(cur["buckets"], v["buckets"])]
+                if v["min"] is not None:
+                    cur["lo"] = v["min"] if cur["lo"] is None \
+                        else min(cur["lo"], v["min"])
+                if v["max"] is not None:
+                    cur["hi"] = v["max"] if cur["hi"] is None \
+                        else max(cur["hi"], v["max"])
+        return acc
+
+    out = {}
+    acc, pacc = collect(doc), collect(prev) if prev else {}
+    for key, v in acc.items():
+        counts, lo, hi = v["buckets"], v["lo"], v["hi"]
+        pv = pacc.get(key)
+        if pv is not None:
+            dl = [c - p for c, p in zip(counts, pv["buckets"])]
+            if sum(dl) > 0:
+                counts, lo, hi = dl, None, None
+        n = sum(counts)
+        if not n:
+            continue
+        out[key] = {
+            "count": n,
+            **{f"p{int(q * 100)}": quantile_from_buckets(
+                rec["buckets"], counts, q, lo=lo, hi=hi)
+               for q in qs},
+        }
+    return out
+
+
 def render_fleet(doc, prev=None, dt=None) -> str:
     """The fleet panel: one line per process from an aggregator export
     (`FleetAggregator.to_json()` / `export_json`) — up/STALE from the
@@ -292,6 +344,48 @@ def render(doc, prev=None, dt=None) -> str:
                 f"  graph cache    hit={gc['hit'] / total:6.1%}  "
                 f"({int(gc['hit'])} hit / {int(gc['miss'])} miss / "
                 f"{int(gc['bypass'])} bypass backwards)")
+
+    # collective telemetry: per-op latency percentiles + bytes rates,
+    # goodput split, and the aggregator's cross-rank skew / straggler
+    # attribution (present only in a fleet aggregator's export)
+    cq = _hist_quantiles_by(doc, "paddle_tpu_collective_seconds", "op",
+                            prev=prev)
+    launches = _series(doc, "paddle_tpu_collective_launches_total")
+    skews = [s for s in
+             _series(doc, "paddle_tpu_collective_skew_seconds")
+             if s["value"]]
+    if cq or any(s["value"] for s in launches) or skews:
+        lines.append("== comms ==")
+        ops = sorted(set(cq) | {s["labels"]["op"] for s in launches
+                                if s["value"]})
+        for op in ops:
+            q = cq.get(op)
+            bps = rate("paddle_tpu_collective_bytes_total", op=op)
+            calls = _counter_sum(
+                doc, "paddle_tpu_collective_launches_total", op=op)
+            row = f"  {op:<16} n={int(calls):>6}"
+            if q:
+                row += (f"  p50={_ms(q['p50'])}  "
+                        f"p95={_ms(q['p95'])}")
+            if bps is not None:
+                row += f"  ({bps / 1e6:8.2f} MB/s)"
+            lines.append(row)
+        good = {s["labels"]["component"]: s["value"] for s in
+                _series(doc, "paddle_tpu_train_goodput_fraction")}
+        if good:
+            lines.append("  goodput      " + "  ".join(
+                f"{k}={good[k]:6.1%}" for k in
+                ("compute", "comms", "stall") if k in good))
+        stragglers = {
+            s["labels"]["op"]: s["labels"]["process"] for s in
+            _series(doc, "paddle_tpu_collective_straggler")
+            if s["value"]}
+        for s in sorted(skews, key=lambda s: s["labels"]["op"]):
+            op = s["labels"]["op"]
+            row = f"  skew         {op}={s['value']:.3f}s"
+            if op in stragglers:
+                row += f"  straggler={stragglers[op]}"
+            lines.append(row)
 
     comp = _series(doc, "paddle_tpu_compile_total")
     if comp:
