@@ -540,6 +540,87 @@ def bench_dispatch(on_tpu):
         }
         ledger_modes.append(rec)
 
+    # numerics-plane overhead A/B (ISSUE 15): a fresh 3-layer MLP in
+    # whole_graph mode (the TestBackwardFamilyBudget config), plane
+    # off vs on, interleaved best-of windows with observability OFF —
+    # the enabled plane's real cost is the in-trace reductions + one
+    # async pull per step, and that is what the timed loop pays. The
+    # grad-norm headline comes from numerics.last() (readable without
+    # metrics). Rides the whole_graph ledger record so
+    # tools/perf_ledger.py --check baselines the overhead ratio.
+    from paddle_tpu.observability import numerics as num
+    nlayers = [pt.nn.Linear(256, 256) for _ in range(3)]
+    nparams = [p for lyr in nlayers for p in lyr.parameters()]
+    nopt = SGD(learning_rate=1e-3, parameters=nparams)
+
+    def num_step():
+        h = pt.ops.tanh(nlayers[0](x))
+        h = pt.ops.tanh(nlayers[1](h))
+        loss = (nlayers[2](h) ** 2).mean()
+        loss.backward()
+        nopt.step()
+        nopt.clear_grad()
+        return loss
+
+    def run_numerics(n):
+        loss = None
+        for _ in range(n):
+            loss = num_step()
+        float(loss.numpy())
+
+    numerics_payload = None
+    steps_n = 160                       # >= 2 sampled steps per window
+    obs.disable()
+    try:
+        with dq.backward_dispatch_mode("whole_graph"):
+            run_numerics(3)             # warm the stats-off variants
+            num.enable(interval=1)
+            run_numerics(3)             # warm the stats-on variants
+            num.disable()
+
+            def ab_windows(n_steps, windows, **enable_kw):
+                best = {"off": float("inf"), "on": float("inf")}
+                for _ in range(windows):
+                    num.disable()
+                    t0 = time.perf_counter()
+                    run_numerics(n_steps)
+                    best["off"] = min(best["off"],
+                                      time.perf_counter() - t0)
+                    num.enable(**enable_kw)
+                    t0 = time.perf_counter()
+                    run_numerics(n_steps)
+                    best["on"] = min(best["on"],
+                                     time.perf_counter() - t0)
+                num.disable()
+                return best
+
+            # headline: the DEFAULT cadence (what numerics.enable()
+            # ships); diagnostic: every-step fidelity (interval=1),
+            # the honest worst case this CPU box pays for full stats
+            best_n = ab_windows(steps_n, 3)
+            best_1 = ab_windows(steps, 3, interval=1)
+            num.enable(interval=1)
+            run_numerics(1)
+            rec_n = num.flush()
+            num.disable()
+        gn = (rec_n or {}).get("grad_norm")
+        numerics_payload = {
+            "overhead_ratio": round(best_n["on"] / best_n["off"], 4),
+            "interval": num.NumericsConfig().interval,
+            "overhead_ratio_interval1": round(
+                best_1["on"] / best_1["off"], 4),
+            "off_steps_per_sec": round(steps_n / best_n["off"], 1),
+            "on_steps_per_sec": round(steps_n / best_n["on"], 1),
+            "grad_norm": round(gn, 6) if gn is not None else None,
+        }
+        for rec in ledger_modes:
+            if rec["mode"] == "whole_graph":
+                rec["numerics"] = numerics_payload
+    finally:
+        num.disable()
+        if obs_was_on:
+            obs.enable()
+
     dt_t, dt_p = best["train"], best["per_node"]
     dt_b, dt_w = best["batched"], best["whole_graph"]
     return {
@@ -564,6 +645,7 @@ def bench_dispatch(on_tpu):
             "windows": windows,
             "windows_run": windows_run,
             "dispatch_gap": gap_by_mode,
+            "numerics": numerics_payload,
         },
     }
 
@@ -1443,6 +1525,8 @@ def _append_perf_ledger(path, name, result, modes=None):
             rec["dispatch_gap"] = m["dispatch_gap"]
             if m.get("graph_cache"):
                 rec["graph_cache"] = m["graph_cache"]
+            if m.get("numerics"):
+                rec["numerics"] = m["numerics"]
             records.append(rec)
     else:
         from paddle_tpu.observability import comms as _comms

@@ -1,13 +1,37 @@
 """AMP: autocast + loss scaling (ref: python/paddle/amp/auto_cast.py:273,
 grad_scaler.py). bf16 is the default low precision on TPU; loss scaling is
 a no-op for bf16 (same exponent range as fp32) but kept for fp16 parity
-and API compatibility."""
+and API compatibility.
+
+GradScaler is wired into the training numerics plane (README "Training
+numerics & model health"): `unscale_` runs as ONE fused jitted
+unscale-and-check executable over all grads (family `amp_unscale`)
+returning a single found_inf scalar — one dispatch and one host sync
+per step instead of the per-parameter `bool(jnp.all(...))` sync storm
+the original loop paid (P blocking round trips per step; the graftlint
+host-sync burn-down removed the site rather than justifying it).
+step/update record `paddle_tpu_amp_loss_scale`,
+`paddle_tpu_amp_steps_total{outcome=ok|skipped}` and
+`paddle_tpu_amp_scale_decreases_total`, and report scale changes to
+`observability.numerics` so loss-scale history rides divergence
+bundles and a scale collapse to the configured floor fires the
+`numerics_divergence` sentinel. The `numerics.check` fault point at
+the top of `step()` (ctx `where="amp"`) lets chaos tests poison a real
+gradient and pin the dynamic-scaling reaction (skip, halve, recover).
+"""
 from __future__ import annotations
 
+import time as _time
+
+import jax
 import jax.numpy as jnp
 
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
+from ..observability import metrics as _om
+from ..observability import numerics as _num
+from ..observability import perf as _pf
+from ..resilience import faults as _faults
 from .state import amp_state, WHITE_LIST, BLACK_LIST
 
 
@@ -65,8 +89,37 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     return models if single_model else model_list
 
 
+_AMP_METRICS = None
+
+
+def _amp_metrics():
+    global _AMP_METRICS
+    if _AMP_METRICS is None:
+        r = _om.registry()
+        _AMP_METRICS = {
+            "scale": r.gauge(
+                "paddle_tpu_amp_loss_scale",
+                "current dynamic loss scale of the GradScaler "
+                "(recorded at every step/update)"),
+            "steps": r.counter(
+                "paddle_tpu_amp_steps_total",
+                "GradScaler.step outcomes: ok = optimizer step "
+                "applied, skipped = nonfinite grads found after "
+                "unscale (the step was dropped and the scale decay "
+                "accounting advanced)",
+                ("outcome",)),
+            "decr": r.counter(
+                "paddle_tpu_amp_scale_decreases_total",
+                "dynamic loss-scale decreases (decr_every_n_nan_or_"
+                "inf consecutive skipped steps reached)"),
+        }
+    return _AMP_METRICS
+
+
 class GradScaler:
     """Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py)."""
+
+    _FAIL = object()
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
@@ -81,6 +134,11 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # fused unscale-and-check executables per grad signature, plus
+        # the dispatch/sync accounting the host-sync test pins
+        self._unscale_cache = {}
+        self._unscale_stats = {"dispatches": 0, "syncs": 0,
+                               "fallbacks": 0}
 
     def is_enable(self):
         return self._enable
@@ -90,43 +148,159 @@ class GradScaler:
             return var
         return var * self._scale
 
+    def _inv32(self):
+        """Cached f32 device scalar for 1/scale — one host->device
+        conversion per scale VALUE, not per step (the optimizer _lr32
+        idiom)."""
+        hit = self.__dict__.get("_inv32_cache")
+        if hit is not None and hit[0] == self._scale:
+            return hit[1]
+        inv = jnp.asarray(1.0 / self._scale, jnp.float32)
+        self.__dict__["_inv32_cache"] = (self._scale, inv)
+        return inv
+
+    def _grad_tensors(self, optimizer):
+        seen = set()
+        out = []
+        for p in optimizer._all_params():
+            if p._grad is None or id(p) in seen:
+                continue
+            seen.add(id(p))
+            out.append((p, p._grad))
+        return out
+
+    def _unscale_fn(self, garrs):
+        """Fused unscale-and-check executable for this grad signature:
+        every grad unscales in f32 (then casts back to its dtype) and
+        ONE reduced found_inf scalar comes back — the same math the
+        old per-parameter loop ran, minus P-1 of its P host syncs.
+        AOT-compiled so the amp_unscale family reports its cost model;
+        a rule that won't trace falls back to the eager loop."""
+        key = tuple((g.shape, g.dtype) for g in garrs)
+        entry = self._unscale_cache.get(key)
+        if entry is self._FAIL:
+            return None
+        if entry is not None:
+            return entry
+
+        def fused(inv, gs):
+            outs = []
+            finite = jnp.bool_(True)
+            for g in gs:
+                gf = g.astype(jnp.float32) * inv
+                finite = jnp.logical_and(finite,
+                                         jnp.all(jnp.isfinite(gf)))
+                outs.append(gf.astype(g.dtype))
+            return outs, jnp.logical_not(finite)
+
+        t0 = _time.perf_counter()
+        try:
+            entry = jax.jit(fused).lower(self._inv32(), garrs).compile()
+        except Exception:
+            self._unscale_cache[key] = self._FAIL
+            return None
+        self._unscale_cache[key] = entry
+        _pf.record_compile("amp_unscale", entry)
+        if _om._ENABLED:
+            c, h = _om.compile_metrics()
+            c.labels(family="amp_unscale").inc()
+            h.labels(family="amp_unscale").observe(
+                _time.perf_counter() - t0)
+        return entry
+
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        pairs = self._grad_tensors(optimizer)
+        if not pairs:
+            self._found_inf = False
+            return
+        garrs = [g._data for _, g in pairs]
+        fn = None
+        if not any(isinstance(g, jax.core.Tracer) for g in garrs):
+            fn = self._unscale_fn(garrs)
+        if fn is None:
+            self._unscale_eager(pairs)
+            return
+        new, found = fn(self._inv32(), garrs)
+        for (_, g), n in zip(pairs, new):
+            g._set_data(n)
+        st = self._unscale_stats
+        st["dispatches"] += 1
+        # the single host sync of the fused path: the step/skip
+        # decision is host control flow, so ONE scalar materializes
+        self._found_inf = bool(found)
+        st["syncs"] += 1
+        # an explicit unscale_ before step() (the grad-clipping
+        # pattern) must not be unscaled AGAIN by step(): the guard
+        # flag step() checks was never actually set by the original
+        # loop (found in the ISSUE 15 review) — a second unscale
+        # divides the update by the loss scale silently
+        self._unscaled = True
+
+    def _unscale_eager(self, pairs):
+        """The pre-ISSUE-15 per-parameter loop, kept as the fallback
+        for non-jittable signatures (and as the oracle the fused
+        rewrite is trajectory-pinned against): P dispatches and P
+        blocking syncs — exactly why the fused path exists."""
         inv = 1.0 / self._scale
         found = False
-        for p in optimizer._all_params():
-            if p._grad is None:
-                continue
-            g = p._grad._data.astype(jnp.float32) * inv
-            if not bool(jnp.all(jnp.isfinite(g))):
+        for _, g in pairs:
+            gf = g._data.astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(gf))):
                 found = True
-            p._grad._set_data(g.astype(p._grad._data.dtype))
+            g._set_data(gf.astype(g._data.dtype))
         self._found_inf = found
+        st = self._unscale_stats
+        st["fallbacks"] += 1
+        st["dispatches"] += len(pairs)
+        st["syncs"] += len(pairs)
+        self._unscaled = True
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
+        # numerics.check chaos hook (ctx where="amp"): fires BEFORE
+        # unscale so an injected PoisonGradient reaches the real
+        # found_inf detection. Guarded on the armed-faults dict.
+        if _faults._ACTIVE:
+            _num.check_fault("amp", self._grad_tensors(optimizer))
         if not getattr(self, "_unscaled", False):
             self.unscale_(optimizer)
-        if not self._found_inf:
+        skipped = self._found_inf
+        if not skipped:
             optimizer.step()
+        else:
+            # the optimizer never ran, so no in-trace stats bundle
+            # carries these grads: count the nonfinite event directly,
+            # and advance the numerics cadence (a training step
+            # happened, the optimizer's own tick never ran)
+            _num.note_found_inf()
+            if _num._ENABLED:
+                _num.tick()
         self._unscaled = False
         self.update()
+        if _om._ENABLED:
+            _amp_metrics()["steps"].labels(
+                outcome="skipped" if skipped else "ok").inc()
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
 
     def update(self):
         if not (self._enable and self._dynamic):
+            if self._enable and _om._ENABLED:
+                _amp_metrics()["scale"].set(self._scale)
             return
+        decreased = False
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
+                decreased = True
         else:
             self._good_steps += 1
             self._bad_steps = 0
@@ -134,19 +308,41 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        if _om._ENABLED:
+            m = _amp_metrics()
+            m["scale"].set(self._scale)
+            if decreased:
+                m["decr"].inc()
+        _num.note_loss_scale(self._scale, decreased=decreased)
 
     def get_loss_scaling(self):
         return Tensor(jnp.asarray(self._scale, jnp.float32))
 
     def state_dict(self):
+        # COMPLETE round trip (ISSUE 15 satellite): the original dict
+        # dropped the ratios on load and omitted found_inf/_dynamic
+        # entirely, so a restore mid-decay resumed with ctor-default
+        # decay dynamics
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
-                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps,
+                "found_inf": self._found_inf,
+                "use_dynamic_loss_scaling": self._dynamic}
 
     def load_state_dict(self, sd):
-        self._scale = sd.get("scale", self._scale)
+        self._scale = float(sd.get("scale", self._scale))
+        self._incr_ratio = sd.get("incr_ratio", self._incr_ratio)
+        self._decr_ratio = sd.get("decr_ratio", self._decr_ratio)
+        self._incr_every = sd.get("incr_every_n_steps", self._incr_every)
+        self._decr_every = sd.get("decr_every_n_nan_or_inf",
+                                  self._decr_every)
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)
+        self._found_inf = bool(sd.get("found_inf", False))
+        self._dynamic = sd.get("use_dynamic_loss_scaling", self._dynamic)
 
     set_state_dict = load_state_dict
 

@@ -272,7 +272,7 @@ def chain_cache_size() -> int:
     return sum(1 for v in _CHAIN_CACHE.values() if not v.disabled)
 
 
-def _build_fused(descs):
+def _build_fused(descs, tap=False):
     """Trace-time composition of one fused segment: each node's
     cotangent contraction is re-derived from its captured primals
     exactly like the per-node ``entry.bwd`` executable does, but
@@ -281,25 +281,35 @@ def _build_fused(descs):
     host.
 
     descs, per node in per-node FIFO dispatch order:
-    ``(entry, out_avals, seed_slots, edge_plan)`` where seed_slots
-    names the output slots receiving host-side seed values (root
-    seeds, contributions from nodes dispatched before this segment,
-    hook-transformed head cotangents) and edge_plan routes each input
-    cotangent: ``("a", node_pos, out_idx)`` accumulates into a later
-    in-segment node's slot — ``g`` if first, else ``acc + g``, in edge
-    order, which IS the per-node FIFO accumulation order, so fan-in
-    sums associate bit-identically — ``("o",)`` emits (leaf edge or
-    out-of-segment boundary), ``("d",)`` drops (stop edge)."""
+    ``(entry, out_avals, seed_slots, edge_plan, leaf_flags)`` where
+    seed_slots names the output slots receiving host-side seed values
+    (root seeds, contributions from nodes dispatched before this
+    segment, hook-transformed head cotangents) and edge_plan routes
+    each input cotangent: ``("a", node_pos, out_idx)`` accumulates
+    into a later in-segment node's slot — ``g`` if first, else
+    ``acc + g``, in edge order, which IS the per-node FIFO
+    accumulation order, so fan-in sums associate bit-identically —
+    ``("o",)`` emits (leaf edge or out-of-segment boundary), ``("d",)``
+    drops (stop edge). leaf_flags marks which edges are LEAF edges.
+
+    ``tap`` (ISSUE 15, whole-graph mode with the numerics plane on):
+    append one f32[2] ``[grad_sq, nonfinite_count]`` in-trace
+    reduction over the emitted LEAF cotangents as a final extra
+    output — a read-only tap, the emitted cotangents themselves are
+    untouched (gradients bit-identical tap on vs off, test-pinned).
+    Boundary emissions are excluded: their contributions reach leaves
+    through later segments and would double-count."""
 
     def fused(seed_vals, packs):
         acc = [[None] * len(d[1]) for d in descs]
         si = 0
-        for pos, (_e, _avals, seed_slots, _plan) in enumerate(descs):
-            for j in seed_slots:
+        for pos, d in enumerate(descs):
+            for j in d[2]:
                 acc[pos][j] = seed_vals[si]
                 si += 1
         outs = []
-        for pos, ((entry, out_avals, _seeds, edge_plan),
+        tap_g2 = tap_nf = None
+        for pos, ((entry, out_avals, _seeds, edge_plan, leaf_flags),
                   (primals, nondiffs)) in enumerate(zip(descs, packs)):
             cots = tuple(
                 a if a is not None else jnp.zeros(av.shape, av.dtype)
@@ -310,19 +320,31 @@ def _build_fused(descs):
 
             _, vf = jax.vjp(_fwd, *primals)
             in_cots = vf(cots)
-            for plan, g in zip(edge_plan, in_cots):
+            for plan, g, is_leaf in zip(edge_plan, in_cots, leaf_flags):
                 kind = plan[0]
                 if kind == "o":
                     outs.append(g)
+                    if tap and is_leaf and jnp.issubdtype(
+                            g.dtype, jnp.inexact):
+                        gf = g.astype(jnp.float32)
+                        g2 = jnp.sum(gf * gf)
+                        nf = jnp.sum(~jnp.isfinite(gf)).astype(
+                            jnp.float32)
+                        tap_g2 = g2 if tap_g2 is None else tap_g2 + g2
+                        tap_nf = nf if tap_nf is None else tap_nf + nf
                 elif kind == "a":
                     cur = acc[plan[1]][plan[2]]
                     acc[plan[1]][plan[2]] = g if cur is None else cur + g
+        if tap:
+            z = jnp.float32(0.0)
+            outs.append(jnp.stack([tap_g2 if tap_g2 is not None else z,
+                                   tap_nf if tap_nf is not None else z]))
         return tuple(outs)
 
     return jax.jit(fused)
 
 
-def _segment_plan(segment, head_slots, cot):
+def _segment_plan(segment, head_slots, cot, tap=False):
     """descs + graph-signature cache key + flat host-seed values for a
     segment (nodes in dispatch order). The key is the whole-graph
     signature: per node (entry uid, output arity, host-seed slot
@@ -330,7 +352,11 @@ def _segment_plan(segment, head_slots, cot):
     accumulation targets) — entry uids are monotonic and never reused
     (ops.registry), so two backwards over the same op signatures and
     topology hit the same executable and a changed exec-cache entry,
-    topology, routing, or seed layout can never alias."""
+    topology, routing, or seed layout can never alias. A numerics-tap
+    segment (ISSUE 15) keys separately (a trailing marker): the tap
+    variant is its own executable, and with the plane off the keys —
+    and every cached steady-state entry — are byte-identical to
+    before."""
     pos = {id(n): i for i, n in enumerate(segment)}
     descs = []
     key_parts = []
@@ -345,7 +371,9 @@ def _segment_plan(segment, head_slots, cot):
                                if s is not None)
             seed_vals.extend(slots[j] for j in seed_slots)
         plan = []
+        leaf = []
         for e in n.edges:
+            leaf.append(e.kind == "leaf")
             if e.kind == "node" and id(e.node) in pos:
                 plan.append(("a", pos[id(e.node)], e.out_idx))
             elif e.kind == "stop":
@@ -353,19 +381,31 @@ def _segment_plan(segment, head_slots, cot):
             else:
                 plan.append(("o",))
         plan = tuple(plan)
-        descs.append((entry, tuple(n.out_avals), seed_slots, plan))
+        descs.append((entry, tuple(n.out_avals), seed_slots, plan,
+                      tuple(leaf)))
         key_parts.append((entry.uid, len(n.out_avals), seed_slots, plan))
-    return descs, tuple(key_parts), seed_vals
+    key = tuple(key_parts)
+    if tap:
+        # the tap variant's key additionally folds in each node's
+        # leaf-vs-boundary edge classification: the base plan encodes
+        # both as ("o",), which is exactly right for routing (the
+        # emitted value is the same) but NOT for the tap — a leaf
+        # emission is reduced into the tap, a boundary emission is
+        # excluded (it reaches leaves through later segments). Two
+        # same-keyed segments differing only in that classification
+        # must not share a tap executable (review finding).
+        key = key + (("numtap",) + tuple(d[4] for d in descs),)
+    return descs, key, seed_vals
 
 
-def _get_fused(descs, key):
+def _get_fused(descs, key, tap=False):
     """(fused executable, cache_hit) for this segment signature —
     possibly disabled, when a previous attempt found the composition
     untraceable."""
     hit = _CHAIN_CACHE.get(key)
     if hit is not None:
         return hit, True
-    fused = _FusedChain(_build_fused(descs),
+    fused = _FusedChain(_build_fused(descs, tap),
                         tuple(d[0] for d in descs))
     if len(_CHAIN_CACHE) >= _CHAIN_CACHE_MAX:
         # simple LRU-ish trim: drop the oldest half (insertion order)
@@ -487,9 +527,17 @@ def run_batched(node_by_id, consumers, cot, node_store, seed,
     mode, maximal single-consumer runs in batched mode."""
     from . import tape
     from ..observability import metrics as _om
+    from ..observability import numerics as _nm
     from ..observability import perf as _pf
 
     whole = _mode == "whole_graph"
+    # numerics in-trace grad tap (ISSUE 15): whole-graph segments on
+    # SAMPLED steps only — batched (chain) mode stays the PR 10 A/B
+    # rung verbatim, and per-node/eager stats come from the
+    # optimizer-side fallback. One flag read per backward when the
+    # plane is off; both tap variants stay cached, so the cadence
+    # alternates between two warm executables, never recompiles.
+    tap = whole and _nm._ENABLED and _nm.want_stats()
     pending = dict(consumers)
     queue = deque(n for nid, n in node_by_id.items()
                   if pending.get(nid, 0) == 0)
@@ -618,8 +666,9 @@ def run_batched(node_by_id, consumers, cot, node_store, seed,
 
         dispatched_fused = False
         if segment is not None:
-            descs, key, seed_vals = _segment_plan(segment, slots, cot)
-            fused, cache_hit = _get_fused(descs, key)
+            descs, key, seed_vals = _segment_plan(segment, slots, cot,
+                                                  tap)
+            fused, cache_hit = _get_fused(descs, key, tap)
             if fused.disabled:
                 if whole:
                     _note_disabled_head(node.fuse_info[0])
@@ -628,6 +677,11 @@ def run_batched(node_by_id, consumers, cot, node_store, seed,
                               for n in segment)
                 try:
                     outs = fused(tuple(seed_vals), packs)
+                    if tap:
+                        # trailing in-trace [grad_sq, nonfinite] tap —
+                        # a device array handed over un-materialized
+                        _nm.note_backward_tap(outs[-1])
+                        outs = outs[:-1]
                     dispatched_fused = True
                 except Exception:
                     # untraceable composition (concrete-path-only
@@ -652,7 +706,8 @@ def run_batched(node_by_id, consumers, cot, node_store, seed,
             for _ in range(absorbed_q):
                 queue.popleft()
             oi = 0
-            for n, (_e, _avals, _seeds, plan) in zip(segment, descs):
+            for n, (_e, _avals, _seeds, plan, _leaf) in zip(segment,
+                                                            descs):
                 for e, p in zip(n.edges, plan):
                     if p[0] != "o":
                         continue        # in-trace accumulation / stop

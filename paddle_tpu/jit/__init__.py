@@ -26,6 +26,7 @@ from ..core.generator import rng_scope, next_key
 from ..nn.layer import Layer
 from ..observability import comms as _cm
 from ..observability import metrics as _om
+from ..observability import numerics as _num
 from ..observability import perf as _pf
 from ..ops.registry import OpDef
 from ..ops import registry as _op_registry
@@ -396,6 +397,16 @@ class TrainStep:
             if shard_data is not None:
                 self._data_sharding = NamedSharding(mesh, shard_data)
         self._donate = donate
+        # numerics plane: trainable-param names + optimizer group
+        # labels for the packed stats bundle (computed once — the
+        # per-step cost of the plane being OFF is one flag read)
+        self._train_pnames = [n for n, t in zip(pnames, trainable) if t]
+        gidx = {}
+        for i, g in enumerate(getattr(optimizer, "_param_groups", [])):
+            for p in g["params"]:
+                gidx[id(p)] = i
+        self._train_groups = [f"g{gidx.get(id(p), 0)}"
+                              for p, t in zip(ptensors, trainable) if t]
         self._step_fn = self._build(donate)
         self._rng = jax.random.PRNGKey(0)
         self._step_count = 0
@@ -430,6 +441,12 @@ class TrainStep:
                 loss = loss._data
             return loss
 
+        # numerics stats variant (ISSUE 15): captured at build time —
+        # __call__ rebuilds when the plane's flag flips, so the family
+        # gains exactly ONE extra executable (the stats-on variant),
+        # pinned by the family-budget tests
+        nstats = self._numerics_on = _num._ENABLED
+
         def step(params, opt_states, buffers, seed, lr, args, kw):
             train_params = [p for p, t in zip(params, trainable) if t]
             frozen_params = [p for p, t in zip(params, trainable) if not t]
@@ -448,6 +465,12 @@ class TrainStep:
                 else:
                     new_params.append(p)
                     new_opt_states.append(s)
+            if nstats:
+                # in-trace reduction bundle over (pre-update params,
+                # grads, post-update params) — read-only taps, the
+                # update math above is untouched
+                return loss, new_params, new_opt_states, _num.pack_stats(
+                    train_params, grads, new_train)
             return loss, new_params, new_opt_states
 
         donate_argnums = (0, 1) if donate else ()
@@ -485,17 +508,36 @@ class TrainStep:
                 # only), stall = the remainder
                 _cm.note_train_step(period, self._step_fn.expected)
             self._last_step_t = now
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        if _num._ENABLED != self._numerics_on:
+            # numerics flag flipped since the last build: swap to the
+            # stats-on (or back to the stats-off) step variant — one
+            # extra compile per direction, then steady-state again
+            self._step_fn = self._build(self._donate)
+        lr_val = self.optimizer.get_lr()
+        lr = jnp.asarray(lr_val, jnp.float32)
         from ..utils.watchdog import watchdog
         with watchdog(what=f"TrainStep step {step_id}") as wd:
-            loss, self.params, self.opt_states = self._step_fn(
+            out = self._step_fn(
                 self.params, self.opt_states, self.buffers, seed, lr,
                 args, kwargs)
+            if self._numerics_on:
+                loss, self.params, self.opt_states, packed = out
+            else:
+                loss, self.params, self.opt_states = out
             if wd is not None:
                 # jit returns futures immediately; a hang detector must
                 # observe DEVICE completion. Armed mode trades async
                 # dispatch for detection (off by default: zero cost).
                 jax.block_until_ready(loss)
+        if self._numerics_on:
+            # stats ride the compiled step every call (they are part
+            # of its trace); the submit/pull follows the plane's
+            # sampling cadence like the eager sites
+            if _num.want_stats():
+                _num.submit(packed, names=self._train_pnames,
+                            groups=self._train_groups, loss=loss,
+                            lr=float(lr_val), source="train_step")
+            _num.tick()
         from ..optimizer.lr import LRScheduler
         if isinstance(self.optimizer._lr, LRScheduler):
             self.optimizer._lr.step()

@@ -29,12 +29,16 @@ numbers from. Built-in instrumentation (recorded only while enabled):
 Sub-surfaces: `observability.slo` (declarative latency objectives
 evaluated from the registry), `observability.flight` (anomaly flight
 recorder — atomic metrics+trace bundles on slow steps, deadline
-misses, preemption storms, fault-point fires, SLO breaches), and
-`observability.fleet` (the cross-process plane: per-process obs
-agents ship sequence-numbered metric deltas + trace events +
-heartbeats over the HMAC RPC layer to an aggregator that merges them
-under a `process` label and publishes fleet health — see README
-"Fleet observability").
+misses, preemption storms, fault-point fires, SLO breaches, training
+numerics divergence), `observability.numerics` (the training-health
+plane: in-trace grad/param stats with one async pull per sampled
+step, the NaN/Inf sentinel with per-parameter attribution, AMP
+loss-scale forensics — see README "Training numerics & model
+health"), and `observability.fleet` (the cross-process plane:
+per-process obs agents ship sequence-numbered metric deltas + trace
+events + heartbeats over the HMAC RPC layer to an aggregator that
+merges them under a `process` label and publishes fleet health — see
+README "Fleet observability").
 
 Quick start::
 
@@ -52,7 +56,7 @@ boundaries (the DataLoader does this automatically for its workers,
 shipping trace events alongside)."""
 from __future__ import annotations
 
-from . import comms, fleet, flight, metrics, perf, slo, tracing  # noqa: F401
+from . import comms, fleet, flight, metrics, numerics, perf, slo, tracing  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, registry,
     DEFAULT_BUCKETS, MergeSkewError,
@@ -69,7 +73,7 @@ __all__ = [
     "trace_context", "trace_events", "trace_clear",
     "export_chrome_trace", "export_jsonl", "summary",
     "metrics", "tracing", "slo", "flight", "perf", "fleet", "comms",
-    "SLO",
+    "numerics", "SLO",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_BUCKETS", "MergeSkewError",
 ]
@@ -111,11 +115,14 @@ def reset() -> None:
     window accumulators move with it (each bench config's ledger
     record covers exactly its own window — the collective window in
     observability.comms included; its per-process call-seq counters
-    survive, see comms.reset_window)."""
+    survive, see comms.reset_window). The numerics plane's pending
+    bundle, sentinel windows and divergence latch move with it too
+    (numerics.reset_window — the enabled flag and config survive)."""
     registry().reset()
     tracing.clear()
     perf.reset_window()
     comms.reset_window()
+    numerics.reset_window()
 
 
 def to_prometheus() -> str:

@@ -15,10 +15,12 @@ trigger site — the recorder does nothing until `arm()`:
 Wired triggers (grep `_fl._ARMED` / `flight.trigger` for ground
 truth): LLMEngine.step latency over threshold, request deadline miss,
 a preemption storm inside one step, any resilience fault point firing
-(capture_faults), SLO breaches found by `slo.evaluate()`, and — in a
-fleet aggregator process — cross-rank collective arrival skew over
-`collective_skew_s` (the straggler attribution plane, see README
-"Collective & mesh observability"). Anything else can call
+(capture_faults), SLO breaches found by `slo.evaluate()`, a training
+numerics divergence (nonfinite grads/params/loss, grad-norm spike,
+loss-scale floor — `observability.numerics`, one bundle per episode),
+and — in a fleet aggregator process — cross-rank collective arrival
+skew over `collective_skew_s` (the straggler attribution plane, see
+README "Collective & mesh observability"). Anything else can call
 `flight.trigger(reason, detail=...)` directly.
 
 A bundle is one directory, written to a hidden tmp name and renamed
@@ -61,7 +63,7 @@ _BUNDLES_COUNTER = None
 
 TRIGGER_REASONS = ("step_latency", "deadline_miss", "preempt_storm",
                    "fault_point", "slo_breach", "collective_skew",
-                   "manual")
+                   "numerics_divergence", "manual")
 
 
 class FlightConfig:

@@ -16,7 +16,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..autograd import no_grad
 from ..observability import metrics as _om
+from ..observability import numerics as _num
 from ..observability import perf as _pf
+from ..resilience import faults as _faults
 from .lr import LRScheduler
 
 _FUSED_COUNTER = None
@@ -199,6 +201,13 @@ class Optimizer:
         wd = getattr(self.weight_decay, "_coeff", self.weight_decay)
         return (_stable_fp(wd),)
 
+    def _numerics_group_labels(self, groups):
+        """Closed per-parameter-group labels for the numerics plane:
+        g<i> by position in self._param_groups (the implicit default
+        group — step()'s literal dict — reads g0)."""
+        gidx = {id(g): i for i, g in enumerate(self._param_groups)}
+        return [f"g{gidx.get(id(grp), 0)}" for grp in groups]
+
     # -- public API --
     @no_grad()
     def step(self):
@@ -211,6 +220,11 @@ class Optimizer:
                     continue
                 seen.add(id(p))
                 params_grads.append((p, p._grad, group))
+        # numerics.check chaos hook (ctx where="step"): guarded on the
+        # armed-faults dict so the clean train loop never builds the
+        # pairs list — one module-attr truthiness test per step
+        if _faults._ACTIVE:
+            _num.check_fault("step", [(p, g) for p, g, _ in params_grads])
         if self._grad_clip is not None:
             pg = [(p, g) for p, g, _ in params_grads]
             clipped = self._grad_clip(pg)
@@ -218,7 +232,17 @@ class Optimizer:
                             zip(params_grads, clipped)]
         self._step_count += 1
         if self._fused_step_apply(params_grads, lr):
+            if _num._ENABLED:
+                _num.tick()
             return
+        # eager per-param path (non-jittable rules, low-precision work
+        # arrays, outer traces): the numerics host-side FALLBACK builds
+        # the same packed bundle with eager jnp dispatches — read-only
+        # taps on the arrays the update already touched, still zero
+        # host syncs here (the pull happens at the next submit/flush)
+        nstats = _num._ENABLED and _num.want_stats() \
+            and bool(params_grads)
+        olds, garrs_s, news = ([], [], []) if nstats else (None, None, None)
         for p, g, group in params_grads:
             state = self._get_state(p)
             garr = g._data
@@ -228,12 +252,26 @@ class Optimizer:
                 garr = garr.astype(parr.dtype)
             new_p, new_state = self._update_rule(parr, garr, state, lr,
                                                  group)
+            if nstats:
+                olds.append(parr)
+                garrs_s.append(garr)
+                news.append(new_p)
             if mw is not None:
                 self._master_weights[id(p)] = new_p
                 p._set_data(new_p.astype(p._data.dtype))
             else:
                 p._set_data(new_p)
             self._accumulators[id(p)] = new_state
+        if nstats and not isinstance(
+                news[0] if news else None, jax.core.Tracer):
+            _num.submit(
+                _num.pack_stats(olds, garrs_s, news),
+                names=[p.name for p, _, _ in params_grads],
+                groups=self._numerics_group_labels(
+                    [grp for _, _, grp in params_grads]),
+                lr=lr, source="optimizer_eager")
+        if _num._ENABLED:
+            _num.tick()
 
     # ------------------------------------------------------------------
     # fused eager step: ALL parameter updates in ONE XLA executable.
@@ -319,8 +357,13 @@ class Optimizer:
         # as discriminating, while the str() form paid a numpy
         # name-building pass per param per step (~100us/step on the
         # bench MLP — the same lesson registry._cache_key learned in
-        # ISSUE 10)
-        key = (self._hyper_fingerprint(),) + tuple(
+        # ISSUE 10). The numerics flag leads the key: the stats-on
+        # variant is a SECOND executable per signature (the only extra
+        # executable the plane is allowed, compiled on the first
+        # SAMPLED step), never a mutation of the stats-off one —
+        # non-sampled steps keep hitting the stats-off executable.
+        nstats = _num._ENABLED and _num.want_stats()
+        key = (nstats, self._hyper_fingerprint()) + tuple(
             (w.shape, w.dtype, g.dtype,
              tuple(sorted((k, v.shape, v.dtype)
                           for k, v in s.items())),
@@ -354,6 +397,14 @@ class Optimizer:
                     new_s.append(ns)
                     casts.append(nw.astype(pdtypes[i])
                                  if flags[i] else None)
+                if nstats:
+                    # the ISSUE 15 in-trace reduction bundle: read-only
+                    # taps over arrays this trace already holds, one
+                    # extra packed output — the update math above is
+                    # untouched (gradients/states bit-identical on vs
+                    # off, test-pinned)
+                    return (new_w, new_s, casts,
+                            _num.pack_stats(work, garrs, new_w))
                 return new_w, new_s, casts
 
             # AOT lower+compile inside the guard: a rule that can't
@@ -385,7 +436,11 @@ class Optimizer:
                 _fused_counter("compile")
                 _fused_compile_time(_time.perf_counter() - t_compile)
         lr32 = self._lr32(lr)
-        new_w, new_s, casts = entry(lr32, work, garrs, states)
+        out = entry(lr32, work, garrs, states)
+        if nstats:
+            new_w, new_s, casts, packed = out
+        else:
+            new_w, new_s, casts = out
         for (p, _, has_mw), nw, ns, cast in zip(infos, new_w, new_s,
                                                 casts):
             if has_mw:
@@ -394,6 +449,12 @@ class Optimizer:
             else:
                 p._set_data(nw)
             self._accumulators[id(p)] = ns
+        if nstats:
+            _num.submit(
+                packed, names=[p.name for p, _, _ in infos],
+                groups=self._numerics_group_labels(
+                    [grp for _, grp, _ in infos]),
+                lr=lr, source="optimizer_fused")
         return True
 
     def clear_grad(self, set_to_zero=False):

@@ -1,0 +1,847 @@
+"""Training numerics & model-health plane (ISSUE 15).
+
+Pins: in-trace stats correctness (fused optimizer / whole-graph
+backward tap / TrainStep / eager fallback against numpy references),
+bit-identical gradients+optimizer states with the plane on vs off
+across all three backward dispatch modes, the ≤1-async-pull-per-step
+budget, the stats-on executable-variant family budget, disabled-mode
+zero-allocation, the NaN/Inf sentinel chaos acceptance
+(PoisonGradient → exactly one numerics_divergence bundle naming the
+first nonfinite parameter), AMP dynamic-loss-scaling under injected
+overflow, the fused unscale's one-dispatch/one-sync contract and
+trajectory parity, the GradScaler state-dict round trip, the
+flight-reason-documented graftlint rule, and the obs_top panel.
+"""
+import math
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.autograd import dispatch_queue as dq
+from paddle_tpu.observability import flight
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability import numerics as num
+from paddle_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    yield
+    num.disable()
+    num.reset_window()
+    faults.clear_all()
+    flight.disarm()
+    obs.disable()
+    obs.reset()
+    dq.set_dispatch_mode("whole_graph")
+
+
+def _mlp(rng, n=3, width=8):
+    layers = [pt.nn.Linear(width, width) for _ in range(n)]
+    for lyr in layers:
+        for p in lyr.parameters():
+            p.set_value(pt.to_tensor(
+                rng.standard_normal(p.shape).astype(np.float32)))
+    return layers
+
+
+def _step_fn(layers, x, opt):
+    def step():
+        h = x
+        for lyr in layers[:-1]:
+            h = pt.ops.tanh(lyr(h))
+        loss = (layers[-1](h) ** 2).mean()
+        loss.backward()
+        grads = [np.asarray(p._grad._data) for lyr in layers
+                 for p in lyr.parameters()]
+        opt.step()
+        opt.clear_grad()
+        return grads
+    return step
+
+
+# ---------------------------------------------------------------------------
+# in-trace stats correctness
+# ---------------------------------------------------------------------------
+class TestInTraceStats:
+    def test_fused_optimizer_stats_match_numpy(self):
+        rng = np.random.default_rng(0)
+        layers = _mlp(rng)
+        params = [p for lyr in layers for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        step = _step_fn(layers, x, opt)
+        obs.enable()
+        num.enable(interval=1)
+        olds = [np.asarray(p._data, np.float64) for p in params]
+        grads = step()
+        news = [np.asarray(p._data, np.float64) for p in params]
+        rec = num.flush()
+        assert rec["source"] == "optimizer_fused"
+        gn_ref = math.sqrt(sum(float(np.sum(np.asarray(g, np.float64)
+                                            ** 2)) for g in grads))
+        assert rec["grad_norm"] == pytest.approx(gn_ref, rel=1e-4)
+        pn_ref = math.sqrt(sum(float(np.sum(w ** 2)) for w in olds))
+        assert rec["param_norm"] == pytest.approx(pn_ref, rel=1e-4)
+        d_ref = math.sqrt(sum(float(np.sum((n - w) ** 2))
+                              for n, w in zip(news, olds)))
+        assert rec["update_ratio"] == pytest.approx(d_ref / pn_ref,
+                                                    rel=1e-3)
+        assert rec["nonfinite"] == {"grad": 0, "param": 0, "loss": 0}
+        # gauges published (group=all + the single default group g0)
+        snap = obs.snapshot()
+        rows = snap["paddle_tpu_train_grad_norm"]["series"]
+        assert rows[("all",)] == pytest.approx(gn_ref, rel=1e-4)
+        assert rows[("g0",)] == pytest.approx(gn_ref, rel=1e-4)
+        assert snap["paddle_tpu_train_param_norm"]["series"][()] == \
+            pytest.approx(pn_ref, rel=1e-4)
+
+    def test_whole_graph_backward_tap(self):
+        """Backward-only loop (no optimizer submit): the in-trace
+        whole-graph tap alone provides grad norm + nonfinite count,
+        published by flush() as a backward-sourced record."""
+        rng = np.random.default_rng(1)
+        layers = _mlp(rng)
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        num.enable(interval=1)
+        with dq.backward_dispatch_mode("whole_graph"):
+            h = pt.ops.tanh(layers[0](x))
+            h = pt.ops.tanh(layers[1](h))
+            loss = (layers[2](h) ** 2).mean()
+            loss.backward()
+        grads = [np.asarray(p._grad._data, np.float64)
+                 for lyr in layers for p in lyr.parameters()]
+        rec = num.flush()
+        assert rec["source"] == "backward"
+        gn_ref = math.sqrt(sum(float(np.sum(g ** 2)) for g in grads))
+        assert rec["backward"]["grad_norm"] == pytest.approx(
+            gn_ref, rel=1e-4)
+        assert rec["backward"]["nonfinite"] == 0
+        assert rec["grad_norm"] == pytest.approx(gn_ref, rel=1e-4)
+
+    def test_eager_fallback_same_series(self, monkeypatch):
+        """PADDLE_TPU_FUSED_OPT=0 forces the per-param eager optimizer
+        path: the host-side fallback publishes the same record shape
+        with the same numbers."""
+        monkeypatch.setenv("PADDLE_TPU_FUSED_OPT", "0")
+        rng = np.random.default_rng(2)
+        layers = _mlp(rng)
+        params = [p for lyr in layers for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        step = _step_fn(layers, x, opt)
+        obs.enable()
+        num.enable(interval=1)
+        grads = step()
+        rec = num.flush()
+        assert rec["source"] == "optimizer_eager"
+        gn_ref = math.sqrt(sum(float(np.sum(np.asarray(g, np.float64)
+                                            ** 2)) for g in grads))
+        assert rec["grad_norm"] == pytest.approx(gn_ref, rel=1e-4)
+
+    def test_trainstep_stats_and_loss(self):
+        from paddle_tpu.jit import TrainStep
+        rng = np.random.default_rng(3)
+        layers = _mlp(rng)
+
+        class M(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.ls = pt.nn.LayerList(layers)
+
+            def forward(self, x):
+                h = x
+                for lyr in self.ls[:-1]:
+                    h = pt.ops.tanh(lyr(h))
+                return (self.ls[-1](h) ** 2).mean()
+
+        m = M()
+        opt = pt.optimizer.SGD(learning_rate=1e-2,
+                               parameters=m.parameters())
+        obs.enable()
+        num.enable(interval=1)
+        ts = TrainStep(m, opt)
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        losses = [float(ts(x).numpy()) for _ in range(3)]
+        rec = num.flush()
+        assert rec["source"] == "train_step"
+        assert rec["loss"] == pytest.approx(losses[-1], rel=1e-5)
+        assert rec["grad_norm"] and math.isfinite(rec["grad_norm"])
+        assert rec["update_ratio"] and rec["update_ratio"] > 0
+
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_per_group_rows(self, fused, monkeypatch):
+        """Both the fused path and the eager fallback label the
+        per-group rows identically (the fallback once extracted the
+        GRAD from the (p, g, group) tuples and collapsed everything
+        to g0 — ISSUE 15 review finding)."""
+        if not fused:
+            monkeypatch.setenv("PADDLE_TPU_FUSED_OPT", "0")
+        rng = np.random.default_rng(4)
+        l1, l2 = pt.nn.Linear(8, 8), pt.nn.Linear(8, 8)
+        opt = pt.optimizer.SGD(
+            learning_rate=1e-2,
+            parameters=[{"params": l1.parameters()},
+                        {"params": l2.parameters(),
+                         "learning_rate": 0.5}])
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        obs.enable()
+        num.enable(interval=1)
+        loss = (l2(pt.ops.tanh(l1(x))) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        rec = num.flush()
+        assert rec["source"] == ("optimizer_fused" if fused
+                                 else "optimizer_eager")
+        assert set(rec["group_norms"]) == {"g0", "g1"}
+        rows = obs.snapshot()["paddle_tpu_train_grad_norm"]["series"]
+        assert ("g0",) in rows and ("g1",) in rows and ("all",) in rows
+
+    def test_sampling_cadence(self):
+        """interval=k publishes every k-th step only (the default-
+        cadence overhead contract), and the stats-off steps keep
+        hitting the stats-off executables."""
+        rng = np.random.default_rng(5)
+        layers = _mlp(rng)
+        params = [p for lyr in layers for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        step = _step_fn(layers, x, opt)
+        num.enable(interval=4)
+        base = num.pulls()
+        for _ in range(9):              # samples at ticks 0, 4, 8
+            step()
+        num.flush()
+        assert num.pulls() - base == 3
+
+
+# ---------------------------------------------------------------------------
+# read-only taps: bit-identical training with the plane on vs off
+# ---------------------------------------------------------------------------
+class TestBitIdentical:
+    @pytest.mark.parametrize("mode", ["whole_graph", "batched",
+                                      "per_node"])
+    def test_grads_and_states_bit_identical(self, mode):
+        rng = np.random.default_rng(7)
+        W = [rng.standard_normal((8, 8)).astype(np.float32)
+             for _ in range(3)]
+        x_np = rng.standard_normal((4, 8)).astype(np.float32)
+
+        def run(plane_on):
+            dq.clear_chain_cache()
+            layers = [pt.nn.Linear(8, 8) for _ in range(3)]
+            for lyr, w in zip(layers, W):
+                lyr.weight.set_value(pt.to_tensor(w))
+            params = [p for lyr in layers for p in lyr.parameters()]
+            opt = pt.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=params)
+            x = pt.to_tensor(x_np)
+            if plane_on:
+                num.enable(interval=1)
+            else:
+                num.disable()
+            with dq.backward_dispatch_mode(mode):
+                for _ in range(4):
+                    h = pt.ops.tanh(layers[0](x))
+                    h = pt.ops.tanh(layers[1](h))
+                    loss = (layers[2](h) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+            num.disable()
+            ps = [np.asarray(p._data).tobytes() for p in params]
+            sts = [{k: np.asarray(v).tobytes() for k, v in
+                    opt._accumulators[id(p)].items()} for p in params]
+            return ps, sts
+
+        assert run(False) == run(True)
+
+
+# ---------------------------------------------------------------------------
+# the async-pull budget and the executable family budget
+# ---------------------------------------------------------------------------
+class TestBudgets:
+    def test_at_most_one_pull_per_step(self):
+        rng = np.random.default_rng(8)
+        layers = _mlp(rng)
+        params = [p for lyr in layers for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        step = _step_fn(layers, x, opt)
+        num.enable(interval=1)
+        base = num.pulls()
+        n = 6
+        for _ in range(n):
+            step()
+        # each submit publishes the PREVIOUS step's bundle: n-1 pulls
+        assert num.pulls() - base == n - 1
+        num.flush()
+        assert num.pulls() - base == n
+
+    def test_stats_on_variant_family_budget(self):
+        """Toggling the plane on adds AT MOST one extra executable per
+        family (the stats-on variant) and the steady state compiles
+        nothing further — the TestCompileFamilyBudget convention."""
+        rng = np.random.default_rng(9)
+        layers = _mlp(rng, width=16)
+        params = [p for lyr in layers for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        x = pt.to_tensor(rng.standard_normal((4, 16)).astype(np.float32))
+        step = _step_fn(layers, x, opt)
+        dq.clear_chain_cache()
+        obs.enable()
+        obs.reset()
+        with dq.backward_dispatch_mode("whole_graph"):
+            for _ in range(2):
+                step()              # stats-off variants compile
+            num.enable(interval=1)
+            for _ in range(2):
+                step()              # stats-on variants compile
+            snap1 = obs.snapshot()["paddle_tpu_compile_total"]["series"]
+            for _ in range(3):
+                step()              # steady state: no new compiles
+            snap2 = obs.snapshot()["paddle_tpu_compile_total"]["series"]
+        per_family = {k[0]: int(v) for k, v in snap2.items() if v}
+        assert per_family.get("backward_fused", 0) <= 2
+        assert per_family.get("optimizer_fused", 0) <= 2
+        assert snap1 == snap2, "steady state recompiled"
+
+    def test_disabled_mode_zero_alloc_and_zero_pulls(self):
+        """The instrumentation entry points with the plane off are one
+        flag check: no allocation growth, no pulls, no pending state
+        (the PR 2/8/14 tracemalloc convention, applied to the layer
+        directly so a per-op leak can't hide in loop noise)."""
+        import tracemalloc
+        assert not obs.enabled() and not num.enabled()
+        for _ in range(16):
+            num.note_backward_tap(None)
+            num.submit(None, (), ())
+            num.note_loss_scale(1.0)
+            num.note_found_inf()
+            num.want_stats()
+
+        def window(iters):
+            tracemalloc.start()
+            base = tracemalloc.get_traced_memory()[0]
+            for _ in range(iters):
+                num.note_backward_tap(None)
+                num.submit(None, (), ())
+                num.note_loss_scale(1.0)
+                num.note_found_inf()
+                num.want_stats()
+            grown = tracemalloc.get_traced_memory()[0] - base
+            tracemalloc.stop()
+            return grown
+
+        window(4000)
+        g1, g2 = window(4000), window(4000)
+        assert g2 < 1024, (g1, g2)
+        assert num.pulls() == 0 or num._PENDING is None
+        assert num._PENDING is None and not num._STEP_TAPS
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: sentinel + forensics
+# ---------------------------------------------------------------------------
+class TestChaosDivergence:
+    def _setup(self, seed=10):
+        rng = np.random.default_rng(seed)
+        layers = _mlp(rng)
+        params = [p for lyr in layers for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        return layers, params, opt, _step_fn(layers, x, opt)
+
+    def test_poisoned_gradient_exactly_one_bundle(self, tmp_path):
+        layers, params, opt, step = self._setup()
+        obs.enable()
+        num.enable(interval=1)
+        flight.arm(str(tmp_path))
+        for _ in range(3):
+            step()
+        target = params[2].name
+        with faults.inject("numerics.check",
+                           exc=num.PoisonGradient(param=target),
+                           times=1, match={"where": "step"}):
+            step()
+        # the poisoned update NaNs the params: every later step stays
+        # nonfinite — one EPISODE, so still exactly one bundle
+        for _ in range(3):
+            step()
+        num.flush()
+        bundles = flight.bundles(str(tmp_path))
+        assert len(bundles) == 1
+        b = flight.load_bundle(bundles[0])
+        assert b["meta"]["reason"] == "numerics_divergence"
+        det = b["meta"]["detail"]
+        assert det["first_nonfinite_param"] == target
+        assert "nonfinite" in det["reasons"]
+        assert det["loss_history"] == []        # no loss noted (eager)
+        # the bundle's metrics snapshot shows the counter increment
+        rows = b["metrics"]["paddle_tpu_train_nonfinite_total"]["series"]
+        assert any(s["labels"]["where"] == "grad" and s["value"] > 0
+                   for s in rows)
+        # and its trace holds the triggering numerics.check span,
+        # whose ids the meta names
+        spans = [e for e in b["trace"] if e["name"] == "numerics.check"]
+        assert spans
+        assert det["trace_id"] in {e.get("trace_id") for e in spans}
+
+    def test_clean_run_zero_bundles_zero_counts(self, tmp_path):
+        _, _, _, step = self._setup(seed=11)
+        obs.enable()
+        num.enable(interval=1)
+        flight.arm(str(tmp_path))
+        for _ in range(4):
+            step()
+        num.flush()
+        assert flight.bundles(str(tmp_path)) == []
+        rows = obs.snapshot().get("paddle_tpu_train_nonfinite_total",
+                                  {}).get("series", {})
+        assert not any(v for v in rows.values()), rows
+
+    def test_latch_rearms_after_clean_step(self, tmp_path):
+        """Two separate poison episodes with clean steps between =
+        two bundles; consecutive poisoned steps inside one episode
+        do not double-fire. Poison value 0 keeps params finite so the
+        episode actually ENDS (NaN would be absorbing)."""
+        layers, params, opt, step = self._setup(seed=12)
+        obs.enable()
+        num.enable(interval=1)
+        flight.arm(str(tmp_path))
+        step()
+        for _ in range(2):      # episode 1: two consecutive poisons
+            with faults.inject("numerics.check",
+                               exc=num.PoisonGradient(
+                                   value=float("inf")),
+                               times=1, match={"where": "step"}):
+                step()
+        # params went nonfinite? inf*lr subtracted — rebuild weights
+        rng = np.random.default_rng(13)
+        for p in params:
+            p.set_value(pt.to_tensor(
+                rng.standard_normal(p.shape).astype(np.float32)))
+        for _ in range(3):      # clean steps re-arm the latch
+            step()
+        with faults.inject("numerics.check",
+                           exc=num.PoisonGradient(value=float("inf")),
+                           times=1, match={"where": "step"}):
+            step()              # episode 2
+        for p in params:
+            p.set_value(pt.to_tensor(
+                rng.standard_normal(p.shape).astype(np.float32)))
+        step()
+        num.flush()
+        assert len(flight.bundles(str(tmp_path))) == 2
+
+    def test_grad_spike_detection(self, tmp_path):
+        num.enable(interval=1, spike_factor=5.0, min_window=4)
+        obs.enable()
+        flight.arm(str(tmp_path))
+        names = ("w",)
+        groups = ("g0",)
+        import jax.numpy as jnp
+
+        def fake_step(scale):
+            g = jnp.full((16,), scale, jnp.float32)
+            w = jnp.ones((16,), jnp.float32)
+            num.submit(num.pack_stats([w], [g], [w - 0.01 * g]),
+                       names=names, groups=groups, lr=0.01)
+        for _ in range(6):
+            fake_step(1.0)
+        fake_step(100.0)        # 100x the window median
+        num.flush()
+        bundles = flight.bundles(str(tmp_path))
+        assert len(bundles) == 1
+        det = flight.load_bundle(bundles[0])["meta"]["detail"]
+        assert det["reasons"] == ["grad_spike"]
+
+    def test_sustained_regime_change_releases_latch(self, tmp_path):
+        """A legitimate persistent grad-norm jump fires grad_spike
+        ONCE, then the window median adapts, the latch re-arms, and a
+        later REAL nonfinite event still gets its bundle — spiked
+        norms excluded from the window would hold the latch forever
+        and swallow the NaN bundle (review finding)."""
+        num.enable(interval=1, spike_factor=5.0, min_window=4,
+                   window=8)
+        obs.enable()
+        flight.arm(str(tmp_path))
+        import jax.numpy as jnp
+
+        def fake_step(scale):
+            g = jnp.full((16,), scale, jnp.float32)
+            w = jnp.ones((16,), jnp.float32)
+            num.submit(num.pack_stats([w], [g], [w - 0.01 * g]),
+                       names=("w",), groups=("g0",), lr=0.01)
+        for _ in range(6):
+            fake_step(1.0)
+        for _ in range(12):     # new PERMANENT regime, 20x the median
+            fake_step(20.0)
+        num.flush()
+        assert len(flight.bundles(str(tmp_path))) == 1  # one episode
+        fake_step(float("nan"))     # the real event must still fire
+        num.flush()
+        bundles = flight.bundles(str(tmp_path))
+        assert len(bundles) == 2
+        det = flight.load_bundle(bundles[-1])["meta"]["detail"]
+        assert "nonfinite" in det["reasons"]
+
+    def test_tap_variant_key_folds_leaf_classification(self):
+        """The whole-graph tap executable keys include each node's
+        leaf-vs-boundary edge flags (base keys encode both as emitted
+        ('o',) — right for routing, wrong for the tap, which reduces
+        only LEAF emissions; review finding)."""
+        rng = np.random.default_rng(30)
+        layers = _mlp(rng)
+        x = pt.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        num.enable(interval=1)
+        dq.clear_chain_cache()
+        with dq.backward_dispatch_mode("whole_graph"):
+            h = pt.ops.tanh(layers[0](x))
+            loss = (layers[1](h) ** 2).mean()
+            loss.backward()
+        tap_keys = [k for k in dq._CHAIN_CACHE
+                    if k and isinstance(k[-1], tuple)
+                    and k[-1][:1] == ("numtap",)]
+        assert tap_keys
+        for k in tap_keys:
+            # marker + one leaf-flag tuple per segment node
+            assert len(k[-1]) == 1 + len(k) - 1
+            assert all(isinstance(f, tuple) for f in k[-1][1:])
+
+    def test_loss_scale_floor_fires(self, tmp_path):
+        num.enable(interval=1, loss_scale_floor=4.0)
+        flight.arm(str(tmp_path))
+        num.note_loss_scale(32.0, decreased=True)
+        assert flight.bundles(str(tmp_path)) == []
+        num.note_loss_scale(4.0, decreased=True)
+        bundles = flight.bundles(str(tmp_path))
+        assert len(bundles) == 1
+        det = flight.load_bundle(bundles[0])["meta"]["detail"]
+        assert det["reasons"] == ["loss_scale_floor"]
+        assert det["loss_scale_history"][-2:] == [32.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# AMP: fused unscale + dynamic-loss-scaling forensics
+# ---------------------------------------------------------------------------
+class TestAMP:
+    def _scaler_loop(self, scaler, n=1, width=6, seed=20):
+        rng = np.random.default_rng(seed)
+        lin = [pt.nn.Linear(width, width) for _ in range(2)]
+        params = [p for lyr in lin for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        x = pt.to_tensor(rng.standard_normal((4, width))
+                         .astype(np.float32))
+
+        def step():
+            h = pt.ops.tanh(lin[0](x))
+            loss = (lin[1](h) ** 2).mean()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            opt.clear_grad()
+        for _ in range(n):
+            step()
+        return params, opt, step
+
+    def test_fused_unscale_one_dispatch_one_sync(self):
+        scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+        self._scaler_loop(scaler, n=5)
+        st = scaler._unscale_stats
+        assert st["dispatches"] == 5        # ONE fused call per step
+        assert st["syncs"] == 5             # ONE host sync per step
+        assert st["fallbacks"] == 0
+        assert len(scaler._unscale_cache) == 1
+
+    def test_fused_unscale_trajectory_matches_eager_loop(self):
+        """The fused rewrite is bit-identical to the original
+        per-parameter loop — same unscaled grads, same found_inf —
+        including across an injected overflow."""
+        rng = np.random.default_rng(21)
+        W = [rng.standard_normal((6, 6)).astype(np.float32)
+             for _ in range(2)]
+        x_np = rng.standard_normal((4, 6)).astype(np.float32)
+
+        def run(force_eager):
+            lin = [pt.nn.Linear(6, 6) for _ in range(2)]
+            for lyr, w in zip(lin, W):
+                lyr.weight.set_value(pt.to_tensor(w))
+            params = [p for lyr in lin for p in lyr.parameters()]
+            opt = pt.optimizer.SGD(learning_rate=1e-2,
+                                   parameters=params)
+            scaler = GradScaler(init_loss_scaling=2.0 ** 8,
+                                decr_every_n_nan_or_inf=1)
+            if force_eager:
+                scaler._unscale_fn = lambda garrs: None
+            x = pt.to_tensor(x_np)
+            for i in range(4):
+                h = pt.ops.tanh(lin[0](x))
+                loss = (lin[1](h) ** 2).mean()
+                scaler.scale(loss).backward()
+                if i == 1:      # poison one step's grads directly
+                    g = params[0]._grad
+                    g._set_data(g._data.at[0, 0].set(float("nan")))
+                scaler.step(opt)
+                opt.clear_grad()
+            return ([np.asarray(p._data).tobytes() for p in params],
+                    scaler._scale, scaler._good_steps,
+                    scaler._bad_steps)
+
+        assert run(False) == run(True)
+
+    def test_dynamic_scaling_under_injected_overflow(self):
+        obs.enable()
+        scaler = GradScaler(init_loss_scaling=2.0 ** 10,
+                            decr_every_n_nan_or_inf=2,
+                            incr_every_n_steps=3)
+        params, opt, step = self._scaler_loop(scaler, n=0)
+        for _ in range(2):
+            step()
+        assert scaler._scale == 2.0 ** 10
+        # nonfinite grads for decr_every_n_nan_or_inf consecutive
+        # steps: both skipped, then the scale halves exactly once
+        with faults.inject("numerics.check", exc=num.PoisonGradient(),
+                           times=2, match={"where": "amp"}):
+            step()
+            step()
+        assert scaler._scale == 2.0 ** 9
+        snap = obs.snapshot()
+        assert snap["paddle_tpu_amp_steps_total"]["series"][
+            ("skipped",)] == 2
+        assert snap["paddle_tpu_amp_steps_total"]["series"][("ok",)] == 2
+        assert snap["paddle_tpu_amp_scale_decreases_total"][
+            "series"][()] == 1
+        assert snap["paddle_tpu_amp_loss_scale"]["series"][()] == \
+            2.0 ** 9
+        # recovery: incr_every_n_steps clean steps grow the scale back
+        for _ in range(3):
+            step()
+        assert scaler._scale == 2.0 ** 10
+
+    def test_skipped_step_counts_nonfinite_once(self):
+        obs.enable()
+        num.enable(interval=1)
+        scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+        params, opt, step = self._scaler_loop(scaler, n=1)
+        with faults.inject("numerics.check", exc=num.PoisonGradient(),
+                           times=1, match={"where": "amp"}):
+            step()
+        rows = obs.snapshot()["paddle_tpu_train_nonfinite_total"][
+            "series"]
+        assert rows[("grad",)] == 1
+
+    def test_explicit_unscale_not_applied_twice(self):
+        """scaler.unscale_(opt) then scaler.step(opt) — the grad-
+        clipping pattern — unscales exactly once: the original loop
+        checked an `_unscaled` guard nothing ever set, so the step
+        silently divided the update by the loss scale again (ISSUE 15
+        review finding)."""
+        rng = np.random.default_rng(22)
+        lin = pt.nn.Linear(6, 6)
+        params = lin.parameters()
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        scaler = GradScaler(init_loss_scaling=2.0 ** 8)
+        x = pt.to_tensor(rng.standard_normal((4, 6)).astype(np.float32))
+        loss = (lin(x) ** 2).mean()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        g_once = np.asarray(params[0]._grad._data).copy()
+        w_before = np.asarray(params[0]._data).copy()
+        scaler.step(opt)        # must NOT unscale a second time
+        assert scaler._unscale_stats["dispatches"] == 1
+        np.testing.assert_allclose(
+            np.asarray(params[0]._data), w_before - 1e-2 * g_once,
+            rtol=1e-6)
+        # and the next step's internal unscale runs again (flag reset)
+        opt.clear_grad()
+        loss = (lin(x) ** 2).mean()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        assert scaler._unscale_stats["dispatches"] == 2
+
+    def test_skipped_step_taps_do_not_leak(self, tmp_path):
+        """A whole-graph backward tap recorded for a step AMP then
+        skips must not ride the NEXT clean step's bundle — stale
+        nonfinite counts would fire a false divergence (ISSUE 15
+        review finding)."""
+        obs.enable()
+        num.enable(interval=1)
+        flight.arm(str(tmp_path))
+        rng = np.random.default_rng(23)
+        lin = [pt.nn.Linear(6, 6) for _ in range(2)]
+        params = [p for lyr in lin for p in lyr.parameters()]
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        scaler = GradScaler(init_loss_scaling=2.0 ** 8,
+                            decr_every_n_nan_or_inf=10)
+        x = pt.to_tensor(rng.standard_normal((4, 6)).astype(np.float32))
+
+        def step(poison=False):
+            with dq.backward_dispatch_mode("whole_graph"):
+                h = pt.ops.tanh(lin[0](x))
+                loss = (lin[1](h) ** 2).mean()
+                scaler.scale(loss).backward()
+                if poison:
+                    g = params[0]._grad
+                    g._set_data(g._data.at[0, 0].set(float("nan")))
+                scaler.step(opt)
+                opt.clear_grad()
+        step()
+        step(poison=True)       # skipped: taps recorded then drained
+        assert not num._STEP_TAPS
+        step()                  # clean step publishes clean taps only
+        step()
+        rec = num.flush()
+        assert rec["nonfinite"]["grad"] == 0
+        assert rec["backward"] is None or \
+            rec["backward"]["nonfinite"] == 0
+        assert flight.bundles(str(tmp_path)) == []
+
+    def test_state_dict_roundtrip_mid_decay(self):
+        scaler = GradScaler(init_loss_scaling=2.0 ** 10, incr_ratio=4.0,
+                            decr_ratio=0.25, incr_every_n_steps=7,
+                            decr_every_n_nan_or_inf=3)
+        params, opt, step = self._scaler_loop(scaler, n=2)
+        # advance INTO a decay run: one bad step of the three needed
+        with faults.inject("numerics.check", exc=num.PoisonGradient(),
+                           times=1, match={"where": "amp"}):
+            step()
+        assert scaler._bad_steps == 1 and scaler._scale == 2.0 ** 10
+        sd = scaler.state_dict()
+        # restore into a scaler built with DIFFERENT ctor args: every
+        # field must come from the checkpoint, not the ctor
+        s2 = GradScaler()
+        s2.load_state_dict(sd)
+        for attr in ("_scale", "_incr_ratio", "_decr_ratio",
+                     "_incr_every", "_decr_every", "_good_steps",
+                     "_bad_steps", "_found_inf", "_dynamic"):
+            assert getattr(s2, attr) == getattr(scaler, attr), attr
+        # the restored scaler finishes the decay exactly where the
+        # original would: 2 more bad steps halve... decr_ratio=0.25
+        s2._found_inf = True
+        s2.update()
+        assert s2._bad_steps == 2
+        s2._found_inf = True
+        s2.update()
+        assert s2._scale == 2.0 ** 10 * 0.25 and s2._bad_steps == 0
+
+
+# ---------------------------------------------------------------------------
+# plumbing: obs.reset window semantics + fleet ride-along
+# ---------------------------------------------------------------------------
+class TestPlumbing:
+    def test_reset_clears_numerics_window(self):
+        num.enable(interval=1)
+        import jax.numpy as jnp
+        num.submit(num.pack_stats([jnp.ones((4,))], [jnp.ones((4,))],
+                                  [jnp.ones((4,))]),
+                   names=("w",), groups=("g0",))
+        assert num._PENDING is not None
+        obs.reset()
+        assert num._PENDING is None and num.last() is None
+        assert num.enabled()            # the flag survives
+
+    def test_series_ride_fleet_farewell(self):
+        """The numerics gauges are ordinary registry series, so they
+        ship in fleet bundles (the worker-farewell wire format) like
+        every other series — the aggregator sees per-process grad
+        norms."""
+        from paddle_tpu.observability import fleet
+        obs.enable()
+        num.enable(interval=1)
+        import jax.numpy as jnp
+        num.submit(num.pack_stats([jnp.ones((4,))], [jnp.ones((4,))],
+                                  [jnp.ones((4,))]),
+                   names=("w",), groups=("g0",))
+        num.flush()
+        bundle = fleet.worker_farewell()
+        snap = bundle["metrics"]
+        assert "paddle_tpu_train_grad_norm" in snap
+        assert snap["paddle_tpu_train_grad_norm"]["series"]
+
+    def test_flight_reason_registered(self):
+        assert "numerics_divergence" in flight.TRIGGER_REASONS
+
+
+# ---------------------------------------------------------------------------
+# graftlint: the flight-reason-documented rule (fixture, both ways)
+# ---------------------------------------------------------------------------
+class TestFlightReasonRule:
+    SRC = (
+        'TRIGGER_REASONS = ("step_latency", "strange_reason")\n'
+        "def f():\n"
+        '    flight.trigger("other_reason", detail={})\n'
+    )
+
+    def _run(self, readme):
+        from tools.graftlint.core import analyze_source
+        return analyze_source(
+            self.SRC, path="paddle_tpu/observability/fixture.py",
+            rule_ids={"flight-reason-documented"}, readme_text=readme)
+
+    def test_undocumented_reasons_flagged(self):
+        found = self._run("step_latency is documented")
+        assert sorted(f.line for f in found) == [1, 3]
+        assert all(f.rule == "flight-reason-documented" for f in found)
+
+    def test_documented_reasons_clean(self):
+        assert self._run("step_latency strange_reason other_reason") \
+            == []
+
+    def test_out_of_scope_paths_ignored(self):
+        from tools.graftlint.core import analyze_source
+        assert analyze_source(
+            self.SRC, path="paddle_tpu/inference/fixture.py",
+            rule_ids={"flight-reason-documented"}, readme_text="") == []
+
+    def test_repo_is_clean(self):
+        """Every live trigger reason in the repo is documented — the
+        rule holds on the actual tree (0 new findings is also pinned
+        by the repo gate in test_graftlint, but the rule-scoped run
+        keeps the failure message readable)."""
+        from tools.graftlint.core import run_paths, repo_root
+        rep = run_paths(["paddle_tpu"], root=repo_root(),
+                        rule_ids={"flight-reason-documented"})
+        assert rep.new == []
+
+
+# ---------------------------------------------------------------------------
+# obs_top: the numerics panel
+# ---------------------------------------------------------------------------
+@pytest.mark.obs
+class TestObsTopPanel:
+    def test_numerics_panel_renders(self):
+        import json
+        import importlib
+        obs.enable()
+        num.enable(interval=1)
+        import jax.numpy as jnp
+        num.submit(num.pack_stats([jnp.ones((4,))],
+                                  [jnp.full((4,), 2.0)],
+                                  [jnp.ones((4,)) * 0.9]),
+                   names=("w",), groups=("g0",))
+        num.flush()
+        _amp = importlib.import_module("paddle_tpu.amp")
+        m = _amp._amp_metrics()
+        m["scale"].set(1024.0)
+        m["steps"].labels(outcome="ok").inc(3)
+        m["steps"].labels(outcome="skipped").inc()
+        doc = json.loads(obs.to_json())
+        import tools.obs_top as obs_top
+        frame = obs_top.render(doc)
+        assert "== numerics ==" in frame
+        assert "grad norm" in frame and "all=" in frame
+        assert "loss scale   1024" in frame
+        assert "ok=3 skipped=1" in frame
+
+    def test_no_panel_when_silent(self):
+        import json
+        obs.enable()
+        doc = json.loads(obs.to_json())
+        import tools.obs_top as obs_top
+        assert "== numerics ==" not in obs_top.render(doc)

@@ -223,6 +223,54 @@ class FaultPointNaming(Rule):
 
 
 @register
+class FlightReasonDocumented(Rule):
+    id = "flight-reason-documented"
+    family = "obs"
+    severity = "error"
+    invariant = ("every flight-recorder trigger reason — the "
+                 "TRIGGER_REASONS registry and every literal "
+                 "flight.trigger(\"...\") site under "
+                 "paddle_tpu/observability/ — appears verbatim in the "
+                 "README flight-recorder documentation (the series-"
+                 "table reason enum / trigger prose)")
+    history = ("collective_skew (PR 14) was documented only by manual "
+               "convention; numerics_divergence (ISSUE 15) made the "
+               "convention a rule — an operator must be able to grep "
+               "any bundle directory's reason straight to its docs")
+
+    def check(self, mod):
+        if not mod.path.startswith("paddle_tpu/observability/"):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if "TRIGGER_REASONS" in names and isinstance(
+                        node.value, (ast.Tuple, ast.List)):
+                    for elt in node.value.elts:
+                        reason = _literal_str(elt)
+                        if reason and _readme_missing(
+                                reason, mod.project.readme):
+                            yield self.finding(
+                                mod, elt.lineno,
+                                f"flight trigger reason '{reason}' "
+                                "(TRIGGER_REASONS) is not documented "
+                                "in the README flight-recorder tables")
+            if isinstance(node, ast.Call):
+                d = U.dotted(node.func) or ""
+                if d.split(".")[-1] != "trigger" or not node.args:
+                    continue
+                reason = _literal_str(node.args[0])
+                if reason and _readme_missing(reason,
+                                              mod.project.readme):
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"flight trigger reason '{reason}' is not "
+                        "documented in the README flight-recorder "
+                        "tables")
+
+
+@register
 class CollectiveInstrumentation(Rule):
     id = "collective-instrumentation"
     family = "obs"

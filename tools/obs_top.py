@@ -345,6 +345,49 @@ def render(doc, prev=None, dt=None) -> str:
                 f"({int(gc['hit'])} hit / {int(gc['miss'])} miss / "
                 f"{int(gc['bypass'])} bypass backwards)")
 
+    # training numerics: grad/param norms + update ratio from the
+    # in-trace stats plane, AMP loss-scale state, nonfinite totals and
+    # the divergence bundle count (present only while numerics is on)
+    # zero-valued rows are obs.reset() leftovers (registered series
+    # survive a reset) — filter them, the family-budget convention
+    gn = {s["labels"].get("group"): s["value"]
+          for s in _series(doc, "paddle_tpu_train_grad_norm")
+          if s["value"]}
+    scale = _value(doc, "paddle_tpu_amp_loss_scale") or None
+    nonf = {s["labels"]["where"]: int(s["value"])
+            for s in _series(doc, "paddle_tpu_train_nonfinite_total")}
+    if gn or scale is not None or any(nonf.values()):
+        lines.append("== numerics ==")
+        if gn:
+            lines.append("  grad norm    " + "  ".join(
+                f"{k}={gn[k]:.4g}"
+                for k in sorted(gn, key=lambda k: (k != "all", k))))
+        pn = _value(doc, "paddle_tpu_train_param_norm") or None
+        ur = _value(doc, "paddle_tpu_train_update_ratio") or None
+        if pn is not None:
+            row = f"  param norm   {pn:.4g}"
+            if ur is not None:
+                row += f"   update ratio {ur:.3g}"
+            lines.append(row)
+        if scale is not None:
+            ok = _counter_sum(doc, "paddle_tpu_amp_steps_total",
+                              outcome="ok")
+            sk = _counter_sum(doc, "paddle_tpu_amp_steps_total",
+                              outcome="skipped")
+            decr = _counter_sum(doc,
+                                "paddle_tpu_amp_scale_decreases_total")
+            lines.append(f"  loss scale   {scale:g}   steps "
+                         f"ok={int(ok)} skipped={int(sk)} "
+                         f"decreases={int(decr)}")
+        if any(nonf.values()):
+            lines.append("  nonfinite    " + "  ".join(
+                f"{w}={nonf.get(w, 0)}"
+                for w in ("grad", "param", "loss")))
+        div = _counter_sum(doc, "paddle_tpu_flight_bundles_total",
+                           reason="numerics_divergence")
+        if div:
+            lines.append(f"  divergence bundles {int(div)}")
+
     # collective telemetry: per-op latency percentiles + bytes rates,
     # goodput split, and the aggregator's cross-rank skew / straggler
     # attribution (present only in a fleet aggregator's export)

@@ -36,6 +36,12 @@ hot path in this repo is bandwidth-dominated, see BENCH_EXTRA).
     hit/miss/bypass counts ride the record and are echoed in the
     verdict (report-only: steady-state O(1) dispatch shows as hits
     dominating);
+  * the dispatch config's whole_graph record also carries the
+    training-numerics on-vs-off overhead ratio (`numerics.
+    overhead_ratio`, bench.py --config dispatch) — a cost like the
+    gap total, checked with the same mirror rule plus an absolute
+    floor, so the numerics plane's ≤3% overhead claim cannot silently
+    erode; the measured grad norm rides report-only;
   * records carrying a fleet `process_role` (observability.fleet's
     `append_capacity_ledger` writes one per process) are baselined per
     (config, process_role), and their `capacity.req_per_s` /
@@ -108,6 +114,19 @@ def _config_key(rec) -> str:
 # a gap delta below this is timer jitter, not a regression — it gives
 # the dispatch-gap check a finite threshold even over a 0.0 baseline
 GAP_FLOOR_MS_PER_STEP = 0.01
+
+# numerics on-vs-off overhead is a ratio near 1.0 measured on a noisy
+# box: require the regression to clear an absolute floor on top of the
+# relative tolerance (the GAP_FLOOR idiom) before failing
+NUMERICS_OVERHEAD_FLOOR = 0.05
+
+
+def _numerics_ratio(rec):
+    num = rec.get("numerics")
+    if not isinstance(num, dict):
+        return None
+    v = num.get("overhead_ratio")
+    return float(v) if v is not None else None
 
 
 def _gap_ms(rec):
@@ -202,6 +221,36 @@ def check(records, tol: float, only_config=None) -> dict:
         gc = latest.get("graph_cache")
         if isinstance(gc, dict):
             out["graph_cache"] = gc
+        # numerics-plane overhead regression (ISSUE 15): the dispatch
+        # config's whole_graph record carries the measured numerics
+        # on-vs-off step-time ratio — a COST like the gap total, so
+        # the same mirror rule: latest above (1 + tol) x the best
+        # (lowest) prior-revision ratio AND past an absolute floor
+        # fails; same-rev priors report-only, same-device only.
+        cur_num = _numerics_ratio(latest)
+        if cur_num is not None:
+            nout = {"overhead_ratio": cur_num,
+                    "ratio_vs_history": None, "baseline_rev": None,
+                    "regressed": False,
+                    "grad_norm": (latest.get("numerics") or {}).get(
+                        "grad_norm")}
+            prior = [(_numerics_ratio(prev), prev.get("rev"))
+                     for prev in history]
+            prior = [p for p in prior if p[0] is not None]
+            other_rev = [p for p in prior if p[1] != latest.get("rev")]
+            pool = other_rev or prior
+            if pool:
+                best_num, best_rev = min(pool)
+                if best_num > 0:
+                    nout["ratio_vs_history"] = round(
+                        cur_num / best_num, 4)
+                nout["baseline_rev"] = best_rev
+                if best_rev != latest.get("rev") and cur_num > max(
+                        best_num * (1.0 + tol),
+                        best_num + NUMERICS_OVERHEAD_FLOOR):
+                    nout["regressed"] = True
+                    out["pass"] = False
+            out["numerics"] = nout
         # fleet capacity regression: achieved rates are the bytes/s
         # rule again — the latest record's req/s / tok/s below
         # (1 - tol) x the best prior-revision record for the same
@@ -269,6 +318,15 @@ def trajectory(records) -> str:
                 f"{'(graph cache)':<16} "
                 + " ".join(f"{k}={gc.get(k, 0)}"
                            for k in ("hit", "miss", "bypass")))
+        nr = _numerics_ratio(rec)
+        if nr is not None:
+            gnorm = (rec.get("numerics") or {}).get("grad_norm")
+            lines.append(
+                f"{ckey:<22} {rec.get('rev', '?'):<19} "
+                f"{'(numerics)':<16} "
+                f"overhead=x{nr:.4f}"
+                + (f" grad_norm={gnorm:.4g}" if gnorm is not None
+                   else ""))
         cap = rec.get("capacity")
         if isinstance(cap, dict):
             req, tok = cap.get("req_per_s"), cap.get("tok_per_s")
