@@ -1363,6 +1363,144 @@ def bench_lint(on_tpu):
     }
 
 
+def bench_autopilot(on_tpu):
+    """Self-healing reaction time: an in-process mini fleet (served
+    aggregator + attached supervisor + one polling trainer) burns
+    through repeated injected NaN episodes — PoisonGradient at a known
+    step, divergence event shipped, rollback commanded over the real
+    RPC loopback, checkpoint restored, outcome reported — and the
+    BENCH line carries the autopilot's two latencies: detection
+    (divergence emission -> supervisor episode open) and MTTR
+    (detection -> training resumed). Host + loopback-socket work; the
+    toy training is incidental."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as pt
+    from paddle_tpu.observability import fleet, numerics as num
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience import supervisor as sv
+
+    episodes = 5
+    steps_per_episode = 4
+    root = tempfile.mkdtemp(prefix="bench_autopilot_")
+    from paddle_tpu import observability as obs
+    obs.enable()        # detection rides the trace stream: obs is
+    num.enable(interval=1)      # the workload here, not overhead
+    agg = fleet.serve_aggregator()
+    sup = sv.attach(sv.Supervisor(
+        agg, ckpt_root=root,
+        policy=sv.Policy(max_rollbacks=episodes + 1)))
+    saved_ident = fleet.identity()
+    fleet.set_identity(process="bench_trainer", role="trainer")
+    try:
+        agent = fleet.FleetAgent(agg.endpoint, interval_s=3600.0,
+                                 timeout_s=30.0)
+        ctl = sv.TrainControl(agg.endpoint, "bench_trainer",
+                              timeout_s=30.0, retries=2)
+        rng = np.random.default_rng(0)
+        lin = pt.nn.Linear(16, 16)
+        params = lin.parameters()
+        for p in params:
+            p.set_value(pt.to_tensor(
+                rng.standard_normal(p.shape).astype(np.float32)))
+        opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=params)
+        sd = {p.name: p for p in params}
+        x = pt.to_tensor(
+            rng.standard_normal((8, 16)).astype(np.float32))
+
+        def train_step():
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        remediations = 0
+        step = 0
+        t0 = time.perf_counter()
+        for _ in range(episodes):
+            for k in range(steps_per_episode):
+                cmd = ctl.poll(step=step)
+                if cmd is not None:
+                    out = ctl.apply(cmd, state_dict=sd, root=root)
+                    ctl.report(cmd["episode"], **out)
+                    remediations += 1
+                    step = out["resumed_step"] + 1
+                    continue
+                if k == steps_per_episode - 1:
+                    faults.inject(
+                        "numerics.check",
+                        exc=num.PoisonGradient(param=params[0].name),
+                        times=1, match={"where": "step"})
+                train_step()
+                num.flush()
+                import numpy as _np
+                if all(_np.isfinite(_np.asarray(p._data)).all()
+                       for p in params):
+                    ckpt.save_state_dict(
+                        sd, os.path.join(root, f"step_{step}"))
+                agent.ship()
+                step += 1
+            # drain the rollback the poisoned step triggered
+            cmd = ctl.poll(step=step)
+            if cmd is not None:
+                out = ctl.apply(cmd, state_dict=sd, root=root)
+                ctl.report(cmd["episode"], **out)
+                remediations += 1
+                step = out["resumed_step"] + 1
+        wall = time.perf_counter() - t0
+
+        snap = agg.registry.snapshot()
+
+        def _hist_stats(name):
+            series = snap.get(name, {}).get("series", {})
+            for v in series.values():
+                if v.get("count"):
+                    return {"mean_ms": round(
+                                v["sum"] / v["count"] * 1e3, 3),
+                            "max_ms": round(v["max"] * 1e3, 3),
+                            "count": v["count"]}
+            return {"mean_ms": None, "max_ms": None, "count": 0}
+
+        detect = _hist_stats(
+            "paddle_tpu_autopilot_detection_latency_seconds")
+        mttr = _hist_stats("paddle_tpu_autopilot_mttr_seconds")
+        autopilot = {
+            "episodes": remediations,
+            "detection_latency": detect,
+            "mttr": mttr,
+            "wall_seconds": round(wall, 3),
+        }
+        from paddle_tpu.observability import perf
+        return {
+            "metric": "autopilot_mttr_ms",
+            "value": mttr["mean_ms"],
+            "unit": "ms",
+            # healthy = every injected episode remediated, zero stuck
+            "vs_baseline": 1.0 if remediations == episodes
+                           and sup.failure is None else 0.0,
+            "extra": {"detection_latency_ms": detect["mean_ms"],
+                      "episodes_injected": episodes,
+                      "episodes_remediated": remediations,
+                      "policy": sup.policy.to_dict()},
+            "_ledger_modes": [{
+                "mode": "autopilot",
+                "families": perf.family_records(),
+                "dispatch_gap": None,
+                "autopilot": autopilot,
+            }],
+        }
+    finally:
+        faults.clear("numerics.check")
+        fleet.set_identity(process=saved_ident[0],
+                           role=saved_ident[1])
+        sup.close()
+        agg.close()
+        num.disable()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2_small,
     "lint": bench_lint,
@@ -1376,6 +1514,7 @@ CONFIGS = {
     "prefix_serving": bench_prefix_serving,
     "spec_decode": bench_spec_decode,
     "router_serving": bench_router_serving,
+    "autopilot": bench_autopilot,
 }
 
 
@@ -1527,6 +1666,8 @@ def _append_perf_ledger(path, name, result, modes=None):
                 rec["graph_cache"] = m["graph_cache"]
             if m.get("numerics"):
                 rec["numerics"] = m["numerics"]
+            if m.get("autopilot"):
+                rec["autopilot"] = m["autopilot"]
             records.append(rec)
     else:
         from paddle_tpu.observability import comms as _comms

@@ -318,6 +318,27 @@ class GradScaler:
     def get_loss_scaling(self):
         return Tensor(jnp.asarray(self._scale, jnp.float32))
 
+    def set_loss_scaling(self, scale: float):
+        """Pin the dynamic loss scale to `scale` and reset the
+        good/bad step counters — the training autopilot's
+        `reraise_scale` remediation (resilience.supervisor): after a
+        rollback, re-raising the scale out of a collapsed-to-floor
+        regime restarts the doubling search from a sane point instead
+        of grinding up from 1.0 by `incr_ratio` every `incr_every`
+        steps. The change is reported to the numerics plane like any
+        update() so the scale history stays honest."""
+        self._scale = float(scale)
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        if self._enable and _om._ENABLED:
+            _amp_metrics()["scale"].set(self._scale)
+        # the remediation ends the divergence episode: re-arm the
+        # sentinel so a second collapse fires its own bundle (a floored
+        # run only has skipped steps — no clean publish ever re-arms it)
+        _num.rearm()
+        _num.note_loss_scale(self._scale, decreased=False)
+
     def state_dict(self):
         # COMPLETE round trip (ISSUE 15 satellite): the original dict
         # dropped the ratios on load and omitted found_inf/_dynamic

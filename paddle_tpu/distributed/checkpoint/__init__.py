@@ -417,12 +417,36 @@ def _ckpt_order_key(name: str) -> Tuple:
     return (1, int(digits)) if digits else (0, 0)
 
 
+class RestoredCheckpoint(str):
+    """resume_latest's return value: the restored checkpoint's path
+    (a str — every existing `path == ...` / os.path.* caller keeps
+    working) annotated with what the supervisor needs to know WITHOUT
+    re-reading metadata.json:
+
+    * ``step`` — the trailing integer of the directory name
+      (``step_200`` → 200), or None when the name carries none.
+    * ``meta`` — the parsed metadata.json dict (tensor entries +
+      ``__manifest__``).
+    """
+
+    step: Optional[int]
+    meta: Dict
+
+    def __new__(cls, path: str, step: Optional[int], meta: Dict):
+        self = super().__new__(cls, path)
+        self.step = step
+        self.meta = meta
+        return self
+
+
 def resume_latest(state_dict: Dict, root: str, verify: bool = True,
-                  cleanup: bool = False) -> Optional[str]:
+                  cleanup: bool = False) -> Optional["RestoredCheckpoint"]:
     """Restore the newest COMPLETE checkpoint under `root` into
     `state_dict` (in place), skipping torn/corrupted ones — the restart
-    entry point after a crash. Returns the loaded checkpoint's path, or
-    None when no usable checkpoint exists.
+    entry point after a crash. Returns the loaded checkpoint's path as
+    a `RestoredCheckpoint` (a str subclass additionally carrying the
+    restored ``.step`` and ``.meta``), or None when no usable
+    checkpoint exists.
 
     Candidates are the subdirectories of `root` holding a metadata.json
     (hidden `.*.tmp-*` / `.*.old-*` staging dirs are ignored), ordered
@@ -465,11 +489,14 @@ def resume_latest(state_dict: Dict, root: str, verify: bool = True,
             continue    # not a checkpoint at all (logs/, tensorboard/,
             # ...) — never a "torn" candidate, never quarantined
         entries.append((_ckpt_order_key(name), os.path.getmtime(p), p))
-    for _, _, p in sorted(entries, reverse=True):
+    for key, _, p in sorted(entries, reverse=True):
         problems = verify_checkpoint(p, deep=verify)
         if not problems:
             load_state_dict(state_dict, p)
-            return p
+            with open(os.path.join(p, _META)) as f:
+                meta = json.load(f)
+            step = key[1] if key[0] else None
+            return RestoredCheckpoint(p, step, meta)
         import warnings
         warnings.warn(
             f"resume_latest: skipping torn checkpoint {p}: "

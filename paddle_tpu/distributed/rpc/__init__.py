@@ -93,6 +93,16 @@ def _obs():
                 "(network flake, port scan); peer address is logged "
                 "at warning level",
                 ("reason",)),
+            "retries": r.counter(
+                "paddle_tpu_rpc_retries_total",
+                "call_endpoint transport-failure retry accounting: "
+                "retried = one re-attempt after a ConnectionError/"
+                "timeout (backoff applied first), gave_up = retry "
+                "budget exhausted and the last transport error "
+                "propagated to the caller. Remote exceptions (status "
+                "err) are a successful round trip and are never "
+                "retried",
+                ("outcome",)),
         }
     return _OBS
 
@@ -374,16 +384,53 @@ def _invoke(to, fn, args, kwargs, timeout):
 
 
 def call_endpoint(endpoint, fn, args=None, kwargs=None,
-                  timeout=_DEFAULT_RPC_TIMEOUT):
+                  timeout=_DEFAULT_RPC_TIMEOUT, retries=0,
+                  backoff_s=0.05, backoff_max_s=2.0):
     """Blocking call straight to an `ip:port` (string or (ip, port)
     tuple) without group rendezvous — the peer just needs a serve()d
     call handler and the same HMAC token. Remote exceptions
-    propagate like rpc_sync."""
+    propagate like rpc_sync.
+
+    Supervisor-grade hardening: `timeout` bounds EVERY socket
+    operation of one attempt (connect, send, receive — a wedged peer
+    that accepts but never answers raises socket.timeout instead of
+    hanging the caller), and `retries` re-attempts are made after
+    transport failures only, sleeping a bounded exponential backoff
+    (backoff_s doubling up to backoff_max_s) between attempts. A
+    remote exception shipped back as status "err" is a SUCCESSFUL
+    round trip and always propagates immediately — retrying it would
+    re-execute a non-idempotent call. Accounting lands on
+    `paddle_tpu_rpc_retries_total{outcome=retried|gave_up}`."""
     if isinstance(endpoint, str):
         ip, port = endpoint.rsplit(":", 1)
     else:
         ip, port = endpoint
-    return _call_endpoint(ip, int(port), fn, args, kwargs, timeout)
+    delay = backoff_s
+    attempts_left = max(0, int(retries))
+    while True:
+        try:
+            return _call_endpoint(ip, int(port), fn, args, kwargs,
+                                  timeout)
+        except (ConnectionError, socket.timeout, OSError) as e:
+            o = _obs()
+            if attempts_left <= 0:
+                if retries:
+                    try:
+                        o["retries"].labels(outcome="gave_up") \
+                            ._value += 1
+                    except Exception:
+                        pass
+                raise
+            attempts_left -= 1
+            try:
+                o["retries"].labels(outcome="retried")._value += 1
+            except Exception:
+                pass
+            _log.warning("rpc call_endpoint to %s:%s failed (%s); "
+                         "retrying in %.3fs (%d attempts left)",
+                         ip, port, e, delay, attempts_left)
+            time.sleep(delay)
+            delay = min(delay * 2, backoff_max_s)
 
 
 def serve(bind: str = "127.0.0.1", port: int = 0):

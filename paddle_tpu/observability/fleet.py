@@ -359,6 +359,10 @@ class FleetAgent:
             return
         take = evs[max(0, len(evs) - new):]
         ring_dropped = new - len(take)
+        # events the aggregator ingested FROM the fleet are not ours
+        # to ship: a co-resident agent re-shipping them would echo
+        # them around the fleet forever (tracing.ingest tags them)
+        take = [ev for ev in take if not ev.get("ingested")]
         overflow = max(0, len(self._buffer) + len(take)
                        - self._buffer.maxlen)
         self._buffer.extend(take)
@@ -489,6 +493,11 @@ class FleetAggregator:
         self._lock = threading.Lock()
         self._server = None
         self.endpoint: Optional[str] = None
+        # ingest observers: callbacks fired OUTSIDE the lock after a
+        # bundle commits, with (process, bundle) — the training
+        # autopilot's supervisor watches the plane through this hook
+        # instead of polling the merged registry
+        self._observers: List = []
         h = self.registry
         self._h = {
             "bundles": h.counter(
@@ -660,7 +669,31 @@ class FleetAggregator:
         # race in between.
         for detail in skew_triggers:
             _fl.trigger("collective_skew", detail=detail)
+        # observers also run outside the lock, and an observer that
+        # raises must not turn the agent's acknowledged ship into a
+        # redelivery loop — the bundle already committed
+        for cb in list(self._observers):
+            try:
+                cb(proc, bundle)
+            except Exception:
+                import logging
+                logging.getLogger("paddle_tpu.observability.fleet") \
+                    .exception("fleet ingest observer failed")
         return {"ok": True, "seq": seq, "rejected_metrics": rejected}
+
+    def add_observer(self, cb) -> None:
+        """Register a post-ingest callback `cb(process, bundle)`, fired
+        outside the aggregator lock after each accepted (non-duplicate)
+        bundle commits. The supervisor (resilience.supervisor) attaches
+        here to watch divergence events and heartbeats as they arrive."""
+        with self._lock:
+            if cb not in self._observers:
+                self._observers.append(cb)
+
+    def remove_observer(self, cb) -> None:
+        with self._lock:
+            if cb in self._observers:
+                self._observers.remove(cb)
 
     # -- cross-rank straggler attribution (called under self._lock) --
     def _note_arrivals(self, proc: str, events) -> list:
@@ -719,6 +752,13 @@ class FleetAggregator:
                         "skew_s": round(skew, 6), "straggler": slow,
                         "arrivals_us": dict(procs)})
         return triggers
+
+    def stragglers(self) -> Dict[str, str]:
+        """Current one-hot straggler attribution: op -> flagged
+        process (empty while the fleet is clean). The supervisor's
+        sustained-straggler detector samples this on each scan."""
+        with self._lock:
+            return dict(self._straggler_cur)
 
     # -- health --
     def processes(self) -> Dict[str, dict]:

@@ -63,7 +63,8 @@ _BUNDLES_COUNTER = None
 
 TRIGGER_REASONS = ("step_latency", "deadline_miss", "preempt_storm",
                    "fault_point", "slo_breach", "collective_skew",
-                   "numerics_divergence", "manual")
+                   "numerics_divergence", "autopilot_remediation",
+                   "manual")
 
 
 class FlightConfig:

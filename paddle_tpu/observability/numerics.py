@@ -274,6 +274,17 @@ def reset_window() -> None:
     _SCALE_HISTORY.clear()
 
 
+def rearm() -> None:
+    """Clear the divergence latch WITHOUT touching windows/histories —
+    a remediation (the autopilot's rollback + loss-scale re-raise)
+    ended the episode, so the next collapse must count as a NEW
+    episode even when no clean publish happened in between (every step
+    of a floored AMP run is a skipped step: nothing publishes, so the
+    clean-step re-arm never runs)."""
+    global _DIVERGED
+    _DIVERGED = False
+
+
 def pulls() -> int:
     """Cumulative host pulls performed by the plane (exactly one per
     published step bundle — the ≤1-async-pull-per-step contract is
@@ -603,6 +614,21 @@ def _fire(reasons: List[str], rec: dict, trace_id=None,
         detail["trace_id"] = trace_id
         detail["span_id"] = span_id
     _fl.trigger("numerics_divergence", detail=detail)
+    if _t.enabled():
+        # structured divergence event INTO the trace ring: the fleet
+        # agent ships ring events, so this is how a divergence reaches
+        # the aggregator-hosted supervisor (resilience.supervisor)
+        # with enough attribution to pick a remediation — the flight
+        # bundle above stays on the diverging process's disk
+        import time as _time
+        _t.add_event("numerics.divergence",
+                     _time.perf_counter() * 1e6, 0.0, args={
+            "step": rec["step"], "source": rec["source"],
+            "reasons": list(reasons),
+            "first_nonfinite_param": rec.get("first_nonfinite_param"),
+            "grad_norm": rec.get("grad_norm"),
+            "loss_scale": (rec.get("nonfinite") or {}).get("loss_scale"),
+        })
 
 
 # ---------------------------------------------------------------------------

@@ -205,16 +205,22 @@ def add_event(name: str, ts_us: float, dur_us: float,
 
 def ingest(evs) -> None:
     """Append events recorded elsewhere (another process's ring, a
-    bundle) verbatim — pid/tid/ts/ids are preserved. Bypasses the
-    enabled flag for the same reason metrics merge() does: the child
-    only has events to ship because recording was on when it
-    mattered."""
+    bundle) — pid/tid/ts/ids are preserved. Bypasses the enabled flag
+    for the same reason metrics merge() does: the child only has
+    events to ship because recording was on when it mattered. Each
+    event is tagged ("ingested": True) so a FleetAgent sharing the
+    ingesting process never ships it back out — an aggregator
+    co-resident with an agent (single-process fleets: bench, tests,
+    chief-hosted aggregation) would otherwise echo every received
+    event into its own next bundle forever (one shipped
+    numerics.divergence event would re-detect on every heartbeat)."""
     if not evs:
         return
     global _APPENDED
+    tagged = [dict(ev, ingested=True) for ev in evs]
     with _LOCK:
-        _APPENDED += len(evs)
-        _RING.extend(evs)
+        _APPENDED += len(tagged)
+        _RING.extend(tagged)
 
 
 def events() -> List[dict]:

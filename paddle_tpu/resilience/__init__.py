@@ -13,6 +13,11 @@ What lives here vs. where the behaviors are implemented:
     `framework_io.*` fault points. `resume_latest` re-exported here.
   * Self-healing DataLoader (dead-worker restart, guaranteed
     SharedMemory unlink) — `io/`, instrumented with `io.*` points.
+  * Training autopilot (closed-loop self-healing: divergence rollback,
+    N-1 elastic restart, loss-scale-floor escalation) — `supervisor`
+    (this package), instrumented with the `supervisor.act` point.
+    `Supervisor` / `TrainControl` / `AutopilotFailure` re-exported
+    here.
 
 See README "Fault tolerance & chaos testing" and
 tests/test_resilience.py for the contract each path guarantees."""
@@ -26,6 +31,12 @@ def __getattr__(name):
     if name in ("resume_latest", "is_complete", "verify_checkpoint"):
         from ..distributed import checkpoint as _ckpt
         val = getattr(_ckpt, name)
+        globals()[name] = val
+        return val
+    if name in ("Supervisor", "TrainControl", "AutopilotFailure",
+                "Policy"):
+        from . import supervisor as _sv
+        val = getattr(_sv, name)
         globals()[name] = val
         return val
     raise AttributeError(

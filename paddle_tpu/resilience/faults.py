@@ -37,6 +37,9 @@ Registered fault points (grep `fault_point(` for ground truth):
                               rename (ctx: path)
     io.worker.batch           in a spawned DataLoader worker, before
                               producing a batch (ctx: wid, bi)
+    supervisor.act            training-autopilot supervisor, before each
+                              remediation action commits (ctx: action,
+                              kind, process)
 
 Injection specs support:
 

@@ -1,0 +1,653 @@
+"""Training autopilot: closed-loop self-healing at fleet scale (see
+README "Training autopilot").
+
+PRs 11-15 built the detection stack — the numerics divergence sentinel
+with first-bad-parameter attribution, cross-rank straggler gauges,
+heartbeat staleness, crash-safe checkpoints with torn-checkpoint
+quarantine — but every signal dead-ended in a dashboard: a NaN'd or
+straggler-stalled fleet waited for a human. This module closes the
+loop. A `Supervisor`, hosted in the fleet-aggregator process, watches
+the plane through the aggregator's post-ingest observer hook and ACTS
+on three detector families:
+
+* **NaN / divergence.** A `numerics.divergence` trace event (emitted
+  by the sentinel alongside its flight bundle, shipped inside the
+  diverging process's next fleet bundle) opens an episode. The
+  supervisor commands the training loop — which polls it every step
+  through `TrainControl` — to halt, roll back via
+  `distributed.checkpoint.resume_latest` (whose return now carries
+  the restored step), apply the policy remediation (`skip_batch`:
+  replay past the poisoned batch without training on it, or
+  `reraise_scale`: pin the AMP loss scale back up via
+  `GradScaler.set_loss_scaling`), and resume. Continuation from the
+  last good step is bit-exact (pinned by tests/test_autopilot.py).
+
+* **Dead rank / persistent straggler.** Missed heartbeats past the
+  policy staleness window, or a `collective_straggler` attribution
+  held continuously for `straggler_sustain_s`, evict the rank and
+  command the controller process to elastic-restart the fleet at N-1
+  — checkpoint load-time resharding (the GSPMD-style mesh-change
+  machinery in `distributed.checkpoint`) restores the 8-rank state
+  onto the 7-rank mesh at load time.
+
+* **Repeated AMP loss-scale floor.** The first `loss_scale_floor`
+  episodes are remediated (rollback + `reraise_scale`); once
+  `scale_floor_max` episodes have burned, the supervisor escalates to
+  a named, actionable `AutopilotFailure` — the polling trainer raises
+  it instead of grinding on as a silent dead run.
+
+Every episode emits exactly ONE `autopilot_remediation` flight bundle
+whose detail is the full detection → action → outcome timeline, plus
+detection-latency and MTTR (detection → training resumed) readings on
+`paddle_tpu_autopilot_*` series. Remediation itself is chaos-testable:
+`faults.fault_point("supervisor.act", action=..., kind=..., process=
+...)` fires before each action COMMITS, and an action that dies there
+leaves the episode's pending-action journal intact — the next
+`scan()` pass completes the recovery (checkpoints stay un-torn
+throughout; rollback only ever READS them).
+
+Split of responsibility: the supervisor never reaches into a trainer
+process — it only answers polls. `TrainControl.poll(step)` (one
+hardened RPC per step: bounded timeout + bounded-backoff retries, so
+a wedged aggregator cannot hang the step loop any more than a wedged
+trainer can hang the supervisor's watch) returns the next command,
+and `TrainControl.apply(...)` executes the rollback locally. A clean
+run polls, receives `None` forever, and performs zero remediations.
+
+Operator entry point: `tools/autopilot.py` serves an aggregator with
+an attached supervisor and prints episode summaries as they close.
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import faults
+
+__all__ = ["AutopilotFailure", "Policy", "Episode", "Supervisor",
+           "TrainControl", "attach", "supervisor"]
+
+
+class AutopilotFailure(RuntimeError):
+    """Named, actionable autopilot escalation — raised (trainer side)
+    or recorded (supervisor side) when remediation is exhausted, so a
+    dead run fails LOUDLY with the episode history attached instead of
+    burning accelerator-hours at loss scale 1.0."""
+
+    def __init__(self, message: str, kind: Optional[str] = None,
+                 episodes: Optional[List[dict]] = None):
+        super().__init__(message)
+        self.kind = kind
+        self.episodes = list(episodes or ())
+
+
+class Policy:
+    """Remediation policy knobs — one instance per supervisor; the
+    defaults match the README policy table."""
+
+    __slots__ = ("nan_policy", "reraise_factor", "max_rollbacks",
+                 "heartbeat_stale_s", "straggler_sustain_s",
+                 "scale_floor_max")
+
+    def __init__(self, nan_policy: str = "skip_batch",
+                 reraise_factor: float = 16.0,
+                 max_rollbacks: int = 3,
+                 heartbeat_stale_s: float = 10.0,
+                 straggler_sustain_s: float = 5.0,
+                 scale_floor_max: int = 2):
+        if nan_policy not in ("skip_batch", "reraise_scale"):
+            raise ValueError(
+                "nan_policy must be 'skip_batch' or 'reraise_scale', "
+                f"got {nan_policy!r}")
+        self.nan_policy = nan_policy
+        self.reraise_factor = float(reraise_factor)
+        self.max_rollbacks = int(max_rollbacks)
+        self.heartbeat_stale_s = float(heartbeat_stale_s)
+        self.straggler_sustain_s = float(straggler_sustain_s)
+        self.scale_floor_max = int(scale_floor_max)
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+_EPISODE_IDS = itertools.count(1)
+
+# episode kinds (detector families)
+KIND_NAN = "nan"
+KIND_SCALE_FLOOR = "scale_floor"
+KIND_DEAD_RANK = "dead_rank"
+KIND_STRAGGLER = "straggler"
+
+
+class Episode:
+    """One detected incident and its remediation lifecycle. `pending`
+    is the action journal: actions move out of it only AFTER their
+    `supervisor.act` fault point + commit succeeded, so a crash inside
+    remediation leaves the journal for the next scan() to drain."""
+
+    __slots__ = ("id", "kind", "process", "detail", "timeline",
+                 "pending", "state", "detected_t", "detected_mono",
+                 "done_t", "outcome", "last_action")
+
+    def __init__(self, kind: str, process: str, detail: dict,
+                 pending: List[dict], now: float):
+        self.id = next(_EPISODE_IDS)
+        self.kind = kind
+        self.process = process
+        self.detail = dict(detail)
+        self.timeline: List[dict] = []
+        self.pending = list(pending)
+        self.state = "detected"     # -> acting -> awaiting -> done
+        self.detected_t = now
+        self.detected_mono = time.perf_counter()
+        self.done_t: Optional[float] = None
+        self.outcome: Optional[dict] = None
+        self.last_action: Optional[str] = None
+
+    def note(self, phase: str, **kv) -> None:
+        ent = {"t": round(time.time(), 6), "phase": phase}
+        ent.update(kv)
+        self.timeline.append(ent)
+
+    def snapshot(self) -> dict:
+        return {"id": self.id, "kind": self.kind,
+                "process": self.process, "state": self.state,
+                "detail": dict(self.detail),
+                "timeline": [dict(e) for e in self.timeline],
+                "pending": [dict(a) for a in self.pending],
+                "outcome": dict(self.outcome) if self.outcome else None}
+
+
+def _hobserve(child, v: float) -> None:
+    """Flag-bypassing histogram observe (the `_bump` precedent for
+    counters): autopilot self-accounting must record even when the
+    hosting process's hot-path flag is off."""
+    child._buckets[bisect.bisect_left(child._bounds, v)] += 1
+    child._sum += v
+    child._count += 1
+    if v < child._min:
+        child._min = v
+    if v > child._max:
+        child._max = v
+
+
+class Supervisor:
+    """The watch-and-act loop. Construct with the serving
+    `FleetAggregator` (detection attaches to its post-ingest observer
+    hook and its merged registry hosts the autopilot series) and the
+    checkpoint root rollbacks restore from; call `scan()` on a cadence
+    (the CLI does; tests drive it manually). `attach()` additionally
+    publishes the instance for the module-level RPC targets, so
+    `TrainControl` in trainer processes can poll through the
+    aggregator's existing HMAC call server."""
+
+    def __init__(self, agg=None, ckpt_root: Optional[str] = None,
+                 policy: Optional[Policy] = None, registry=None,
+                 controller: Optional[str] = None):
+        from ..observability import metrics as _m
+        self.agg = agg
+        self.ckpt_root = ckpt_root
+        self.policy = policy or Policy()
+        self.controller = controller
+        self.failure: Optional[AutopilotFailure] = None
+        self._lock = threading.RLock()
+        self._open: Dict[int, Episode] = {}
+        self._done: List[dict] = []
+        # completed-episode history per (process, kind) — the repeated
+        # scale-floor / repeated-rollback escalation counters
+        self._history: Dict[tuple, int] = {}
+        self._evicted: set = set()
+        self._straggler_since: Dict[str, float] = {}
+        self._commands: Dict[str, List[dict]] = {}
+        self._pollers: Dict[str, dict] = {}
+        r = registry if registry is not None else (
+            agg.registry if agg is not None else _m.registry())
+        self.registry = r
+        self._h = {
+            "episodes": r.counter(
+                "paddle_tpu_autopilot_episodes_total",
+                "closed autopilot episodes by detector family "
+                "(kind=nan|scale_floor|dead_rank|straggler) and how "
+                "they ended (outcome=remediated|escalated|failed)",
+                ("kind", "outcome")),
+            "actions": r.counter(
+                "paddle_tpu_autopilot_actions_total",
+                "remediation actions the supervisor committed (the "
+                "supervisor.act fault point fired and the action took "
+                "effect), by action name from the README policy table",
+                ("action",)),
+            "action_failures": r.counter(
+                "paddle_tpu_autopilot_action_failures_total",
+                "remediation actions that died between the "
+                "supervisor.act fault point and their commit (chaos "
+                "injection, crash) — the episode's pending-action "
+                "journal survives and the next scan() retries",
+                ("action",)),
+            "last_action": r.gauge(
+                "paddle_tpu_autopilot_last_action",
+                "one-hot marker on the most recently committed "
+                "remediation action (1 on the latest, 0 elsewhere) — "
+                "the obs_top autopilot panel's 'last action' readout",
+                ("action",)),
+            "open": r.gauge(
+                "paddle_tpu_autopilot_open_episodes",
+                "episodes currently detected-but-not-closed (pending "
+                "actions or awaiting the trainer's outcome report)"),
+            "detect": r.histogram(
+                "paddle_tpu_autopilot_detection_latency_seconds",
+                "fault signal emission (the numerics.divergence event "
+                "timestamp, trainer clock) to supervisor detection "
+                "(aggregator clock; CLOCK_MONOTONIC, cross-process "
+                "comparable on one host)"),
+            "mttr": r.histogram(
+                "paddle_tpu_autopilot_mttr_seconds",
+                "mean-time-to-recovery per episode: detection to the "
+                "trainer's outcome report (training resumed / fleet "
+                "restarted); escalations observe detection-to-"
+                "escalation"),
+        }
+        if agg is not None:
+            agg.add_observer(self._on_bundle)
+
+    # -- lifecycle --
+    def close(self) -> None:
+        global _SUPERVISOR
+        if self.agg is not None:
+            try:
+                self.agg.remove_observer(self._on_bundle)
+            except Exception:
+                pass
+        if _SUPERVISOR is self:
+            _SUPERVISOR = None
+
+    # -- detection: fleet-bundle observer --
+    def _on_bundle(self, proc: str, bundle: dict) -> None:
+        for ev in bundle.get("trace") or ():
+            if ev.get("name") != "numerics.divergence":
+                continue
+            args = ev.get("args") or {}
+            reasons = args.get("reasons") or []
+            kind = KIND_SCALE_FLOOR if "loss_scale_floor" in reasons \
+                else KIND_NAN
+            self._detect(kind, proc, {
+                "step": args.get("step"), "reasons": list(reasons),
+                "first_nonfinite_param":
+                    args.get("first_nonfinite_param"),
+                "grad_norm": args.get("grad_norm"),
+                "loss_scale": args.get("loss_scale"),
+                "source": args.get("source"),
+            }, emitted_ts_us=ev.get("ts"))
+
+    def _detect(self, kind: str, proc: str, detail: dict,
+                emitted_ts_us=None) -> Optional[Episode]:
+        with self._lock:
+            for ep in self._open.values():
+                if ep.process == proc and ep.kind == kind:
+                    # same incident still in remediation: fold, don't
+                    # double-open (and never double-bundle)
+                    ep.note("detection_repeat", **detail)
+                    return None
+            pending = self._plan(kind, proc, detail)
+            ep = Episode(kind, proc, detail, pending, time.time())
+            if emitted_ts_us is not None:
+                lat = max(0.0,
+                          ep.detected_mono - float(emitted_ts_us) / 1e6)
+                ep.detail["detection_latency_s"] = round(lat, 6)
+                _hobserve(self._h["detect"]._require_default(), lat)
+            ep.note("detection", kind=kind, process=proc, **detail)
+            self._open[ep.id] = ep
+            self._h["open"]._require_default()._value = \
+                float(len(self._open))
+        # acting happens outside the lock: actions fire fault points
+        # and enqueue commands, and a chaos exc must not poison the
+        # detection path — scan() retries the journal
+        try:
+            self._advance(ep)
+        except Exception:
+            pass
+        return ep
+
+    def _plan(self, kind: str, proc: str, detail: dict) -> List[dict]:
+        """The policy table: detector family -> action journal."""
+        p = self.policy
+        burned = self._history.get((proc, kind), 0)
+        if kind == KIND_SCALE_FLOOR:
+            if burned + 1 >= p.scale_floor_max:
+                return [{"action": "escalate",
+                         "reason": "repeated AMP loss-scale floor "
+                                   f"({burned + 1} episodes, policy "
+                                   f"max {p.scale_floor_max})"}]
+            return [{"action": "rollback_resume",
+                     "policy": "reraise_scale"}]
+        if kind == KIND_NAN:
+            if burned + 1 > p.max_rollbacks:
+                return [{"action": "escalate",
+                         "reason": "divergence recurred past the "
+                                   f"rollback budget ({burned} "
+                                   "rollbacks already spent, policy "
+                                   f"max {p.max_rollbacks})"}]
+            return [{"action": "rollback_resume",
+                     "policy": p.nan_policy}]
+        # dead rank / sustained straggler: same elastic path
+        return [{"action": "evict_rank"},
+                {"action": "elastic_restart"}]
+
+    # -- detection: periodic scan --
+    def scan(self, now: Optional[float] = None) -> dict:
+        """One watch pass: heartbeat staleness + sustained-straggler
+        detection, then drain every open episode's pending-action
+        journal (retrying actions a previous pass crashed inside).
+        Returns a status summary. Never raises on action failure —
+        failures are counted and retried next pass."""
+        now = time.time() if now is None else now
+        p = self.policy
+        if self.agg is not None:
+            try:
+                controller = self._controller()
+            except RuntimeError:
+                controller = None
+            health = self.agg.health(now)
+            for proc, st in health.items():
+                # the controller runs the step loop the supervisor
+                # commands — it cannot be evicted (a dead controller
+                # has no one left to restart the fleet; that is the
+                # operator's page, not an autopilot episode)
+                if proc in self._evicted or proc == controller:
+                    continue
+                if not st["up"] and st["age_s"] >= p.heartbeat_stale_s:
+                    self._detect(KIND_DEAD_RANK, proc, {
+                        "age_s": round(st["age_s"], 3),
+                        "role": st["role"], "pid": st["pid"]})
+            flagged = set()
+            for op, proc in self.agg.stragglers().items():
+                flagged.add(proc)
+                since = self._straggler_since.setdefault(proc, now)
+                if proc in self._evicted:
+                    continue
+                if now - since >= p.straggler_sustain_s:
+                    self._detect(KIND_STRAGGLER, proc, {
+                        "op": op,
+                        "sustained_s": round(now - since, 3)})
+            for proc in list(self._straggler_since):
+                if proc not in flagged:
+                    del self._straggler_since[proc]
+        with self._lock:
+            open_eps = list(self._open.values())
+        for ep in open_eps:
+            try:
+                self._advance(ep)
+            except Exception:
+                pass    # counted in _advance; journal intact
+        with self._lock:
+            return {"open": len(self._open),
+                    "done": len(self._done),
+                    "failure": str(self.failure) if self.failure
+                    else None}
+
+    # -- acting --
+    def _advance(self, ep: Episode) -> None:
+        while True:
+            with self._lock:
+                if not ep.pending:
+                    break
+                step = ep.pending[0]
+            action = step["action"]
+            try:
+                self.act(action, ep, **{k: v for k, v in step.items()
+                                        if k != "action"})
+            except Exception:
+                from ..observability.fleet import _bump
+                _bump(self._h["action_failures"], action=action)
+                raise
+            with self._lock:
+                if ep.pending and ep.pending[0] is step:
+                    ep.pending.pop(0)
+        with self._lock:
+            if ep.state in ("detected", "acting"):
+                ep.state = "awaiting"
+
+    def act(self, action: str, ep: Episode, **detail) -> None:
+        """Commit ONE remediation action for an episode. The
+        `supervisor.act` fault point fires before anything takes
+        effect — chaos can kill the supervisor mid-remediation here
+        and the episode's journal (still holding this action) lets
+        the next scan() complete the recovery."""
+        ep.note("action_attempt", action=action, **detail)
+        ep.state = "acting"
+        faults.fault_point("supervisor.act", action=action,
+                           kind=ep.kind, process=ep.process)
+        if action == "rollback_resume":
+            pol = detail.get("policy", self.policy.nan_policy)
+            self._enqueue(ep.process, {
+                "cmd": "rollback", "episode": ep.id,
+                "policy": pol, "skip_step": ep.detail.get("step"),
+                "reraise_factor": self.policy.reraise_factor,
+                "ckpt_root": self.ckpt_root})
+        elif action == "evict_rank":
+            with self._lock:
+                self._evicted.add(ep.process)
+        elif action == "elastic_restart":
+            target = self._controller()
+            world = None
+            if self.agg is not None:
+                live = [pr for pr, st in
+                        self.agg.health(time.time()).items()
+                        if st["up"] and pr not in self._evicted]
+                world = len(live)
+            self._enqueue(target, {
+                "cmd": "restart", "episode": ep.id,
+                "evicted": ep.process, "world": world,
+                "ckpt_root": self.ckpt_root})
+        elif action == "escalate":
+            msg = (f"autopilot escalation ({ep.kind}, process "
+                   f"{ep.process}): {detail.get('reason', 'policy')}")
+            with self._lock:
+                self.failure = AutopilotFailure(
+                    msg, kind=ep.kind,
+                    episodes=self._done + [ep.snapshot()])
+            self._enqueue(self._controller(), {
+                "cmd": "stop", "episode": ep.id, "error": msg,
+                "kind": ep.kind})
+        else:
+            raise ValueError(f"unknown autopilot action {action!r}")
+        ep.note("action", action=action, **detail)
+        ep.last_action = action
+        from ..observability.fleet import _bump
+        _bump(self._h["actions"], action=action)
+        for a in ("rollback_resume", "evict_rank", "elastic_restart",
+                  "escalate"):
+            self._h["last_action"].labels(action=a)._value = \
+                1.0 if a == action else 0.0
+        if action == "escalate":
+            # nothing will report an outcome for a stopped run — the
+            # escalation closes the episode
+            self._close(ep, "escalated",
+                        {"error": str(self.failure)})
+
+    def _controller(self) -> str:
+        if self.controller is not None:
+            return self.controller
+        with self._lock:
+            if self._pollers:
+                return max(self._pollers,
+                           key=lambda pr: self._pollers[pr]["t"])
+        raise RuntimeError(
+            "autopilot has no controller process to command: no "
+            "TrainControl has polled yet and none was configured "
+            "(Supervisor(controller=...))")
+
+    def _enqueue(self, proc: str, cmd: dict) -> None:
+        with self._lock:
+            self._commands.setdefault(proc, []).append(cmd)
+
+    # -- command channel (RPC-served) --
+    def poll(self, process: str, step=None):
+        """The trainer's per-step check-in: records liveness/progress
+        and returns the next queued command (or None). The most recent
+        poller doubles as the default controller for fleet-level
+        commands."""
+        with self._lock:
+            self._pollers[process] = {"t": time.time(), "step": step}
+            q = self._commands.get(process)
+            return q.pop(0) if q else None
+
+    def report(self, process: str, episode_id: int,
+               outcome: dict) -> dict:
+        """The trainer's remediation-outcome report: closes the
+        episode, observes MTTR, dumps the flight bundle."""
+        with self._lock:
+            ep = self._open.get(int(episode_id))
+        if ep is None:
+            return {"ok": False, "unknown_episode": episode_id}
+        status = "remediated" if outcome.get("ok", True) else "failed"
+        self._close(ep, status, dict(outcome, process=process))
+        return {"ok": True, "episode": episode_id, "outcome": status}
+
+    def _close(self, ep: Episode, outcome: str, detail: dict) -> None:
+        from ..observability import flight as _fl
+        from ..observability.fleet import _bump
+        with self._lock:
+            if ep.id not in self._open:
+                return
+            mttr = time.perf_counter() - ep.detected_mono
+            ep.note("outcome", outcome=outcome,
+                    mttr_s=round(mttr, 6), **detail)
+            ep.outcome = dict(detail, outcome=outcome,
+                              mttr_s=round(mttr, 6))
+            ep.state = "done"
+            ep.done_t = time.time()
+            del self._open[ep.id]
+            self._history[(ep.process, ep.kind)] = \
+                self._history.get((ep.process, ep.kind), 0) + 1
+            snap = ep.snapshot()
+            self._done.append(snap)
+            self._h["open"]._require_default()._value = \
+                float(len(self._open))
+        _bump(self._h["episodes"], kind=ep.kind, outcome=outcome)
+        _hobserve(self._h["mttr"]._require_default(), mttr)
+        # one bundle per episode, dumped OUTSIDE the lock (flight I/O)
+        _fl.trigger("autopilot_remediation", detail={
+            "episode": ep.id, "kind": ep.kind, "process": ep.process,
+            "outcome": outcome, "mttr_s": round(mttr, 6),
+            "detection_latency_s":
+                ep.detail.get("detection_latency_s"),
+            "policy": self.policy.to_dict(),
+            "timeline": snap["timeline"]})
+
+    # -- introspection (CLI / tests) --
+    def episodes(self, done: bool = True) -> List[dict]:
+        with self._lock:
+            out = [ep.snapshot() for ep in self._open.values()]
+            if done:
+                out = self._done + out
+            return out
+
+
+# ---------------------------------------------------------------------------
+# module-level RPC targets (pickle by reference; executed in the
+# aggregator/supervisor process by the generic rpc call handler — the
+# fleet._ingest_bundle pattern)
+# ---------------------------------------------------------------------------
+_SUPERVISOR: Optional[Supervisor] = None
+
+
+def attach(sup: Supervisor) -> Supervisor:
+    """Publish `sup` as THE supervisor the RPC targets below route to
+    (one per process, like the fleet aggregator singleton)."""
+    global _SUPERVISOR
+    if _SUPERVISOR is not None and _SUPERVISOR is not sup:
+        raise RuntimeError("a supervisor is already attached in this "
+                           "process; close() it first")
+    _SUPERVISOR = sup
+    return sup
+
+
+def supervisor() -> Optional[Supervisor]:
+    return _SUPERVISOR
+
+
+def _require() -> Supervisor:
+    if _SUPERVISOR is None:
+        raise RuntimeError("no autopilot supervisor is attached in "
+                           "this process (supervisor.attach(...))")
+    return _SUPERVISOR
+
+
+def _sv_poll(process, step=None):
+    return _require().poll(process, step=step)
+
+
+def _sv_report(process, episode_id, outcome):
+    return _require().report(process, episode_id, outcome)
+
+
+# ---------------------------------------------------------------------------
+# trainer side
+# ---------------------------------------------------------------------------
+class TrainControl:
+    """The training loop's autopilot client: one `poll(step)` per step
+    asks the supervisor for a command over the hardened RPC path
+    (bounded per-call timeout + bounded-backoff retries — a wedged
+    supervisor delays a step, it cannot hang the run), and
+    `apply(...)` executes a rollback command locally. A `stop` command
+    raises the supervisor's `AutopilotFailure` in the training
+    process."""
+
+    def __init__(self, endpoint, process: str, timeout_s: float = 5.0,
+                 retries: int = 2, backoff_s: float = 0.05):
+        self.endpoint = endpoint
+        self.process = str(process)
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+
+    def _call(self, fn, *args):
+        from ..distributed import rpc as _r
+        return _r.call_endpoint(self.endpoint, fn, args=args,
+                                timeout=self.timeout_s,
+                                retries=self.retries,
+                                backoff_s=self.backoff_s)
+
+    def poll(self, step=None) -> Optional[dict]:
+        cmd = self._call(_sv_poll, self.process, step)
+        if cmd and cmd.get("cmd") == "stop":
+            raise AutopilotFailure(cmd.get("error", "autopilot stop"),
+                                   kind=cmd.get("kind"))
+        return cmd
+
+    def report(self, episode_id: int, **outcome) -> dict:
+        return self._call(_sv_report, self.process, episode_id,
+                          dict(outcome))
+
+    def apply(self, cmd: dict, state_dict=None, root=None,
+              scaler=None) -> dict:
+        """Execute a `rollback` command: restore the latest good
+        checkpoint into `state_dict` (in place) and apply the policy
+        remediation. Returns the outcome dict to `report(...)` —
+        `resumed_step` is the restored step (from resume_latest's
+        RestoredCheckpoint), `skip_step` echoes the batch the policy
+        says to replay past without training. `restart` commands are
+        returned unchanged for the caller's mesh rebuild (too
+        app-specific to automate here)."""
+        if cmd.get("cmd") != "rollback":
+            return cmd
+        from ..distributed import checkpoint as _ckpt
+        root = root if root is not None else cmd.get("ckpt_root")
+        res = _ckpt.resume_latest(state_dict, root)
+        if res is None:
+            raise AutopilotFailure(
+                f"rollback commanded but no usable checkpoint under "
+                f"{root!r}", kind="nan")
+        out = {"action": "rollback_resume", "ok": True,
+               "policy": cmd.get("policy"),
+               "resumed_step": res.step, "resumed_from": str(res),
+               "skip_step": cmd.get("skip_step")}
+        if cmd.get("policy") == "reraise_scale" and scaler is not None:
+            new_scale = float(scaler.get_loss_scaling()) \
+                * float(cmd.get("reraise_factor") or 16.0)
+            scaler.set_loss_scaling(new_scale)
+            out["loss_scale"] = new_scale
+        return out

@@ -338,7 +338,8 @@ class TestRecompile:
 # ---------------------------------------------------------------------------
 _README = ("paddle_tpu_good_total paddle_tpu_lat_seconds engine.step "
            "request.prefill engine.decode.seq stats documented: "
-           "decode_tokens prefills")
+           "decode_tokens prefills autopilot actions: rollback_resume "
+           "evict_rank elastic_restart escalate")
 
 
 class TestObsDiscipline:
@@ -403,6 +404,36 @@ class TestObsDiscipline:
         """, rules={"stats-key-naming"}, readme=_README)
         assert rule_ids(fs) == ["stats-key-naming"]
         assert "mystery_key" in fs[0].message
+
+    def test_bad_autopilot_action_undocumented(self):
+        fs = analyze("""
+            def _plan(self):
+                return [{"action": "reboot_datacenter"}]
+        """, rules={"autopilot-action-documented"},
+            readme=_README,
+            path="paddle_tpu/resilience/supervisor.py")
+        assert rule_ids(fs) == ["autopilot-action-documented"]
+        assert "reboot_datacenter" in fs[0].message
+
+    def test_good_autopilot_actions(self):
+        fs = analyze("""
+            def _plan(self):
+                return [{"action": "rollback_resume"},
+                        {"action": "evict_rank"}]
+
+            def go(self, ep):
+                self.act("escalate", ep)
+        """, rules={"autopilot-action-documented"},
+            readme=_README,
+            path="paddle_tpu/resilience/supervisor.py")
+        assert fs == []
+
+    def test_autopilot_rule_scoped_to_resilience(self):
+        fs = analyze("""
+            PLAN = [{"action": "reboot_datacenter"}]
+        """, rules={"autopilot-action-documented"},
+            readme=_README, path="paddle_tpu/engine/thing.py")
+        assert fs == []
 
     def test_good_stats_keys(self):
         fs = analyze("""

@@ -106,7 +106,10 @@ class TestTraceContext:
                     "ts": 1.0, "dur": 2.0, "trace_id": "aa",
                     "span_id": "bb"}]
         tracing.ingest(foreign)
-        assert tracing.events() == foreign
+        # Merged events are tagged so a co-resident FleetAgent never
+        # re-ships them; the caller's dicts are left untouched.
+        assert tracing.events() == [dict(foreign[0], ingested=True)]
+        assert "ingested" not in foreign[0]
 
 
 # ---------------------------------------------------------------------------

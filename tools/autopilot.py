@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Training autopilot CLI: serve a fleet aggregator with an attached
+self-healing supervisor (see README "Training autopilot").
+
+    python tools/autopilot.py --ckpt-root runs/ckpts \\
+        [--bind 127.0.0.1] [--port 0] [--flight-dir runs/flight] \\
+        [--interval 1.0] [--nan-policy skip_batch|reraise_scale] \\
+        [--stale-after 10] [--straggler-sustain 5] \\
+        [--scale-floor-max 2] [--controller NAME] [--once]
+
+Prints the serving endpoint (trainers point their FleetAgent AND
+TrainControl at it), then runs the watch loop: each interval scans for
+dead ranks / sustained stragglers, drains remediation journals, and
+prints every episode as it closes. Exits non-zero with the named
+AutopilotFailure when the supervisor escalates. `--once` performs a
+single scan and prints status JSON (smoke/automation)."""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ckpt-root", required=True,
+                    help="checkpoint directory rollbacks restore from")
+    ap.add_argument("--bind", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--flight-dir", default=None,
+                    help="arm the flight recorder here so every "
+                         "episode dumps its autopilot_remediation "
+                         "bundle")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--nan-policy", default="skip_batch",
+                    choices=("skip_batch", "reraise_scale"))
+    ap.add_argument("--stale-after", type=float, default=10.0)
+    ap.add_argument("--straggler-sustain", type=float, default=5.0)
+    ap.add_argument("--scale-floor-max", type=int, default=2)
+    ap.add_argument("--controller", default=None,
+                    help="process name fleet-level commands (restart/"
+                         "stop) go to; default: the latest poller")
+    ap.add_argument("--once", action="store_true",
+                    help="one scan, print status JSON, exit")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability import fleet, flight
+    from paddle_tpu.resilience import supervisor as sv
+
+    if args.flight_dir:
+        flight.arm(args.flight_dir, capture_faults=False,
+                   min_interval_s=0.0)
+    agg = fleet.serve_aggregator(
+        bind=args.bind, port=args.port,
+        stale_after_s=args.stale_after)
+    pol = sv.Policy(nan_policy=args.nan_policy,
+                    heartbeat_stale_s=args.stale_after,
+                    straggler_sustain_s=args.straggler_sustain,
+                    scale_floor_max=args.scale_floor_max)
+    sup = sv.attach(sv.Supervisor(
+        agg, ckpt_root=args.ckpt_root, policy=pol,
+        controller=args.controller))
+    print(f"autopilot serving at {agg.endpoint} "
+          f"(ckpt_root={args.ckpt_root})", flush=True)
+
+    if args.once:
+        status = sup.scan()
+        print(json.dumps({"endpoint": agg.endpoint, **status}))
+        sup.close()
+        agg.close()
+        return 0
+
+    seen = 0
+    try:
+        while True:
+            sup.scan()
+            done = sup.episodes(done=True)
+            closed = [e for e in done if e["state"] == "done"]
+            for ep in closed[seen:]:
+                out = ep.get("outcome") or {}
+                print(f"episode {ep['id']} [{ep['kind']}] "
+                      f"process={ep['process']} -> "
+                      f"{out.get('outcome', '?')} "
+                      f"mttr={out.get('mttr_s', '?')}s", flush=True)
+            seen = len(closed)
+            if sup.failure is not None:
+                print(f"AutopilotFailure: {sup.failure}",
+                      file=sys.stderr, flush=True)
+                return 2
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        sup.close()
+        agg.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -354,3 +354,44 @@ class StatsKeyNaming(Rule):
                     mod, line,
                     f"engine.stats key '{key}' is not documented in "
                     "the README engine.stats table")
+
+
+@register
+class AutopilotActionDocumented(Rule):
+    id = "autopilot-action-documented"
+    family = "obs"
+    severity = "error"
+    invariant = ("every remediation action the autopilot supervisor "
+                 "can commit — literal action names in act(\"...\") "
+                 "calls and {\"action\": \"...\"} journal entries "
+                 "under paddle_tpu/resilience/ — appears verbatim in "
+                 "the README Training-autopilot policy table")
+    history = ("ISSUE 16: remediation actions are what an operator "
+               "sees in episode timelines, autopilot_remediation "
+               "bundles and the paddle_tpu_autopilot_actions_total "
+               "series; an action name the README policy table does "
+               "not carry is a remediation nobody can audit")
+
+    def check(self, mod):
+        if not mod.path.startswith("paddle_tpu/resilience/"):
+            return
+        seen: Dict[str, int] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                d = U.dotted(node.func) or ""
+                if d.split(".")[-1] == "act" and node.args:
+                    name = _literal_str(node.args[0])
+                    if name is not None and name not in seen:
+                        seen[name] = node.lineno
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if _literal_str(k) == "action":
+                        name = _literal_str(v)
+                        if name is not None and name not in seen:
+                            seen[name] = v.lineno
+        for name, line in sorted(seen.items(), key=lambda kv: kv[1]):
+            if _readme_missing(name, mod.project.readme):
+                yield self.finding(
+                    mod, line,
+                    f"autopilot action '{name}' is not documented in "
+                    "the README Training-autopilot policy table")

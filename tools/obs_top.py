@@ -447,6 +447,49 @@ def render(doc, prev=None, dt=None) -> str:
         if hbm_live is not None:
             lines.append(f"  live arrays    {hbm_live / 1e6:10.2f} MB")
 
+    # autopilot: closed-loop remediation accounting (present only in
+    # an aggregator/supervisor export — see README "Training autopilot")
+    eps = _series(doc, "paddle_tpu_autopilot_episodes_total")
+    open_eps = _value(doc, "paddle_tpu_autopilot_open_episodes")
+    if eps or open_eps:
+        lines.append("== autopilot ==")
+        if open_eps:
+            lines.append(f"  open episodes  {int(open_eps)}")
+        for s in sorted(eps, key=lambda s: (s["labels"]["kind"],
+                                            s["labels"]["outcome"])):
+            if s["value"]:
+                lines.append(f"  {s['labels']['kind']:<12} "
+                             f"{s['labels']['outcome']:<11} "
+                             f"{int(s['value']):>4}")
+        last = [s["labels"]["action"] for s in
+                _series(doc, "paddle_tpu_autopilot_last_action")
+                if s["value"]]
+        acts = {s["labels"]["action"]: int(s["value"]) for s in
+                _series(doc, "paddle_tpu_autopilot_actions_total")
+                if s["value"]}
+        if acts:
+            row = "  actions      " + "  ".join(
+                f"{a}={n}" for a, n in sorted(acts.items()))
+            if last:
+                row += f"   last={last[0]}"
+            lines.append(row)
+        fails = _counter_sum(
+            doc, "paddle_tpu_autopilot_action_failures_total")
+        if fails:
+            lines.append(f"  action failures {int(fails)} "
+                         "(journaled; retried next scan)")
+        det = _hist_quantiles(
+            doc, "paddle_tpu_autopilot_detection_latency_seconds",
+            prev=prev)
+        mttr = _hist_quantiles(
+            doc, "paddle_tpu_autopilot_mttr_seconds", prev=prev)
+        if det:
+            lines.append(f"  detection    p50={_ms(det['p50'])}  "
+                         f"p95={_ms(det['p95'])}")
+        if mttr:
+            lines.append(f"  mttr         p50={_ms(mttr['p50'])}  "
+                         f"p95={_ms(mttr['p95'])}")
+
     fl = _series(doc, "paddle_tpu_flight_bundles_total")
     if fl:
         lines.append("== flight bundles ==")
