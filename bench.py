@@ -1501,10 +1501,119 @@ def bench_autopilot(on_tpu):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_embedding(on_tpu):
+    """Terabyte-embedding subsystem bench over the 8-device mesh
+    (main() forces the CPU host-platform mesh like the comms config):
+    sharded lookup + sparse-update throughput through the real
+    unique-id all_to_all exchange, the mmap tier's hit rate under a
+    skewed id distribution, and achieved exchange bytes/s. The
+    exchange's collectives are instrumented by observability.comms, so
+    the windows also ride the perf ledger as the `comms_all_to_all`
+    family (baselined by tools/perf_ledger.py --check per config)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.embedding import HostEmbedding, ShardedHostEmbedding
+    from paddle_tpu.observability import metrics as _m
+
+    rng = np.random.RandomState(0)
+    n_rows, dim = (1 << 22, 128) if on_tpu else (1 << 18, 64)
+    G, per, steps = 8, (1024 if on_tpu else 512), (30 if on_tpu else 12)
+    emb = ShardedHostEmbedding(n_rows, dim, seed=0,
+                               optimizer="adagrad")
+    ids = rng.randint(0, n_rows, size=(steps, G, per))
+    # warm (compile the gather executables + first-touch init)
+    out = emb(ids[0])
+    pt.ops.sum(out * out).backward()
+    emb.apply_updates()
+
+    def _ctr(name, **labels):
+        m = _m.registry().get(name)
+        if m is None:
+            return 0.0
+        try:
+            return m.labels(**labels).value if labels else m.value
+        except ValueError:
+            return 0.0
+
+    x0 = sum(_ctr("paddle_tpu_embedding_exchange_bytes_total",
+                  payload=p) for p in ("ids", "rows", "grads"))
+    t0 = time.perf_counter()
+    rows = 0
+    for s in range(1, steps):
+        out = emb(ids[s])
+        loss = pt.ops.sum(out * out)
+        loss.backward()
+        emb.apply_updates()
+        rows += emb.stats["device_bytes_last"] // (
+            dim * np.dtype("float32").itemsize)
+    wall = time.perf_counter() - t0
+    x1 = sum(_ctr("paddle_tpu_embedding_exchange_bytes_total",
+                  payload=p) for p in ("ids", "rows", "grads"))
+    lookup_rps = rows / wall if wall > 0 else 0.0
+    xbps = (x1 - x0) / wall if wall > 0 else 0.0
+
+    # mmap tier hit rate under a skewed (80/20) id distribution
+    tier_dir = tempfile.mkdtemp(prefix="bench_emb_")
+    try:
+        hm = HostEmbedding(n_rows, dim, seed=0,
+                           mmap_path=os.path.join(tier_dir, "t.bin"),
+                           hot_rows=n_rows // 32, rows_per_page=64)
+        hot_pool = rng.randint(0, n_rows // 64, size=(4096,))
+        # steady state first: materialize every row (lazy-init writes
+        # promote pages, which would count first-touch reads as hot),
+        # then fault the hot pool's pages resident before measuring
+        for lo in range(0, n_rows, 1 << 14):
+            hm.read_rows(np.arange(lo, min(lo + (1 << 14), n_rows)))
+        hm(np.arange(0, n_rows // 64, 64))
+        h0 = _ctr("paddle_tpu_embedding_tier_rows_total", tier="hot")
+        c0 = _ctr("paddle_tpu_embedding_tier_rows_total", tier="cold")
+        # 95/5 skew: the hot pool's pages fit the LRU capacity with
+        # room for the uniform tail's transient promotions (a working
+        # set larger than the LRU degenerates to sequential-scan
+        # thrash — real, but not the steady state being priced here)
+        for _ in range(8 if on_tpu else 4):
+            skew = np.where(rng.rand(per) < 0.95,
+                            hot_pool[rng.randint(0, 4096, size=per)],
+                            rng.randint(0, n_rows, size=per))
+            hm(skew)
+        h1 = _ctr("paddle_tpu_embedding_tier_rows_total", tier="hot")
+        c1 = _ctr("paddle_tpu_embedding_tier_rows_total", tier="cold")
+        served = (h1 - h0) + (c1 - c0)
+        hit = (h1 - h0) / served if served else None
+        resident = hm.resident_bytes()
+        logical = hm.host_bytes()
+    finally:
+        shutil.rmtree(tier_dir, ignore_errors=True)
+
+    return {
+        "metric": "embedding_lookup_rows_per_sec",
+        "value": round(lookup_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": 1.0,     # baselined by the perf ledger
+        "extra": {
+            "exchange_bytes_per_s": round(xbps, 1),
+            "tier_hit_rate": None if hit is None else round(hit, 4),
+            "exchange_pad_last": round(
+                emb.stats["exchange_pad_last"], 4),
+            "steps": steps - 1,
+            "devices": G,
+            "rows": n_rows,
+            "dim": dim,
+            "batch_per_rank": per,
+            "mmap_resident_bytes": resident,
+            "mmap_logical_bytes": logical,
+        },
+    }
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2_small,
     "lint": bench_lint,
     "comms": bench_comms,
+    "embedding": bench_embedding,
     "gpt1p3b": bench_gpt_1p3b,
     "resnet50": bench_resnet50,
     "bert": bench_bert_base,
@@ -1839,8 +1948,9 @@ def main():
                     help=argparse.SUPPRESS)   # internal: --gate child
     args = ap.parse_args()
 
-    if args.config == "comms" and not args.all:
-        # the comms sweep wants the 8-device mesh; on a CPU box that
+    if args.config in ("comms", "embedding") and not args.all:
+        # the comms sweep and the sharded-embedding exchange want the
+        # 8-device mesh; on a CPU box that
         # means the forced host-platform device count, and it must be
         # in the env BEFORE the first backend query (jax is imported
         # below; sitecustomize may have imported the module already,
@@ -1867,15 +1977,15 @@ def main():
     from paddle_tpu import observability as obs
     names = list(CONFIGS) if args.all else [args.config]
     for name in names:
-        if name == "comms" and args.all:
-            # device topology is process-global: the comms sweep's
-            # forced 8-device mesh must not re-topology the other
-            # configs of an --all run, so it gets its own process
-            # (which appends its own ledger records)
+        if name in ("comms", "embedding") and args.all:
+            # device topology is process-global: these configs' forced
+            # 8-device mesh must not re-topology the other configs of
+            # an --all run, so each gets its own process (which
+            # appends its own ledger records)
             import subprocess
             import sys
             cmd = [sys.executable, os.path.abspath(__file__),
-                   "--config", "comms", "--ledger", args.ledger]
+                   "--config", name, "--ledger", args.ledger]
             if args.no_obs:
                 cmd.append("--no-obs")
             if args.no_ledger:
@@ -1886,9 +1996,12 @@ def main():
                 print(line, flush=True)
             else:
                 print(json.dumps({
-                    "metric": "comms_bytes_per_sec", "value": None,
-                    "unit": "bytes/s", "vs_baseline": 0.0,
-                    "extra": {"error": "comms child failed",
+                    "metric": ("comms_bytes_per_sec" if name == "comms"
+                               else "embedding_lookup_rows_per_sec"),
+                    "value": None,
+                    "unit": "bytes/s" if name == "comms" else "rows/s",
+                    "vs_baseline": 0.0,
+                    "extra": {"error": f"{name} child failed",
                               "rc": child.returncode,
                               "stderr": child.stderr[-500:]}}),
                     flush=True)

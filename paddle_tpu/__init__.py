@@ -72,7 +72,7 @@ def __getattr__(name):
         globals()["sparse"] = mod
         return mod
     if name in ("fft", "signal", "quantization", "geometric", "audio", "text",
-                "resilience", "observability"):
+                "resilience", "observability", "embedding"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

@@ -1,270 +1,20 @@
-"""Parameter-server capability, TPU-native rendering (partial — see
-scope note).
+"""Parameter-server capability — backward-compatible re-export shim.
 
-What the reference's PS subsystem fundamentally provides for recsys
-training (ref: python/paddle/distributed/ps/, fleet.init(role); the
-C++ table service under paddle/fluid/distributed/ps/) is ONE core
-capability: embedding tables too large for a single device, looked up
-and updated by all workers. On TPU that capability does not need an
-external service process: the table lives SHARDED across the mesh
-(rows split over devices via GSPMD), lookups are sharded gathers (XLA
-inserts the collectives), and updates flow through the normal tape —
-the optimizer update runs sharded too, so per-device memory holds
-1/world of the table and its optimizer state.
+The implementation moved to `paddle_tpu.embedding` (the terabyte-scale
+embedding subsystem: device tier in embedding/device.py, host tier in
+embedding/host.py, process-sharded + mmap tiers alongside). This
+module keeps the historical import path
+`paddle_tpu.distributed.ps.{ShardedEmbedding,HostEmbedding}` working.
 
 Scope note (README "Unsupported surface"): the asynchronous push/pull
 training mode, heterogeneous CPU parameter hosts, and the brpc table
 service are NOT reproduced — they are artifacts of GPU clusters with
-small device memory and slow interconnects. `ShardedEmbedding` +
-`fleet.distributed_optimizer` is the TPU path to the same model scale.
+small device memory and slow interconnects. The embedding package's
+scale ladder is the TPU path to the same model scale.
 """
 from __future__ import annotations
 
-import threading
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from ..core.tensor import Tensor
-from ..nn.layer import Layer
-from ..nn.layers.common import Embedding
+from ..embedding.device import ShardedEmbedding
+from ..embedding.host import HostEmbedding
 
 __all__ = ["ShardedEmbedding", "HostEmbedding"]
-
-
-def _default_mesh(axis):
-    from .auto_parallel.api import ProcessMesh
-    import numpy as np
-    devs = jax.devices()
-    return ProcessMesh(np.arange(len(devs)), dim_names=[axis])
-
-
-class ShardedEmbedding(Embedding):
-    """Row-sharded embedding table over a device mesh.
-
-    weight: [num_embeddings, embedding_dim] with rows split over
-    `axis` (NamedSharding P(axis, None)) — each device stores
-    rows/world and 1/world of the optimizer state. forward(ids) is a
-    sharded gather: XLA partitions it so each device serves the ids
-    that hit its shard and the results combine over ICI. Gradients are
-    dense per-step activations of the gather; the weight grad stays
-    sharded, so the update never materializes the full table anywhere.
-
-    ref capability: distributed/ps distributed_lookup_table /
-    fleet SparseEmbedding (python/paddle/distributed/ps/the_one_ps.py);
-    design: GSPMD substitution, not a table service.
-    """
-
-    def __init__(self, num_embeddings, embedding_dim, mesh=None,
-                 axis=None, weight_attr=None, padding_idx=None,
-                 name=None):
-        super().__init__(num_embeddings, embedding_dim,
-                         padding_idx=padding_idx,
-                         weight_attr=weight_attr)
-        if mesh is None:
-            mesh = _default_mesh(axis or "dp")
-        if axis is None:
-            axis = mesh.dim_names[0]
-        jmesh = mesh._jax_mesh if hasattr(mesh, "_jax_mesh") else mesh
-        self._sharding = NamedSharding(jmesh, P(axis, None))
-        n_dev = 1
-        for ax in (axis if isinstance(axis, (list, tuple)) else [axis]):
-            n_dev *= jmesh.shape[ax]
-        if num_embeddings % n_dev:
-            raise ValueError(
-                f"num_embeddings ({num_embeddings}) must be divisible "
-                f"by the {axis!r} mesh axis size ({n_dev}) for row "
-                "sharding")
-        self._shard_devices = n_dev
-        # commit the storage: from here on every update stays sharded
-        self.weight._data = jax.device_put(self.weight._data,
-                                           self._sharding)
-
-    def shard_info(self):
-        """(rows_per_device, bytes_per_device) — the PS 'table shard'
-        accounting surface. Counts only the SHARDED axis: on a 2-D
-        mesh the table is replicated over the other axes."""
-        rows = self.num_embeddings // self._shard_devices
-        itemsize = jnp.dtype(self.weight._data.dtype).itemsize
-        return rows, rows * self.embedding_dim * itemsize
-
-
-class HostEmbedding(Layer):
-    """Embedding table BACKED BY HOST RAM — beyond-aggregate-HBM scale
-    (VERDICT r4 next-5).
-
-    Capability match for the reference's MemorySparseTable /
-    SSDSparseTable (ref: paddle/fluid/distributed/ps/table/
-    memory_sparse_table.h, ssd_sparse_table.h; the "100B features"
-    claim at README.md:47-49): tables that do not fit device memory
-    live on the parameter host, and each step only moves the rows it
-    touches. TPU-native rendering — no brpc service:
-
-      * the table is a host numpy array (lazily materialised pages:
-        np.zeros is virtual until a row is first touched, so a 100 GB
-        table costs only the rows the data distribution actually hits);
-      * forward(ids) host-gathers the batch's UNIQUE rows into a
-        compact [n_unique, dim] block, ships it H2D, and indexes it on
-        device — device memory per step is O(unique rows), never O(table);
-      * `prefetch(next_ids)` starts the gather+H2D for the NEXT batch
-        on a worker thread while the current step computes
-        (double-buffering; jax device transfers are async);
-      * backward accumulates duplicate-id grads into the compact block
-        (ordinary gather vjp); `apply_updates()` brings the sparse grad
-        D2H and applies the table optimizer (sgd / adagrad — the
-        reference sparse-table optimizers) host-side, touching only the
-        same rows.
-
-    The table deliberately does NOT appear in parameters(): like the
-    reference's sparse tables it has its own optimizer config, outside
-    the dense optimizer's state (the_one_ps.py sparse-table accessor
-    configs)."""
-
-    def __init__(self, num_embeddings, embedding_dim, dtype="float32",
-                 optimizer="adagrad", learning_rate=0.05,
-                 adagrad_epsilon=1e-6, init_std=0.01, seed=0):
-        super().__init__()
-        if optimizer not in ("sgd", "adagrad"):
-            raise ValueError(
-                f"HostEmbedding optimizer must be 'sgd' or 'adagrad'; "
-                f"got {optimizer!r}")
-        self.num_embeddings = int(num_embeddings)
-        self.embedding_dim = int(embedding_dim)
-        self._np_dtype = np.dtype(dtype)
-        self.table = np.zeros((num_embeddings, embedding_dim),
-                              self._np_dtype)       # virtual until touched
-        self._init_mask = np.zeros((num_embeddings,), bool)
-        self.optimizer = optimizer
-        self.learning_rate = float(learning_rate)
-        self.adagrad_epsilon = float(adagrad_epsilon)
-        self._acc = (np.zeros((num_embeddings, embedding_dim), np.float32)
-                     if optimizer == "adagrad" else None)
-        self.init_std = float(init_std)
-        self.seed = int(seed)
-        self._inflight = None       # (key, thread, result holder)
-        self._last = None           # (unique, compact Tensor) of last fwd
-        # guards table/_init_mask/_acc against the prefetch worker
-        self._table_lock = threading.Lock()
-        self.stats = {"steps": 0, "rows_touched": 0, "prefetch_hits": 0,
-                      "prefetch_stale": 0, "device_bytes_last": 0}
-
-    # -- lazy deterministic init: row r is N(0, init_std) from a
-    # per-row stream, independent of WHEN it is first touched --
-    def _ensure_init(self, rows: np.ndarray) -> None:
-        if self.init_std == 0.0:
-            return
-        fresh = rows[~self._init_mask[rows]]
-        for r in fresh:
-            rng = np.random.RandomState(
-                (self.seed * 0x9E3779B1 + int(r)) & 0x7FFFFFFF)
-            self.table[r] = rng.standard_normal(
-                self.embedding_dim).astype(self._np_dtype) * self.init_std
-        self._init_mask[fresh] = True
-
-    @staticmethod
-    def _key(ids: np.ndarray):
-        return (ids.shape, ids.tobytes())
-
-    def _gather_rows(self, ids: np.ndarray):
-        unique, inv = np.unique(ids.reshape(-1), return_inverse=True)
-        if unique.size and (unique[0] < 0
-                            or unique[-1] >= self.num_embeddings):
-            raise IndexError(
-                f"HostEmbedding ids out of range [0, "
-                f"{self.num_embeddings})")
-        with self._table_lock:
-            self._ensure_init(unique)
-            compact = self.table[unique]        # host gather (copies)
-        return unique, inv, jax.device_put(compact)   # async H2D
-
-    def prefetch(self, ids) -> None:
-        """Start the host gather + H2D for a FUTURE forward(ids) on a
-        worker thread; overlaps with whatever the device is running.
-
-        Ordering contract: prefetch AFTER apply_updates() for the step
-        whose grads touch shared rows — apply_updates invalidates any
-        in-flight prefetch (it may have gathered pre-update rows), so a
-        too-early prefetch costs its overlap, never staleness."""
-        ids = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
-                         np.int64)
-        key = self._key(ids)
-        holder = {}
-
-        def work():
-            try:
-                holder["res"] = self._gather_rows(ids)
-            except BaseException as e:
-                holder["err"] = e
-
-        t = threading.Thread(target=work, daemon=True)
-        t.start()
-        self._inflight = (key, t, holder)
-
-    def forward(self, ids):
-        ids_np = np.asarray(
-            ids.numpy() if isinstance(ids, Tensor) else ids, np.int64)
-        key = self._key(ids_np)
-        hit = None
-        if self._inflight is not None:
-            ikey, t, holder = self._inflight
-            self._inflight = None       # consumed OR discarded: one shot
-            if ikey == key:
-                t.join()
-                if "err" in holder:
-                    raise holder["err"]
-                hit = holder["res"]
-            else:
-                self.stats["prefetch_stale"] += 1
-        if hit is not None:
-            unique, inv, dev = hit
-            self.stats["prefetch_hits"] += 1
-        else:
-            unique, inv, dev = self._gather_rows(ids_np)
-        compact = Tensor._wrap(dev, stop_gradient=False)
-        from .. import ops
-        out = ops.gather(compact, Tensor._wrap(jnp.asarray(inv)))
-        out = ops.reshape(out, tuple(ids_np.shape)
-                          + (self.embedding_dim,))
-        self._last = (unique, compact)
-        self.stats["rows_touched"] += int(unique.size)
-        self.stats["device_bytes_last"] = int(
-            unique.size * self.embedding_dim * self._np_dtype.itemsize)
-        return out
-
-    def apply_updates(self) -> None:
-        """Flow the last backward's sparse grad back into the host
-        table (the PS push; ref: sparse-table accessor update)."""
-        if self._last is None:
-            return
-        unique, compact = self._last
-        g = compact.grad
-        if g is None:
-            self._last = None
-            return
-        grad = np.asarray(g._data if isinstance(g, Tensor) else g,
-                          np.float32)
-        lr = self.learning_rate
-        with self._table_lock:
-            if self.optimizer == "sgd":
-                self.table[unique] -= (lr * grad).astype(self._np_dtype)
-            else:
-                acc = self._acc[unique] + grad * grad
-                self._acc[unique] = acc
-                self.table[unique] -= (
-                    lr * grad / (np.sqrt(acc) + self.adagrad_epsilon)
-                ).astype(self._np_dtype)
-        # an in-flight prefetch may hold PRE-update rows: drop it so the
-        # matching forward refetches fresh values (see prefetch contract)
-        self._inflight = None
-        self.stats["steps"] += 1
-        self._last = None
-
-    def host_bytes(self) -> int:
-        """Logical table bytes (virtual pages count fully)."""
-        n = self.table.nbytes
-        if self._acc is not None:
-            n += self._acc.nbytes
-        return n

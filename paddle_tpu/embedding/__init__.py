@@ -1,0 +1,33 @@
+"""Terabyte-scale embedding subsystem (ROADMAP item 5).
+
+The reference's headline recommender capability — "100B features" via
+MemorySparseTable / SSDSparseTable (ref: paddle/fluid/distributed/ps/
+table/) — as a TPU-native scale ladder, each rung a drop-in Layer:
+
+  1. `ShardedEmbedding` — table fits aggregate device HBM: rows
+     GSPMD-sharded over the mesh (device.py).
+  2. `HostEmbedding` — table fits host RAM: host-resident rows, each
+     step ships only the batch's unique rows H2D (host.py).
+  3. `HostEmbedding(mmap_path=...)` — table exceeds host RAM: hot LRU
+     of row pages over a sparse mmap backing file, honest three-way
+     byte accounting (store.py).
+  4. `ShardedHostEmbedding` — table exceeds one process: rows
+     hash-sharded over the launch group, per-step unique-id all_to_all
+     exchange over the instrumented collectives, sparse grads applied
+     on the owners only (sharded.py), with crash-safe per-shard
+     checkpoints that reshard across process-count changes
+     (checkpoint.py).
+
+`paddle_tpu.distributed.ps` re-exports ShardedEmbedding/HostEmbedding
+for backward compatibility; new code should import from here."""
+from .device import ShardedEmbedding
+from .host import HostEmbedding
+from .sharded import ShardedHostEmbedding
+from .store import MmapRowStore, RamRowStore, row_init
+from .checkpoint import resume_latest_shards, save_shards
+
+__all__ = [
+    "ShardedEmbedding", "HostEmbedding", "ShardedHostEmbedding",
+    "RamRowStore", "MmapRowStore", "row_init",
+    "save_shards", "resume_latest_shards",
+]

@@ -1,0 +1,152 @@
+"""Crash-safe per-shard checkpoints for sharded host embeddings.
+
+Reuses the atomic checkpoint machinery wholesale
+(`distributed.checkpoint`: tmp dir + fsync + rename, sha256 manifest,
+torn-dir detection) — each shard saves independently into
+
+    root/step_<n>/shard_<k>_of_<S>/
+
+so a crash mid-step can tear at most the step being written; resume
+scans newest-first and only trusts a step whose FULL shard set
+verifies clean, falling back to the previous step otherwise (the same
+contract `resume_latest` gives dense checkpoints, lifted to shard
+sets).
+
+The payload is sparse and exact: only MATERIALIZED rows (lazily
+initialized or ever updated) are saved, as (global id, value
+[, adagrad accumulator]) triples. Because rows are keyed by GLOBAL id,
+`resume_latest_shards` reshards on load — a table saved by S processes
+restores onto S' processes by scattering each row to `gid % S'` — and
+restored values are bit-exact (verified by
+tests/test_embedding_sharded.py round-trip and kill-and-resume
+tests). Untouched rows are NOT saved; after restore they lazily
+re-initialize to the same deterministic values as before (global-id
+keyed init), so the sparse payload loses nothing.
+
+Spans: `embedding.shard_save` / `embedding.shard_restore` wrap the
+whole shard-set operation (the per-shard `checkpoint.save` /
+`checkpoint.restore` spans nest inside)."""
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+from ..distributed import checkpoint as _dckpt
+from ..observability import tracing as _ot
+
+__all__ = ["save_shards", "resume_latest_shards"]
+
+_SHARD_RE = re.compile(r"^shard_(\d+)_of_(\d+)$")
+
+
+def _shard_dir(step_dir: str, k: int, S: int) -> str:
+    return os.path.join(step_dir, f"shard_{k:05d}_of_{S:05d}")
+
+
+def save_shards(emb, root: str, step: int) -> str:
+    """Checkpoint every shard of a `ShardedHostEmbedding` under
+    `root/step_<step>/` (one atomic directory per shard; a bare
+    `HostEmbedding` saves as the S=1 degenerate case). Returns the
+    step directory path."""
+    shards = getattr(emb, "shards", None) or [emb]
+    S = len(shards)
+    step_dir = os.path.join(root, f"step_{int(step)}")
+    with _ot.span("embedding.shard_save", path=step_dir, shards=S):
+        for k, sh in enumerate(shards):
+            with sh._table_lock:
+                local = np.flatnonzero(sh._init_mask)
+                values = sh._store.read(local)
+                acc = sh._acc_store.read(local) \
+                    if sh._acc_store is not None else None
+            gids = local * sh.init_id_scale + sh.init_id_offset
+            state = {
+                "rows": gids.astype(np.int64),
+                "values": values,
+                # shard identity rides in-band so restore can reshard
+                # without trusting directory names
+                "shard_meta": np.asarray(
+                    [k, S, emb.num_embeddings, emb.embedding_dim],
+                    np.int64),
+            }
+            if acc is not None:
+                state["acc"] = acc
+            _dckpt.save_state_dict(state, _shard_dir(step_dir, k, S))
+    return step_dir
+
+
+def _step_candidates(root: str):
+    """[(step, step_dir)] newest first."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        p = os.path.join(root, name)
+        if name.startswith("step_") and os.path.isdir(p):
+            try:
+                out.append((int(name[len("step_"):]), p))
+            except ValueError:
+                continue
+    return sorted(out, reverse=True)
+
+
+def _shard_set(step_dir: str):
+    """The complete, clean shard set of a step dir, or None if the
+    step is torn (missing shards, mixed S, or a shard that fails
+    manifest verification)."""
+    found = {}
+    S_saved = None
+    for name in os.listdir(step_dir):
+        m = _SHARD_RE.match(name)
+        if not m:
+            continue
+        k, S = int(m.group(1)), int(m.group(2))
+        if S_saved is None:
+            S_saved = S
+        elif S != S_saved:
+            return None                     # mixed shard counts: torn
+        found[k] = os.path.join(step_dir, name)
+    if S_saved is None or sorted(found) != list(range(S_saved)):
+        return None                         # incomplete shard set
+    for p in found.values():
+        if not _dckpt.is_complete(p) or _dckpt.verify_checkpoint(p):
+            return None                     # torn / corrupt shard
+    return [found[k] for k in range(S_saved)]
+
+
+def resume_latest_shards(emb, root: str) -> Optional[str]:
+    """Restore the newest step under `root` whose WHOLE shard set
+    verifies clean into `emb` (a `ShardedHostEmbedding` — or a bare
+    `HostEmbedding` via its degenerate S=1 layout), resharding when
+    the saved shard count differs from the current one. Torn steps
+    (crash mid-save) are skipped in favor of the previous complete
+    step. Returns the restored step directory, or None."""
+    for step, step_dir in _step_candidates(root):
+        shard_dirs = _shard_set(step_dir)
+        if shard_dirs is None:
+            continue
+        with _ot.span("embedding.shard_restore", path=step_dir,
+                      shards=len(shard_dirs)):
+            for p in shard_dirs:
+                names = _dckpt.get_checkpoint_files(p)
+                state = {name: 0 for name in names}
+                _dckpt.load_state_dict(state, p)
+                gids = np.asarray(state["rows"].numpy(), np.int64)
+                values = state["values"].numpy()
+                acc = state["acc"].numpy() if "acc" in state else None
+                if hasattr(emb, "load_rows"):
+                    emb.load_rows(gids, values, acc=acc)
+                else:                       # bare HostEmbedding
+                    local = (gids - emb.init_id_offset) \
+                        // emb.init_id_scale
+                    with emb._table_lock:
+                        emb._store.write(local, values)
+                        if acc is not None \
+                                and emb._acc_store is not None:
+                            emb._acc_store.write(local, acc)
+                        emb._init_mask[local] = True
+                        emb._table_version += 1
+        return step_dir
+    return None

@@ -1,0 +1,74 @@
+"""Device-row-sharded embedding (GSPMD tier) — migrated unchanged from
+`paddle_tpu.distributed.ps`, which re-exports it.
+
+This is the IN-HBM tier of the embedding scale ladder: table fits the
+aggregate device memory → `ShardedEmbedding` (rows over the mesh, XLA
+inserts the collectives). Past aggregate HBM → `HostEmbedding`; past
+host RAM / one process → `ShardedHostEmbedding` + the mmap tier (see
+the package docstring)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layers.common import Embedding
+
+__all__ = ["ShardedEmbedding"]
+
+
+def _default_mesh(axis):
+    from ..distributed.auto_parallel.api import ProcessMesh
+    import numpy as np
+    devs = jax.devices()
+    return ProcessMesh(np.arange(len(devs)), dim_names=[axis])
+
+
+class ShardedEmbedding(Embedding):
+    """Row-sharded embedding table over a device mesh.
+
+    weight: [num_embeddings, embedding_dim] with rows split over
+    `axis` (NamedSharding P(axis, None)) — each device stores
+    rows/world and 1/world of the optimizer state. forward(ids) is a
+    sharded gather: XLA partitions it so each device serves the ids
+    that hit its shard and the results combine over ICI. Gradients are
+    dense per-step activations of the gather; the weight grad stays
+    sharded, so the update never materializes the full table anywhere.
+
+    ref capability: distributed/ps distributed_lookup_table /
+    fleet SparseEmbedding (python/paddle/distributed/ps/the_one_ps.py);
+    design: GSPMD substitution, not a table service.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, mesh=None,
+                 axis=None, weight_attr=None, padding_idx=None,
+                 name=None):
+        super().__init__(num_embeddings, embedding_dim,
+                         padding_idx=padding_idx,
+                         weight_attr=weight_attr)
+        if mesh is None:
+            mesh = _default_mesh(axis or "dp")
+        if axis is None:
+            axis = mesh.dim_names[0]
+        jmesh = mesh._jax_mesh if hasattr(mesh, "_jax_mesh") else mesh
+        self._sharding = NamedSharding(jmesh, P(axis, None))
+        n_dev = 1
+        for ax in (axis if isinstance(axis, (list, tuple)) else [axis]):
+            n_dev *= jmesh.shape[ax]
+        if num_embeddings % n_dev:
+            raise ValueError(
+                f"num_embeddings ({num_embeddings}) must be divisible "
+                f"by the {axis!r} mesh axis size ({n_dev}) for row "
+                "sharding")
+        self._shard_devices = n_dev
+        # commit the storage: from here on every update stays sharded
+        self.weight._data = jax.device_put(self.weight._data,
+                                           self._sharding)
+
+    def shard_info(self):
+        """(rows_per_device, bytes_per_device) — the PS 'table shard'
+        accounting surface. Counts only the SHARDED axis: on a 2-D
+        mesh the table is replicated over the other axes."""
+        rows = self.num_embeddings // self._shard_devices
+        itemsize = jnp.dtype(self.weight._data.dtype).itemsize
+        return rows, rows * self.embedding_dim * itemsize

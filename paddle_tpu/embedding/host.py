@@ -1,0 +1,392 @@
+"""Host-RAM / mmap-backed embedding tables (migrated from
+`paddle_tpu.distributed.ps`, which re-exports this for backward
+compatibility).
+
+Capability match for the reference's MemorySparseTable /
+SSDSparseTable (ref: paddle/fluid/distributed/ps/table/
+memory_sparse_table.h, ssd_sparse_table.h; the "100B features" claim):
+tables that do not fit device memory live on the parameter host — or,
+past host RAM, in an mmap-backed disk tier — and each step only moves
+the rows it touches. TPU-native rendering, no brpc service:
+
+  * storage is a `store.RamRowStore` (all-RAM, lazily materialised
+    np.zeros pages) or `store.MmapRowStore` (hot LRU of resident row
+    pages over a sparse mmap backing file — pass `mmap_path=`);
+  * forward(ids) host-gathers the batch's UNIQUE rows into a compact
+    [n_unique, dim] block, ships it H2D, and indexes it on device —
+    device memory per step is O(unique rows), never O(table);
+  * `prefetch(next_ids)` starts the gather+H2D for the NEXT batch on a
+    worker thread while the current step computes (double-buffering);
+  * backward accumulates duplicate-id grads into the compact block
+    (ordinary gather vjp); `apply_updates()` brings the sparse grad
+    D2H and applies the table optimizer (sgd / adagrad — the reference
+    sparse-table optimizers) host-side, touching only the same rows.
+
+The table deliberately does NOT appear in parameters(): like the
+reference's sparse tables it has its own optimizer config, outside the
+dense optimizer's state (the_one_ps.py sparse-table accessor configs).
+
+Prefetch consistency is version-fenced: every gather snapshots the
+table version under the lock, `apply_updates()` bumps it, and
+`forward` refuses any prefetched block whose version predates the
+update — so a prefetch racing an update can cost its overlap but can
+NEVER serve pre-update rows, regardless of thread timing (the
+`prefetch_invalidated` stats key counts the discarded ones, and the
+orphaned worker thread is joined, not leaked).
+
+Observability (recorded only while enabled): see README
+"Terabyte-scale embeddings" — `paddle_tpu_embedding_lookup_seconds` /
+`paddle_tpu_embedding_update_seconds` histograms, rows / prefetch /
+tier counters, and the three byte-accounting gauges (logical /
+resident / disk)."""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..observability import metrics as _om
+from ..observability import tracing as _ot
+from .store import MmapRowStore, RamRowStore, apply_sparse_grad, row_init
+
+__all__ = ["HostEmbedding"]
+
+_METRICS = None
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        r = _om.registry()
+        _METRICS = {
+            "lookup": r.histogram(
+                "paddle_tpu_embedding_lookup_seconds",
+                "host gather of a batch's unique embedding rows + the "
+                "H2D dispatch of the compact block (one observation "
+                "per forward or prefetch gather)"),
+            "update": r.histogram(
+                "paddle_tpu_embedding_update_seconds",
+                "sparse optimizer apply of one step's embedding grads "
+                "into the host table (apply_updates / the sharded "
+                "owner-side apply)"),
+            "rows": r.counter(
+                "paddle_tpu_embedding_rows_total",
+                "unique embedding rows moved, by direction: lookup = "
+                "host-gathered + shipped H2D, update = written back "
+                "by the sparse optimizer", ("op",)),
+            "prefetch": r.counter(
+                "paddle_tpu_embedding_prefetch_total",
+                "prefetched gathers by outcome: hit = consumed by the "
+                "matching forward, stale = ids mismatched the next "
+                "forward, invalidated = apply_updates landed first so "
+                "the pre-update block was discarded", ("outcome",)),
+            "logical": r.gauge(
+                "paddle_tpu_embedding_logical_bytes",
+                "logical embedding table bytes (virtual / on-disk "
+                "pages count fully; includes optimizer accumulator)"),
+            "resident": r.gauge(
+                "paddle_tpu_embedding_resident_bytes",
+                "embedding bytes pinned in host RAM right now (all-RAM "
+                "tier: the whole table; mmap tier: the hot page LRU)"),
+            "disk": r.gauge(
+                "paddle_tpu_embedding_disk_bytes",
+                "bytes actually allocated by mmap backing files "
+                "(sparse holes cost nothing; 0 for the all-RAM tier)"),
+        }
+    return _METRICS
+
+
+class HostEmbedding(Layer):
+    """Embedding table backed by host RAM (default) or an mmap disk
+    tier (`mmap_path=`) — beyond-aggregate-HBM, and beyond-host-RAM,
+    scale. See the module docstring for the full contract; the
+    sharded, multi-process rendering is
+    `paddle_tpu.embedding.ShardedHostEmbedding`, which composes one of
+    these per owner shard."""
+
+    def __init__(self, num_embeddings, embedding_dim, dtype="float32",
+                 optimizer="adagrad", learning_rate=0.05,
+                 adagrad_epsilon=1e-6, init_std=0.01, seed=0,
+                 mmap_path=None, hot_rows=None, rows_per_page=None,
+                 init_id_scale=1, init_id_offset=0):
+        super().__init__()
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(
+                f"HostEmbedding optimizer must be 'sgd' or 'adagrad'; "
+                f"got {optimizer!r}")
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self._np_dtype = np.dtype(dtype)
+        self.optimizer = optimizer
+        self.learning_rate = float(learning_rate)
+        self.adagrad_epsilon = float(adagrad_epsilon)
+        self.init_std = float(init_std)
+        self.seed = int(seed)
+        # lazy-init keys on (row * scale + offset): identity for a
+        # standalone table; a process shard k of S passes (S, k) so
+        # local row r initializes as GLOBAL row r*S+k — the sharded
+        # table's values match the unsharded table's bit-for-bit
+        self.init_id_scale = int(init_id_scale)
+        self.init_id_offset = int(init_id_offset)
+        if mmap_path is None:
+            self._store = RamRowStore(num_embeddings, embedding_dim,
+                                      self._np_dtype)
+            self.table = self._store.arr        # back-compat alias
+            self._acc_store = RamRowStore(
+                num_embeddings, embedding_dim, np.float32) \
+                if optimizer == "adagrad" else None
+            self._acc = self._acc_store.arr \
+                if self._acc_store is not None else None
+        else:
+            self._store = MmapRowStore(
+                num_embeddings, embedding_dim, self._np_dtype,
+                mmap_path, hot_rows=hot_rows,
+                rows_per_page=rows_per_page)
+            self.table = None   # no full-array view in the mmap tier
+            self._acc_store = MmapRowStore(
+                num_embeddings, embedding_dim, np.float32,
+                mmap_path + ".acc", hot_rows=hot_rows,
+                rows_per_page=rows_per_page) \
+                if optimizer == "adagrad" else None
+            self._acc = None
+        # _init_mask doubles as the MATERIALIZED-rows mask: lazy init
+        # marks it, and so does every sparse update — checkpointing
+        # saves exactly these rows
+        self._init_mask = np.zeros((self.num_embeddings,), bool)
+        self._inflight = None       # (key, thread, result holder)
+        self._orphans = []          # invalidated workers, joined later
+        self._last = None           # (unique, compact Tensor) of last fwd
+        # guards table/_init_mask/_acc/version against prefetch workers
+        self._table_lock = threading.Lock()
+        self._table_version = 0
+        self.stats = {"steps": 0, "rows_touched": 0, "prefetch_hits": 0,
+                      "prefetch_stale": 0, "prefetch_invalidated": 0,
+                      "device_bytes_last": 0}
+
+    # -- lazy deterministic init: row r is N(0, init_std) from a
+    # counter-based per-row stream (store.row_init), independent of
+    # WHEN it is first touched and of which rows share its batch --
+    def _ensure_init(self, rows: np.ndarray) -> None:
+        if self.init_std == 0.0:
+            return
+        fresh = rows[~self._init_mask[rows]]
+        if fresh.size:
+            gids = fresh * self.init_id_scale + self.init_id_offset
+            self._store.write(fresh, row_init(
+                gids, self.embedding_dim, self.seed, self.init_std,
+                self._np_dtype))
+            self._init_mask[fresh] = True
+
+    @staticmethod
+    def _key(ids: np.ndarray):
+        return (ids.shape, ids.tobytes())
+
+    def _gather_rows(self, ids: np.ndarray):
+        unique, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        if unique.size and (unique[0] < 0
+                            or unique[-1] >= self.num_embeddings):
+            raise IndexError(
+                f"HostEmbedding ids out of range [0, "
+                f"{self.num_embeddings})")
+        t0 = time.perf_counter()
+        with _ot.span("embedding.lookup", rows=int(unique.size)):
+            with self._table_lock:
+                version = self._table_version
+                self._ensure_init(unique)
+                compact = self._store.read(unique)      # host gather
+            dev = jax.device_put(compact)               # async H2D
+        if _om._ENABLED:
+            _metrics()["lookup"].observe(time.perf_counter() - t0)
+            _metrics()["rows"].labels(op="lookup").inc(unique.size)
+        return unique, inv, dev, version
+
+    # -- public row API (the sharded owner-side surface) --
+    def read_rows(self, rows) -> np.ndarray:
+        """Host-side: ensure-init + gather the given LOCAL rows (a
+        copy). The sharded exchange calls this on the owner."""
+        rows = np.asarray(rows, np.int64)
+        with self._table_lock:
+            self._ensure_init(rows)
+            out = self._store.read(rows)
+        if _om._ENABLED:
+            _metrics()["rows"].labels(op="lookup").inc(rows.size)
+        return out
+
+    def apply_row_grads(self, rows, grad) -> None:
+        """Apply the table optimizer to a compact (unique-row) grad
+        block — the owner-side half of the sharded reverse path, and
+        the core of `apply_updates`. `rows` must be unique (one
+        optimizer step per row per call, the sparse-accessor
+        contract)."""
+        rows = np.asarray(rows, np.int64)
+        grad = np.asarray(grad, np.float32)
+        t0 = time.perf_counter()
+        lr, eps = self.learning_rate, self.adagrad_epsilon
+        with self._table_lock:
+            vals = self._store.read(rows)
+            acc = self._acc_store.read(rows) \
+                if self._acc_store is not None else None
+            vals, acc = apply_sparse_grad(
+                vals, acc, grad, self.optimizer, lr, eps,
+                self._np_dtype)
+            self._store.write(rows, vals)
+            if self._acc_store is not None:
+                self._acc_store.write(rows, acc)
+            self._init_mask[rows] = True    # materialized (checkpoint)
+            self._table_version += 1
+        # an in-flight prefetch may hold PRE-update rows: invalidate it
+        # (version fence) and park the worker for a later join
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            self._orphans.append(inflight)
+            self.stats["prefetch_invalidated"] += 1
+            if _om._ENABLED:
+                _metrics()["prefetch"].labels(
+                    outcome="invalidated").inc()
+        self.stats["steps"] += 1
+        if _om._ENABLED:
+            _metrics()["update"].observe(time.perf_counter() - t0)
+            _metrics()["rows"].labels(op="update").inc(rows.size)
+            self.publish_bytes()
+
+    def publish_bytes(self) -> None:
+        """Publish the three byte-accounting gauges (logical /
+        resident / disk) for this table."""
+        m = _metrics()
+        m["logical"].set(self.host_bytes())
+        m["resident"].set(self.resident_bytes())
+        m["disk"].set(self.disk_bytes())
+
+    def prefetch(self, ids) -> None:
+        """Start the host gather + H2D for a FUTURE forward(ids) on a
+        worker thread; overlaps with whatever the device is running.
+
+        Ordering contract: prefetch AFTER apply_updates() for the step
+        whose grads touch shared rows — apply_updates invalidates any
+        in-flight prefetch (it may have gathered pre-update rows), so
+        a too-early prefetch costs its overlap, never staleness. The
+        invalidation is version-fenced (see module docstring), so the
+        contract holds under arbitrary thread timing."""
+        ids = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                         np.int64)
+        key = self._key(ids)
+        holder = {}
+
+        def work():
+            try:
+                holder["res"] = self._gather_rows(ids)
+            except BaseException as e:
+                holder["err"] = e
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        self._inflight = (key, t, holder)
+
+    def _drain_orphans(self) -> None:
+        """Join invalidated prefetch workers (their gathers are short;
+        joining bounds thread count instead of leaking daemons)."""
+        orphans, self._orphans = self._orphans, []
+        for _key, t, _holder in orphans:
+            t.join()
+
+    def forward(self, ids):
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids, np.int64)
+        key = self._key(ids_np)
+        self._drain_orphans()
+        hit = None
+        if self._inflight is not None:
+            ikey, t, holder = self._inflight
+            self._inflight = None       # consumed OR discarded: one shot
+            if ikey == key:
+                t.join()
+                if "err" in holder:
+                    raise holder["err"]
+                res = holder["res"]
+                # version fence: a gather that snapshotted the table
+                # BEFORE an apply_updates that has since landed holds
+                # pre-update rows — refetch instead of serving them
+                if res[3] == self._table_version:
+                    hit = res
+                else:
+                    self.stats["prefetch_invalidated"] += 1
+                    if _om._ENABLED:
+                        _metrics()["prefetch"].labels(
+                            outcome="invalidated").inc()
+            else:
+                self.stats["prefetch_stale"] += 1
+                self._orphans.append((ikey, t, holder))
+                if _om._ENABLED:
+                    _metrics()["prefetch"].labels(outcome="stale").inc()
+        if hit is not None:
+            unique, inv, dev, _ver = hit
+            self.stats["prefetch_hits"] += 1
+            if _om._ENABLED:
+                _metrics()["prefetch"].labels(outcome="hit").inc()
+        else:
+            unique, inv, dev, _ver = self._gather_rows(ids_np)
+        compact = Tensor._wrap(dev, stop_gradient=False)
+        from .. import ops
+        out = ops.gather(compact, Tensor._wrap(jnp.asarray(inv)))
+        out = ops.reshape(out, tuple(ids_np.shape)
+                          + (self.embedding_dim,))
+        self._last = (unique, compact)
+        self.stats["rows_touched"] += int(unique.size)
+        self.stats["device_bytes_last"] = int(
+            unique.size * self.embedding_dim * self._np_dtype.itemsize)
+        return out
+
+    def apply_updates(self) -> None:
+        """Flow the last backward's sparse grad back into the host
+        table (the PS push; ref: sparse-table accessor update)."""
+        if self._last is None:
+            return
+        unique, compact = self._last
+        g = compact.grad
+        if g is None:
+            self._last = None
+            return
+        grad = np.asarray(g._data if isinstance(g, Tensor) else g,
+                          np.float32)
+        with _ot.span("embedding.update", rows=int(unique.size)):
+            self.apply_row_grads(unique, grad)
+        self._last = None
+
+    # -- byte accounting (see store module docstring) --
+    def host_bytes(self) -> int:
+        """Logical table bytes (virtual / on-disk pages count fully;
+        includes the optimizer accumulator)."""
+        n = self._store.host_bytes()
+        if self._acc_store is not None:
+            n += self._acc_store.host_bytes()
+        return n
+
+    def resident_bytes(self) -> int:
+        """Bytes pinned in host RAM right now: the whole table for the
+        all-RAM tier, the hot page LRU for the mmap tier."""
+        n = self._store.resident_bytes()
+        if self._acc_store is not None:
+            n += self._acc_store.resident_bytes()
+        return n
+
+    def disk_bytes(self) -> int:
+        """Bytes actually allocated by mmap backing files (0 for the
+        all-RAM tier; sparse holes cost nothing)."""
+        n = self._store.disk_bytes()
+        if self._acc_store is not None:
+            n += self._acc_store.disk_bytes()
+        return n
+
+    def flush(self) -> None:
+        """Persist dirty hot pages to the mmap backing files (no-op
+        for the all-RAM tier)."""
+        with self._table_lock:
+            self._store.flush()
+            if self._acc_store is not None:
+                self._acc_store.flush()

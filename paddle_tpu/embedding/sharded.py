@@ -1,0 +1,335 @@
+"""Process-row-sharded host embedding: the terabyte-table exchange.
+
+The reference scales its sparse tables past one parameter host by
+hash-partitioning rows over PS server processes, with workers doing a
+per-batch pull of the rows they touch and a push of the sparse grads
+(ref: paddle/fluid/distributed/ps/table/memory_sparse_table.h row
+shards + the brpc pull/push RPCs). TPU-native rendering, no RPC
+service: every launch process IS both a worker and a shard owner, and
+the pull/push are unique-id `all_to_all` exchanges over the existing
+collectives (`distributed/communication.py`), so the PR 14 comms plane
+prices every exchange (`paddle_tpu_collective_*` series) for free.
+
+Partition: global row g lives on shard `g % S` at local row `g // S`
+(S = group.nranks). Each shard is a full `HostEmbedding` — RAM tier or
+mmap disk tier (`mmap_dir=`) — constructed with
+`init_id_scale=S, init_id_offset=k`, so shard k lazily initializing
+local row r produces bit-for-bit the values the UNSHARDED table gives
+global row r*S+k. Sharding, tiering, and process-count changes never
+change a row's initial values.
+
+One lookup step (forward), rank-major single-controller rendering — a
+batch of ids has leading dim G, row w being worker w's batch:
+
+  1. per worker: np.unique over its ids — the wire only ever carries a
+     batch's UNIQUE rows (the compact-block invariant of
+     HostEmbedding, now also the exchange invariant);
+  2. bucket each worker's unique ids by owning shard, pad buckets to
+     the max bucket size (all_to_all is a square exchange; the pad
+     fraction is published as
+     `paddle_tpu_embedding_exchange_pad_fraction` — it IS the id-skew
+     signal), and all_to_all counts + padded ids to the owners
+     (int32 on the wire: jax downcasts int64 anyway, so the table caps
+     num_embeddings at 2**31);
+  3. owners gather their requested rows (lazy-init + tier promotion
+     happen here, on the owner only) and all_to_all the row blocks
+     back;
+  4. each worker assembles its compact [n_unique, dim] block; the
+     concatenation over workers is wrapped as ONE autograd leaf and
+     indexed on device — identical device-side shape to the unsharded
+     HostEmbedding forward.
+
+The backward takes the reverse path: the compact block's grad is
+bucketed per worker with the SAME layout (reusing the forward's
+permutations), all_to_all'd to the owners, duplicate global ids are
+summed across workers (np.add.at), and each owner applies its
+sgd/adagrad update exactly once per row per step — the same
+one-step-per-row contract as the unsharded `apply_updates`, so sharded
+and unsharded training match to float-summation order.
+
+Checkpointing is per-shard and crash-safe: see
+`paddle_tpu.embedding.checkpoint` (atomic tmp+fsync+rename dirs per
+shard, `resume_latest_shards` reshards when the process count
+changes)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..observability import metrics as _om
+from ..observability import tracing as _ot
+from .host import HostEmbedding, _metrics as _host_metrics
+
+__all__ = ["ShardedHostEmbedding"]
+
+_METRICS = None
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        r = _om.registry()
+        _METRICS = {
+            "xbytes": r.counter(
+                "paddle_tpu_embedding_exchange_bytes_total",
+                "bytes moved by the sharded embedding all_to_all "
+                "exchanges, by payload: ids = request counts + padded "
+                "unique ids, rows = gathered row blocks to the "
+                "workers, grads = sparse grads back to the owners",
+                ("payload",)),
+            "pad": r.gauge(
+                "paddle_tpu_embedding_exchange_pad_fraction",
+                "fraction of the last id-exchange payload that was "
+                "padding (buckets pad to the max worker->shard bucket "
+                "size; high values mean skewed id ownership)"),
+        }
+    return _METRICS
+
+
+def _comm():
+    # lazy: paddle_tpu.distributed imports .ps which re-exports THIS
+    # package — a module-level import here would close the cycle
+    from ..distributed import communication
+    return communication
+
+
+class ShardedHostEmbedding(Layer):
+    """Host embedding row-sharded over the launch group (see module
+    docstring). Construction, forward(ids with leading dim G =
+    group.nranks), `apply_updates()` after backward, and the byte
+    accounting trio mirror `HostEmbedding`."""
+
+    def __init__(self, num_embeddings, embedding_dim, group=None,
+                 dtype="float32", optimizer="adagrad",
+                 learning_rate=0.05, adagrad_epsilon=1e-6,
+                 init_std=0.01, seed=0, mmap_dir=None, hot_rows=None,
+                 rows_per_page=None):
+        super().__init__()
+        if int(num_embeddings) > (1 << 31):
+            raise ValueError(
+                "ShardedHostEmbedding caps num_embeddings at 2**31: "
+                "ids cross the wire as int32 (jax downcasts int64)")
+        C = _comm()
+        self.group = C._resolve_group(group)
+        self.nshards = self.group.nranks
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self._np_dtype = np.dtype(dtype)
+        self.optimizer = optimizer
+        S = self.nshards
+        if mmap_dir is not None:
+            import os
+            os.makedirs(mmap_dir, exist_ok=True)
+        self.shards = []
+        for k in range(S):
+            local = (self.num_embeddings - k + S - 1) // S
+            self.shards.append(HostEmbedding(
+                max(local, 1), embedding_dim, dtype=dtype,
+                optimizer=optimizer, learning_rate=learning_rate,
+                adagrad_epsilon=adagrad_epsilon, init_std=init_std,
+                seed=seed, init_id_scale=S, init_id_offset=k,
+                mmap_path=(None if mmap_dir is None else
+                           f"{mmap_dir}/shard_{k:05d}.bin"),
+                hot_rows=hot_rows, rows_per_page=rows_per_page))
+        self._last = None           # (compact Tensor, exchange state)
+        self.stats = {"steps": 0, "rows_touched": 0,
+                      "device_bytes_last": 0, "exchange_pad_last": 0.0}
+
+    # -- the forward exchange --
+    def forward(self, ids):
+        C = _comm()
+        G = S = self.nshards
+        dim = self.embedding_dim
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids, np.int64)
+        if ids_np.ndim < 2 or ids_np.shape[0] != G:
+            raise ValueError(
+                f"sharded embedding ids must be rank-major [G, ...] "
+                f"with G={G}; got shape {tuple(ids_np.shape)}")
+        if ids_np.size and (ids_np.min() < 0
+                            or ids_np.max() >= self.num_embeddings):
+            raise IndexError(
+                f"ShardedHostEmbedding ids out of range [0, "
+                f"{self.num_embeddings})")
+        rest = ids_np.shape[1:]
+        import time as _time
+        t0 = _time.perf_counter()
+        # 1-2. per-worker unique + owner bucketing
+        uniq, inv, order, dest_pos, counts = [], [], [], [], []
+        for w in range(G):
+            u, iv = np.unique(ids_np[w].reshape(-1),
+                              return_inverse=True)
+            owner = u % S
+            o = np.argsort(owner, kind="stable")
+            cnt = np.bincount(owner, minlength=S)
+            offs = np.concatenate(([0], np.cumsum(cnt)))
+            uniq.append(u)
+            inv.append(iv)
+            order.append(o)
+            counts.append(cnt)
+            # within-bucket slot of each owner-sorted id (bucket base
+            # filled in once cap is known)
+            dest_pos.append(np.arange(u.size) - offs[owner[o]])
+        cap = max(1, max((int(c.max()) for c in counts if c.size),
+                         default=1))
+        for w in range(G):
+            dest_pos[w] = dest_pos[w] \
+                + (uniq[w][order[w]] % S) * cap
+        Cmat = np.stack(counts).astype(np.int32)        # [G, S]
+        P = np.zeros((G, S * cap), np.int32)            # padded ids
+        for w in range(G):
+            P[w, dest_pos[w]] = uniq[w][order[w]].astype(np.int32)
+        with _ot.span("embedding.exchange", direction="lookup",
+                      cap=cap):
+            Ct = np.asarray(
+                C.all_to_all(Tensor(Cmat), group=self.group).numpy(),
+                np.int64)                               # [S, G]
+            Q = np.asarray(
+                C.all_to_all(Tensor(P), group=self.group).numpy(),
+                np.int64)                               # [S, G*cap]
+            # 3. owner-side gather (lazy init + tier promotion here)
+            R = np.zeros((S, G * cap, dim), self._np_dtype)
+            for s in range(S):
+                sel = np.concatenate([
+                    np.arange(w * cap, w * cap + Ct[s, w])
+                    for w in range(G)]) if Ct[s].sum() else \
+                    np.empty((0,), np.int64)
+                if sel.size:
+                    gids = Q[s, sel]
+                    R[s, sel] = self.shards[s].read_rows(gids // S)
+            B = C.all_to_all(
+                Tensor(R.reshape(S, G * cap * dim)),
+                group=self.group)._data.reshape(G, S * cap, dim)
+        # 4. per-worker compact block, one autograd leaf
+        posu = []
+        for w in range(G):
+            pu = np.empty(uniq[w].size, np.int64)
+            pu[order[w]] = dest_pos[w]
+            posu.append(pu)
+        compact_all = jnp.concatenate(
+            [B[w][jnp.asarray(posu[w])] for w in range(G)], axis=0) \
+            if G else B.reshape(0, dim)
+        compact_t = Tensor._wrap(compact_all, stop_gradient=False)
+        offs_u = np.concatenate(
+            ([0], np.cumsum([u.size for u in uniq])))
+        inv_all = np.concatenate(
+            [inv[w] + offs_u[w] for w in range(G)])
+        from .. import ops
+        out = ops.gather(compact_t,
+                         Tensor._wrap(jnp.asarray(inv_all)))
+        out = ops.reshape(out, (G,) + tuple(rest) + (dim,))
+        self._last = (compact_t, {
+            "order": order, "dest_pos": dest_pos, "uniq": uniq,
+            "offs_u": offs_u, "Ct": Ct, "Q": Q, "cap": cap,
+        })
+        total_u = int(offs_u[-1])
+        pad = 1.0 - (Cmat.sum() / float(G * S * cap)) \
+            if G * S * cap else 0.0
+        self.stats["rows_touched"] += total_u
+        self.stats["device_bytes_last"] = int(
+            total_u * dim * self._np_dtype.itemsize)
+        self.stats["exchange_pad_last"] = float(pad)
+        if _om._ENABLED:
+            m = _metrics()
+            m["xbytes"].labels(payload="ids").inc(
+                Cmat.nbytes + P.nbytes)
+            m["xbytes"].labels(payload="rows").inc(R.nbytes)
+            m["pad"].set(pad)
+            # the sharded lookup (exchange included) lands in the same
+            # latency histogram as the single-process gather
+            _host_metrics()["lookup"].observe(
+                _time.perf_counter() - t0)
+        return out
+
+    # -- the reverse exchange --
+    def apply_updates(self) -> None:
+        """Route the last backward's compact grad back to the owning
+        shards (reverse all_to_all) and apply each shard's optimizer —
+        one step per touched row, exactly like the unsharded table."""
+        if self._last is None:
+            return
+        compact_t, st = self._last
+        self._last = None
+        g = compact_t.grad
+        if g is None:
+            return
+        C = _comm()
+        G = S = self.nshards
+        dim = self.embedding_dim
+        cap = st["cap"]
+        grad = np.asarray(g._data if isinstance(g, Tensor) else g,
+                          np.float32)
+        Gm = np.zeros((G, S * cap, dim), np.float32)
+        for w in range(G):
+            gw = grad[st["offs_u"][w]:st["offs_u"][w + 1]]
+            Gm[w, st["dest_pos"][w]] = gw[st["order"][w]]
+        with _ot.span("embedding.exchange", direction="grads",
+                      cap=cap):
+            H = np.asarray(C.all_to_all(
+                Tensor(Gm.reshape(G, S * cap * dim)),
+                group=self.group).numpy()).reshape(S, G * cap, dim)
+        Ct, Q = st["Ct"], st["Q"]
+        for s in range(S):
+            sel = np.concatenate([
+                np.arange(w * cap, w * cap + Ct[s, w])
+                for w in range(G)]) if Ct[s].sum() else \
+                np.empty((0,), np.int64)
+            if not sel.size:
+                continue
+            gids = Q[s, sel]
+            # the same global row requested by several workers gets
+            # ONE optimizer step on the summed grad
+            u, iv = np.unique(gids, return_inverse=True)
+            acc = np.zeros((u.size, dim), np.float32)
+            np.add.at(acc, iv, H[s, sel])
+            self.shards[s].apply_row_grads(u // S, acc)
+        self.stats["steps"] += 1
+        if _om._ENABLED:
+            _metrics()["xbytes"].labels(payload="grads").inc(Gm.nbytes)
+
+    # -- checkpoint / restore surface (used by .checkpoint) --
+    def materialized_rows(self, shard: int) -> np.ndarray:
+        """GLOBAL ids of the rows shard k has materialized (lazily
+        initialized or updated) — what a shard checkpoint saves."""
+        k = int(shard)
+        local = np.flatnonzero(self.shards[k]._init_mask)
+        return local * self.nshards + k
+
+    def load_rows(self, gids, values, acc=None) -> None:
+        """Scatter restored (global id, value[, accumulator]) rows
+        into the CURRENT sharding — the resharding half of
+        `resume_latest_shards`: saved shard count need not match."""
+        gids = np.asarray(gids, np.int64)
+        values = np.asarray(values, self._np_dtype)
+        S = self.nshards
+        owner = gids % S
+        for k in range(S):
+            sel = owner == k
+            if not sel.any():
+                continue
+            sh = self.shards[k]
+            local = gids[sel] // S
+            with sh._table_lock:
+                sh._store.write(local, values[sel])
+                if acc is not None and sh._acc_store is not None:
+                    sh._acc_store.write(
+                        local, np.asarray(acc, np.float32)[sel])
+                sh._init_mask[local] = True
+                sh._table_version += 1
+
+    # -- byte accounting over all shards --
+    def host_bytes(self) -> int:
+        return sum(s.host_bytes() for s in self.shards)
+
+    def resident_bytes(self) -> int:
+        return sum(s.resident_bytes() for s in self.shards)
+
+    def disk_bytes(self) -> int:
+        return sum(s.disk_bytes() for s in self.shards)
+
+    def flush(self) -> None:
+        for s in self.shards:
+            s.flush()

@@ -1,0 +1,282 @@
+"""Tiered row storage for host embedding tables.
+
+The terabyte-scale table problem (ref: paddle/fluid/distributed/ps/
+table/ssd_sparse_table.h — MemorySparseTable keeps hot rows in RAM,
+SSDSparseTable spills cold rows to disk) splits into two orthogonal
+concerns this module owns:
+
+* **Where a row's bytes live.** `RamRowStore` is the all-RAM tier (a
+  numpy array whose np.zeros pages stay virtual until touched — the
+  original HostEmbedding storage, unchanged semantics).
+  `MmapRowStore` is the beyond-RAM tier: the full table is an
+  mmap-backed file on disk (created sparse — untouched pages cost no
+  disk blocks), and a bounded LRU of row PAGES is pinned resident in
+  RAM as the hot tier. Reads promote the containing page; writes dirty
+  the hot copy; eviction flushes dirty pages back to the backing file.
+  Byte accounting is honest and three-valued: `host_bytes()` is the
+  LOGICAL table size (virtual pages count fully — what the model
+  thinks it has), `resident_bytes()` is what the store currently PINS
+  in RAM (hot pages; the OS page cache over the mmap is reclaimable
+  and deliberately not counted), `disk_bytes()` is what the backing
+  file actually allocates (st_blocks — sparse holes cost nothing).
+
+* **What a fresh row's values are.** `row_init` is the deterministic
+  lazy initializer: row r of a (seed, dim, std) table is N(0, std)
+  from a counter-based hash stream keyed on (seed, r, column) alone —
+  independent of WHEN the row is first touched, of which rows share
+  its batch, and of which tier (RAM / mmap / process shard) it lives
+  in. Fully vectorized (splitmix64 + Box–Muller on uint64 lanes): the
+  per-fresh-row Python RandomState loop it replaces was O(n_fresh)
+  interpreter work per step. `tests/test_host_embedding.py` pins
+  batched-vs-rowwise equality of the stream.
+
+Tier telemetry (recorded only while observability is enabled):
+`paddle_tpu_embedding_tier_rows_total{tier=hot|cold}` row reads served
+from the resident hot tier vs promoted from the cold mmap tier, and
+`paddle_tpu_embedding_evictions_total` hot pages evicted (dirty pages
+flush on the way out)."""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..observability import metrics as _om
+
+__all__ = ["RamRowStore", "MmapRowStore", "row_init", "apply_sparse_grad"]
+
+_METRICS = None
+
+
+def _metrics():
+    global _METRICS
+    if _METRICS is None:
+        r = _om.registry()
+        _METRICS = {
+            "tier": r.counter(
+                "paddle_tpu_embedding_tier_rows_total",
+                "embedding rows read by storage tier: hot = served "
+                "from the RAM-resident page cache, cold = promoted "
+                "from the mmap backing file (the all-RAM tier counts "
+                "every read as hot)", ("tier",)),
+            "evict": r.counter(
+                "paddle_tpu_embedding_evictions_total",
+                "hot row pages evicted from the RAM-resident LRU to "
+                "the mmap backing file (dirty pages are flushed on "
+                "the way out)"),
+        }
+    return _METRICS
+
+
+# ---------------------------------------------------------------------------
+# deterministic counter-based lazy init
+# ---------------------------------------------------------------------------
+_U64 = np.uint64
+_GOLD64 = _U64(0x9E3779B97F4A7C15)      # splitmix64 increment
+_MIX1 = _U64(0xBF58476D1CE4E5B9)
+_MIX2 = _U64(0x94D049BB133111EB)
+_COLKEY = _U64(0xD6E8FEB86659FD93)      # decorrelates the two BM lanes
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer on uint64 lanes (wrapping)."""
+    z = (x + _GOLD64).astype(_U64)
+    z = ((z ^ (z >> _U64(30))) * _MIX1).astype(_U64)
+    z = ((z ^ (z >> _U64(27))) * _MIX2).astype(_U64)
+    return z ^ (z >> _U64(31))
+
+
+def row_init(rows, dim: int, seed: int, std: float, dtype) -> np.ndarray:
+    """[len(rows), dim] of N(0, std) values, deterministic per
+    (seed, row id, column) — the batched replacement for the per-row
+    RandomState loop. `rows` are GLOBAL row ids: a process shard or an
+    mmap tier initializing the same global row produces the same
+    values as the single-process all-RAM table."""
+    rows = np.asarray(rows, dtype=np.uint64)
+    cols = np.arange(dim, dtype=np.uint64)
+    # one base stream per row (seed folded in), one counter per column
+    base = _splitmix64(rows * _GOLD64 + _U64(np.uint64(seed & 0xFFFFFFFF)))
+    ctr = base[:, None] ^ (cols[None, :] * _COLKEY)
+    h1 = _splitmix64(ctr)
+    h2 = _splitmix64(ctr ^ _COLKEY)
+    # 53-bit uniforms; u1 in (0, 1] so log() is finite, u2 in [0, 1)
+    u1 = ((h1 >> _U64(11)).astype(np.float64) + 1.0) * (2.0 ** -53)
+    u2 = (h2 >> _U64(11)).astype(np.float64) * (2.0 ** -53)
+    z = np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)
+    return (z * std).astype(np.dtype(dtype), copy=False)
+
+
+def apply_sparse_grad(vals, acc, grad, optimizer, lr, eps, out_dtype):
+    """The reference sparse-table accessor math (sgd / adagrad) on a
+    compact row block: returns (new_vals, new_acc). Shared by the
+    single-process HostEmbedding and the sharded owners so both apply
+    bit-identical updates. Matches the original in-place HostEmbedding
+    arithmetic exactly (the step is cast to the table dtype BEFORE the
+    subtraction)."""
+    grad = np.asarray(grad, np.float32)
+    if optimizer == "sgd":
+        return vals - (lr * grad).astype(out_dtype), acc
+    acc = acc + grad * grad
+    step = (lr * grad / (np.sqrt(acc) + eps)).astype(out_dtype)
+    return vals - step, acc
+
+
+# ---------------------------------------------------------------------------
+# tiers
+# ---------------------------------------------------------------------------
+class RamRowStore:
+    """All-RAM tier: one numpy array. np.zeros pages are virtual until
+    first touched, so a 100 GB logical table costs only the rows the
+    data distribution actually hits — the original HostEmbedding
+    storage, unchanged."""
+
+    def __init__(self, num_rows: int, width: int, dtype):
+        self.num_rows = int(num_rows)
+        self.width = int(width)
+        self.dtype = np.dtype(dtype)
+        self.arr = np.zeros((self.num_rows, self.width), self.dtype)
+
+    def read(self, rows: np.ndarray) -> np.ndarray:
+        out = self.arr[rows]                    # fancy index: a copy
+        if _om._ENABLED and len(rows):
+            _metrics()["tier"].labels(tier="hot").inc(len(rows))
+        return out
+
+    def write(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        self.arr[rows] = vals
+
+    def host_bytes(self) -> int:
+        return self.arr.nbytes
+
+    def resident_bytes(self) -> int:
+        return self.arr.nbytes
+
+    def disk_bytes(self) -> int:
+        return 0
+
+    def flush(self) -> None:
+        pass
+
+
+class MmapRowStore:
+    """Beyond-RAM tier: the table lives in an mmap-backed file; a
+    bounded LRU of row pages stays resident in RAM.
+
+    * the backing file is created SPARSE (ftruncate) — `disk_bytes()`
+      reports allocated blocks, so an untouched terabyte table costs
+      ~0 disk like it costs ~0 RAM in the all-RAM tier;
+    * `read()` serves resident pages from the hot tier and promotes
+      the pages it misses (whole-page copy into RAM — embedding access
+      is id-clustered enough that page granularity amortizes);
+    * `write()` promotes then dirties the hot copy; eviction (LRU,
+      past `hot_rows` worth of pages) flushes dirty pages back;
+    * `flush()` persists every dirty page + msyncs the mapping (the
+      shard-checkpoint path reads THROUGH the store, so checkpoints
+      never depend on flush ordering).
+
+    An existing backing file is reopened in place (mode r+), so a
+    process restart — or a supervisor resuming a crashed shard — sees
+    the last flushed bytes without any checkpoint involvement."""
+
+    def __init__(self, num_rows: int, width: int, dtype, path: str,
+                 hot_rows: Optional[int] = None,
+                 rows_per_page: Optional[int] = None):
+        self.num_rows = int(num_rows)
+        self.width = int(width)
+        self.dtype = np.dtype(dtype)
+        self.path = path
+        row_bytes = self.width * self.dtype.itemsize
+        if rows_per_page is None:
+            # ~1 MiB pages: large enough to amortize the promote copy,
+            # small enough that a skewed id distribution doesn't pin
+            # the whole table hot
+            rows_per_page = max(1, (1 << 20) // max(row_bytes, 1))
+        self.rows_per_page = int(rows_per_page)
+        self.n_pages = -(-self.num_rows // self.rows_per_page)
+        if hot_rows is None:
+            hot_rows = self.rows_per_page * 64
+        self.hot_pages = max(1, int(hot_rows) // self.rows_per_page)
+        mode = "r+" if os.path.exists(path) else "w+"
+        self._mm = np.memmap(path, dtype=self.dtype, mode=mode,
+                             shape=(self.num_rows, self.width))
+        self._hot: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self._dirty: set = set()
+        self.evictions = 0
+
+    # -- page machinery --
+    def _page(self, p: int) -> np.ndarray:
+        page = self._hot.get(p)
+        if page is None:
+            lo = p * self.rows_per_page
+            hi = min(lo + self.rows_per_page, self.num_rows)
+            page = np.array(self._mm[lo:hi])    # promote: copy to RAM
+            self._hot[p] = page
+            self._evict_over_capacity()
+        else:
+            self._hot.move_to_end(p)
+        return page
+
+    def _evict_over_capacity(self) -> None:
+        while len(self._hot) > self.hot_pages:
+            victim, vpage = self._hot.popitem(last=False)
+            if victim in self._dirty:
+                lo = victim * self.rows_per_page
+                self._mm[lo:lo + len(vpage)] = vpage
+                self._dirty.discard(victim)
+            self.evictions += 1
+            if _om._ENABLED:
+                _metrics()["evict"].inc()
+
+    def _by_page(self, rows: np.ndarray):
+        pages = rows // self.rows_per_page
+        for p in np.unique(pages):
+            sel = pages == p
+            yield int(p), sel, rows[sel] - int(p) * self.rows_per_page
+
+    # -- row API --
+    def read(self, rows: np.ndarray) -> np.ndarray:
+        rows = np.asarray(rows)
+        out = np.empty((len(rows), self.width), self.dtype)
+        hot = cold = 0
+        for p, sel, local in self._by_page(rows):
+            if p in self._hot:
+                hot += int(sel.sum())
+            else:
+                cold += int(sel.sum())
+            out[sel] = self._page(p)[local]
+        if _om._ENABLED and len(rows):
+            m = _metrics()["tier"]
+            m.labels(tier="hot").inc(hot)
+            m.labels(tier="cold").inc(cold)
+        return out
+
+    def write(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        rows = np.asarray(rows)
+        vals = np.asarray(vals, self.dtype)
+        for p, sel, local in self._by_page(rows):
+            self._page(p)[local] = vals[sel]
+            self._dirty.add(p)
+
+    # -- accounting / durability --
+    def host_bytes(self) -> int:
+        return self.num_rows * self.width * self.dtype.itemsize
+
+    def resident_bytes(self) -> int:
+        return sum(page.nbytes for page in self._hot.values())
+
+    def disk_bytes(self) -> int:
+        try:
+            return os.stat(self.path).st_blocks * 512
+        except OSError:
+            return 0
+
+    def flush(self) -> None:
+        for p in sorted(self._dirty):
+            page = self._hot.get(p)
+            if page is not None:
+                lo = p * self.rows_per_page
+                self._mm[lo:lo + len(page)] = page
+        self._dirty.clear()
+        self._mm.flush()

@@ -1,0 +1,155 @@
+"""Embedding observability: the `paddle_tpu_embedding_*` series and
+spans recorded by the host / sharded tables (README "Terabyte-scale
+embeddings" metric + span tables) and the obs_top "== embedding =="
+panel rendered from a snapshot document."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    from paddle_tpu import observability as obs
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _obs_top():
+    tools = os.path.join(REPO, "tools")
+    sys.path.insert(0, tools)
+    try:
+        import obs_top
+    finally:
+        sys.path.remove(tools)
+    return obs_top
+
+
+def _train_host(tmp_path, steps=3):
+    from paddle_tpu.embedding import HostEmbedding
+    emb = HostEmbedding(256, 8, optimizer="adagrad", learning_rate=0.2,
+                        init_std=0.05, seed=1,
+                        mmap_path=str(tmp_path / "emb.bin"),
+                        hot_rows=32, rows_per_page=8)
+    rng = np.random.default_rng(0)
+    for s in range(steps):
+        ids = rng.integers(0, 256, (16,)).astype(np.int64)
+        out = emb(pt.to_tensor(ids))
+        out.sum().backward()
+        emb.prefetch(ids)           # will be invalidated by the update
+        emb.apply_updates()
+    return emb
+
+
+# ---------------------------------------------------------------------------
+# series + spans
+# ---------------------------------------------------------------------------
+def test_host_embedding_series_recorded(tmp_path):
+    from paddle_tpu import observability as obs
+    obs.enable()
+    _train_host(tmp_path)
+    snap = obs.snapshot()
+    rows = snap["paddle_tpu_embedding_rows_total"]["series"]
+    assert rows[("lookup",)] > 0 and rows[("update",)] > 0
+    for hist in ("paddle_tpu_embedding_lookup_seconds",
+                 "paddle_tpu_embedding_update_seconds"):
+        series = snap[hist]["series"]
+        assert sum(s["count"] for s in series.values()) > 0, hist
+    tier = snap["paddle_tpu_embedding_tier_rows_total"]["series"]
+    assert tier.get(("hot",), 0) + tier.get(("cold",), 0) > 0
+    pf = snap["paddle_tpu_embedding_prefetch_total"]["series"]
+    assert pf[("invalidated",)] > 0
+    # byte gauges published by the update path
+    logical = snap["paddle_tpu_embedding_logical_bytes"]["series"]
+    resident = snap["paddle_tpu_embedding_resident_bytes"]["series"]
+    disk = snap["paddle_tpu_embedding_disk_bytes"]["series"]
+    (lv,), (rv,), (dv,) = (logical.values(), resident.values(),
+                           disk.values())
+    assert lv > rv > 0 and dv >= 0
+
+
+def test_embedding_spans_recorded(tmp_path):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.embedding import (
+        ShardedHostEmbedding, save_shards, resume_latest_shards)
+    from paddle_tpu.embedding import HostEmbedding
+    obs.enable()
+    emb = ShardedHostEmbedding(128, 4, init_std=0.05, seed=1)
+    ids = np.arange(64, dtype=np.int64).reshape(8, 8)
+    out = emb(pt.to_tensor(ids))
+    out.sum().backward()
+    emb.apply_updates()
+    # lookup/update spans wrap the HOST table's gather/apply (the
+    # sharded exchange has its own span around the all_to_alls)
+    host = HostEmbedding(32, 4, init_std=0.05, seed=1)
+    hout = host(pt.to_tensor(np.arange(8, dtype=np.int64)))
+    hout.sum().backward()
+    host.apply_updates()
+    save_shards(emb, str(tmp_path), step=1)
+    resume_latest_shards(ShardedHostEmbedding(128, 4, init_std=0.05,
+                                              seed=1), str(tmp_path))
+    names = {e["name"] for e in tracing.events()}
+    for want in ("embedding.lookup", "embedding.exchange",
+                 "embedding.update", "embedding.shard_save",
+                 "embedding.shard_restore"):
+        assert want in names, (want, sorted(names))
+
+
+def test_disabled_records_nothing(tmp_path):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import tracing
+    _train_host(tmp_path)           # obs disabled by the fixture
+    rec = obs.snapshot().get("paddle_tpu_embedding_rows_total")
+    if rec is not None:             # registered by an earlier test
+        assert all(v == 0 for v in rec["series"].values())
+    assert tracing.events() == []
+
+
+# ---------------------------------------------------------------------------
+# obs_top "== embedding ==" panel
+# ---------------------------------------------------------------------------
+def test_obs_top_embedding_panel_renders(tmp_path):
+    from paddle_tpu import observability as obs
+    obs.enable()
+    prev = json.loads(obs.to_json())
+    _train_host(tmp_path)
+    doc = json.loads(obs.to_json())
+    frame = _obs_top().render(doc, prev, dt=1.0)
+    assert "== embedding ==" in frame
+    lines = {ln.strip().split()[0]: ln for ln in frame.splitlines()
+             if ln.strip()}
+    assert "p50=" in lines["lookup"] and "rows/s" in lines["lookup"]
+    assert "rows=" in lines["update"]
+    assert "hit=" in lines["tier"] and "evictions=" in lines["tier"]
+    assert "invalidated=" in lines["prefetch"]
+    assert "logical=" in lines["bytes"] and "resident=" in lines["bytes"]
+
+
+def test_obs_top_sharded_exchange_line(tmp_path):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.embedding import ShardedHostEmbedding
+    obs.enable()
+    emb = ShardedHostEmbedding(128, 4, init_std=0.05, seed=1)
+    ids = np.arange(64, dtype=np.int64).reshape(8, 8)
+    out = emb(pt.to_tensor(ids))
+    out.sum().backward()
+    emb.apply_updates()
+    frame = _obs_top().render(json.loads(obs.to_json()))
+    line = [ln for ln in frame.splitlines()
+            if ln.strip().startswith("exchange")][0]
+    assert "ids=" in line and "rows=" in line and "grads=" in line
+    assert "pad=" in line
+
+
+def test_obs_top_no_embedding_series_no_panel():
+    assert "== embedding ==" not in _obs_top().render({})

@@ -1,0 +1,167 @@
+"""Tiered row storage for host embedding tables
+(paddle_tpu/embedding/store.py): the mmap disk tier's hot-page LRU,
+dirty write-back on eviction, honest three-valued byte accounting
+(logical / resident / disk), reopen-in-place durability, and
+RAM-vs-mmap tier equivalence of the full HostEmbedding training
+loop (the acceptance bullet: a larger-than-RAM-budget table serves
+bit-identical lookups with `resident_bytes() < host_bytes()`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.embedding.store import MmapRowStore, RamRowStore
+from paddle_tpu.embedding import HostEmbedding
+
+
+# ---------------------------------------------------------------------------
+# MmapRowStore: pages, LRU, write-back
+# ---------------------------------------------------------------------------
+def test_mmap_read_write_round_trip(tmp_path):
+    st = MmapRowStore(100, 4, np.float32, str(tmp_path / "t.bin"),
+                      hot_rows=1000, rows_per_page=10)
+    rows = np.array([3, 57, 99], np.int64)
+    vals = np.arange(12, dtype=np.float32).reshape(3, 4)
+    st.write(rows, vals)
+    np.testing.assert_array_equal(st.read(rows), vals)
+    # untouched rows read as zeros (sparse file holes)
+    assert not st.read(np.array([50], np.int64)).any()
+
+
+def test_mmap_lru_evicts_and_flushes_dirty_pages(tmp_path):
+    # capacity: 2 pages of 10 rows
+    st = MmapRowStore(100, 4, np.float32, str(tmp_path / "t.bin"),
+                      hot_rows=20, rows_per_page=10)
+    for p in range(5):                      # touch 5 distinct pages
+        st.write(np.array([p * 10], np.int64),
+                 np.full((1, 4), float(p + 1), np.float32))
+    assert len(st._hot) == 2                # bounded resident set
+    assert st.evictions == 3
+    # evicted dirty pages were flushed to the backing file: the rows
+    # written to pages 0..2 survive re-promotion
+    for p in range(3):
+        np.testing.assert_array_equal(
+            st.read(np.array([p * 10], np.int64)),
+            np.full((1, 4), float(p + 1), np.float32))
+
+
+def test_mmap_byte_accounting(tmp_path):
+    st = MmapRowStore(10_000, 8, np.float32, str(tmp_path / "t.bin"),
+                      hot_rows=100, rows_per_page=10)
+    assert st.host_bytes() == 10_000 * 8 * 4        # logical, always
+    assert st.resident_bytes() == 0                 # nothing promoted
+    st.write(np.arange(10), np.ones((10, 8), np.float32))
+    assert st.resident_bytes() == 10 * 8 * 4        # one hot page
+    assert st.resident_bytes() < st.host_bytes()
+    st.flush()
+    # sparse backing file: only the touched page costs disk blocks
+    assert 0 < st.disk_bytes() < st.host_bytes()
+
+
+def test_mmap_reopen_in_place_sees_flushed_bytes(tmp_path):
+    path = str(tmp_path / "t.bin")
+    st = MmapRowStore(50, 4, np.float32, path, rows_per_page=10)
+    st.write(np.array([7]), np.full((1, 4), 3.5, np.float32))
+    st.flush()
+    del st
+    st2 = MmapRowStore(50, 4, np.float32, path, rows_per_page=10)
+    np.testing.assert_array_equal(
+        st2.read(np.array([7])),
+        np.full((1, 4), 3.5, np.float32))
+
+
+def test_tier_counters_hot_vs_cold(tmp_path):
+    from paddle_tpu import observability as obs
+    obs.reset()
+    obs.enable()
+    try:
+        st = MmapRowStore(100, 4, np.float32, str(tmp_path / "t.bin"),
+                          hot_rows=1000, rows_per_page=10)
+        st.read(np.array([1, 2, 11], np.int64))     # 2 pages faulted
+        st.read(np.array([1, 2, 11], np.int64))     # all resident now
+        rec = obs.snapshot()["paddle_tpu_embedding_tier_rows_total"]
+        assert rec["series"][("cold",)] == 3
+        assert rec["series"][("hot",)] == 3
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+def test_ram_store_is_all_resident():
+    st = RamRowStore(100, 4, np.float32)
+    assert st.resident_bytes() == st.host_bytes() == 100 * 4 * 4
+    assert st.disk_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# HostEmbedding on the mmap tier: tier-equivalence of training
+# ---------------------------------------------------------------------------
+def _train_steps(emb, ids_seq, target):
+    losses = []
+    for ids in ids_seq:
+        out = emb(pt.to_tensor(ids))
+        loss = ((out - pt.to_tensor(target)) ** 2).mean()
+        loss.backward()
+        emb.apply_updates()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_mmap_tier_matches_ram_tier_bit_exact(tmp_path):
+    """The acceptance contract: an mmap-tier table whose hot budget is
+    far below the table size serves lookups and applies updates
+    bit-identically to the all-RAM tier, while actually pinning less
+    RAM than the logical table size."""
+    rng = np.random.default_rng(3)
+    n, dim = 5000, 8
+    ids_seq = [rng.integers(0, n, (16,)).astype(np.int64)
+               for _ in range(6)]
+    target = rng.standard_normal((16, dim)).astype(np.float32)
+
+    ram = HostEmbedding(n, dim, optimizer="adagrad", learning_rate=0.2,
+                        init_std=0.05, seed=11)
+    mm = HostEmbedding(n, dim, optimizer="adagrad", learning_rate=0.2,
+                       init_std=0.05, seed=11,
+                       mmap_path=str(tmp_path / "emb.bin"),
+                       hot_rows=64, rows_per_page=8)
+    l_ram = _train_steps(ram, ids_seq, target)
+    l_mm = _train_steps(mm, ids_seq, target)
+    np.testing.assert_array_equal(l_ram, l_mm)
+    touched = np.unique(np.concatenate(ids_seq))
+    np.testing.assert_array_equal(ram.table[touched],
+                                  mm._store.read(touched))
+    # honest accounting: the mmap tier holds less than the logical
+    # table in RAM, and the backing file has real blocks after flush
+    assert mm.resident_bytes() < mm.host_bytes()
+    assert mm.host_bytes() == ram.host_bytes()      # same logical size
+    mm.flush()
+    assert mm.disk_bytes() > 0
+    assert ram.disk_bytes() == 0
+
+
+def test_mmap_tier_lazy_init_matches_ram(tmp_path):
+    """Deterministic lazy init is tier-independent: first touches on
+    the mmap tier produce the same rows as the RAM tier even though
+    the pages round-trip through the LRU."""
+    ram = HostEmbedding(200, 4, init_std=0.1, seed=7)
+    mm = HostEmbedding(200, 4, init_std=0.1, seed=7,
+                       mmap_path=str(tmp_path / "e.bin"),
+                       hot_rows=8, rows_per_page=4)
+    ids = np.array([0, 3, 150, 199], np.int64)
+    a = ram(pt.to_tensor(ids)).numpy()
+    b = mm(pt.to_tensor(ids)).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_mmap_table_alias_is_none(tmp_path):
+    """The back-compat `emb.table` full-array alias only exists for
+    the all-RAM tier; the mmap tier has no single resident array."""
+    mm = HostEmbedding(100, 4, mmap_path=str(tmp_path / "e.bin"))
+    assert mm.table is None and mm._acc is None
+    ram = HostEmbedding(100, 4)
+    assert ram.table is not None
+
+
+def test_out_of_range_raises_on_mmap_tier(tmp_path):
+    emb = HostEmbedding(10, 2, mmap_path=str(tmp_path / "e.bin"))
+    with pytest.raises(IndexError):
+        emb(pt.to_tensor(np.array([10], np.int64)))

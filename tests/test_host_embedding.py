@@ -116,3 +116,100 @@ def test_out_of_range_raises():
     emb = HostEmbedding(10, 2)
     with pytest.raises(IndexError):
         emb(pt.to_tensor(np.array([10], np.int64)))
+
+
+# ---------------------------------------------------------------------------
+# vectorized lazy init (embedding.store.row_init)
+# ---------------------------------------------------------------------------
+def test_row_init_batched_matches_rowwise():
+    """The batched counter-based stream is a pure function of
+    (seed, global row id, column): initializing rows one at a time, in
+    any order, gives bit-for-bit the same values as one batched call —
+    the property the vectorized `_ensure_init` relies on."""
+    from paddle_tpu.embedding.store import row_init
+    rows = np.array([0, 7, 3, 1_000_003, 42], np.int64)
+    batched = row_init(rows, 16, seed=9, std=0.02, dtype=np.float32)
+    rowwise = np.concatenate([
+        row_init(np.array([r], np.int64), 16, seed=9, std=0.02,
+                 dtype=np.float32)
+        for r in rows])
+    np.testing.assert_array_equal(batched, rowwise)
+    # and the stream is keyed on the GLOBAL id: shard (scale, offset)
+    # relabeling reproduces the unsharded values exactly
+    a = HostEmbedding(100, 8, init_std=0.1, seed=5)
+    b = HostEmbedding(50, 8, init_std=0.1, seed=5,
+                      init_id_scale=2, init_id_offset=1)   # shard 1 of 2
+    a(pt.to_tensor(np.array([3, 7], np.int64)))       # global rows 3, 7
+    b(pt.to_tensor(np.array([1, 3], np.int64)))       # local 1,3 -> 3,7
+    np.testing.assert_array_equal(a.table[3], b.table[1])
+    np.testing.assert_array_equal(a.table[7], b.table[3])
+
+
+def test_row_init_stats_distribution():
+    from paddle_tpu.embedding.store import row_init
+    vals = row_init(np.arange(4096), 32, seed=1, std=0.5,
+                    dtype=np.float32)
+    assert np.isfinite(vals).all()
+    assert abs(float(vals.mean())) < 0.01
+    assert abs(float(vals.std()) - 0.5) < 0.01
+
+
+# ---------------------------------------------------------------------------
+# prefetch vs apply_updates: the version fence
+# ---------------------------------------------------------------------------
+def test_prefetch_invalidated_by_update_never_serves_stale_rows():
+    """A prefetch issued BEFORE apply_updates gathered pre-update rows;
+    the update must invalidate it (counted under
+    `prefetch_invalidated`) and the next forward must serve the
+    POST-update values."""
+    emb = HostEmbedding(50, 4, optimizer="sgd", learning_rate=1.0,
+                        init_std=0.0)
+    emb.table[:] = 1.0
+    ids = np.array([2, 3], np.int64)
+    out = emb(pt.to_tensor(ids))
+    out.sum().backward()
+    emb.prefetch(ids)               # gathers the PRE-update rows
+    emb.apply_updates()             # rows 2,3 -> 0.0; invalidates it
+    assert emb.stats["prefetch_invalidated"] == 1
+    out2 = emb(pt.to_tensor(ids)).numpy()
+    np.testing.assert_array_equal(out2, np.zeros((2, 4), np.float32))
+    assert emb.stats["prefetch_hits"] == 0
+
+
+def test_version_fence_rejects_adversarial_schedule():
+    """Even if an invalidated in-flight gather REAPPEARS at consume
+    time (the worst-case thread schedule the `_inflight` hand-off
+    alone can't rule out), the version fence in forward refuses it:
+    the gather snapshotted a table version older than the update."""
+    emb = HostEmbedding(50, 4, optimizer="sgd", learning_rate=1.0,
+                        init_std=0.0)
+    emb.table[:] = 1.0
+    ids = np.array([5, 6], np.int64)
+    emb.prefetch(ids)
+    key, t, holder = emb._inflight
+    t.join()                        # gather definitely completed (old)
+    out = emb(pt.to_tensor(ids))
+    out.sum().backward()
+    emb.apply_updates()             # bumps the table version
+    emb._inflight = (key, t, holder)    # adversarial: stale reappears
+    before = emb.stats["prefetch_invalidated"]
+    out2 = emb(pt.to_tensor(ids)).numpy()
+    np.testing.assert_array_equal(out2, np.zeros((2, 4), np.float32))
+    assert emb.stats["prefetch_invalidated"] == before + 1
+    assert emb.stats["prefetch_hits"] == 1  # only the pre-update consume
+
+
+def test_orphaned_prefetch_workers_are_joined():
+    """Stale / invalidated prefetch workers are parked and joined by
+    the next forward — bounded thread count, no daemon leak."""
+    emb = HostEmbedding(50, 4, init_std=0.01)
+    ids1 = np.array([1, 2], np.int64)
+    ids2 = np.array([3, 4], np.int64)
+    emb.prefetch(ids1)
+    emb(pt.to_tensor(ids2))         # mismatch: ids1 gather parked
+    assert emb.stats["prefetch_stale"] == 1
+    assert len(emb._orphans) == 1
+    orphan_thread = emb._orphans[0][1]
+    emb(pt.to_tensor(ids2))         # next forward drains the park list
+    assert emb._orphans == []
+    assert not orphan_thread.is_alive()

@@ -430,6 +430,61 @@ def render(doc, prev=None, dt=None) -> str:
                 row += f"  straggler={stragglers[op]}"
             lines.append(row)
 
+    # embedding: the terabyte-table plane (README "Terabyte-scale
+    # embeddings") — lookup/update latency, tier hit rate, exchange
+    emb_rows = _series(doc, "paddle_tpu_embedding_rows_total")
+    if any(s["value"] for s in emb_rows):
+        lines.append("== embedding ==")
+        for op in ("lookup", "update"):
+            n = _counter_sum(doc, "paddle_tpu_embedding_rows_total",
+                             op=op)
+            q = _hist_quantiles(
+                doc, f"paddle_tpu_embedding_{op}_seconds", prev=prev)
+            rps = rate("paddle_tpu_embedding_rows_total", op=op)
+            row = f"  {op:<9} rows={int(n):>10}"
+            if q:
+                row += f"  p50={_ms(q['p50'])}  p95={_ms(q['p95'])}"
+            if rps is not None:
+                row += f"  ({rps:10.1f} rows/s)"
+            lines.append(row)
+        hot = _counter_sum(doc, "paddle_tpu_embedding_tier_rows_total",
+                           tier="hot")
+        cold = _counter_sum(
+            doc, "paddle_tpu_embedding_tier_rows_total", tier="cold")
+        if hot + cold:
+            ev = _counter_sum(doc,
+                              "paddle_tpu_embedding_evictions_total")
+            lines.append(
+                f"  tier      hit={hot / (hot + cold):6.1%}  "
+                f"hot={int(hot)}  cold={int(cold)}  "
+                f"evictions={int(ev)}")
+        xb = {s["labels"]["payload"]: s["value"] for s in _series(
+            doc, "paddle_tpu_embedding_exchange_bytes_total")}
+        if xb:
+            pad = _value(doc,
+                         "paddle_tpu_embedding_exchange_pad_fraction")
+            row = "  exchange  " + "  ".join(
+                f"{p}={xb[p] / 1e6:.2f}MB" for p in
+                ("ids", "rows", "grads") if p in xb)
+            if pad is not None:
+                row += f"  pad={pad:6.1%}"
+            lines.append(row)
+        pf = {s["labels"]["outcome"]: s["value"] for s in _series(
+            doc, "paddle_tpu_embedding_prefetch_total")}
+        if pf:
+            lines.append("  prefetch  " + "  ".join(
+                f"{k}={int(pf[k])}" for k in
+                ("hit", "stale", "invalidated") if k in pf))
+        logical = _value(doc, "paddle_tpu_embedding_logical_bytes")
+        if logical is not None:
+            resident = _value(
+                doc, "paddle_tpu_embedding_resident_bytes") or 0
+            disk = _value(doc, "paddle_tpu_embedding_disk_bytes") or 0
+            lines.append(
+                f"  bytes     logical={logical / 1e6:.1f}MB  "
+                f"resident={resident / 1e6:.1f}MB  "
+                f"disk={disk / 1e6:.1f}MB")
+
     comp = _series(doc, "paddle_tpu_compile_total")
     if comp:
         lines.append("== compiles ==")
