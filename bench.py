@@ -1104,6 +1104,119 @@ def bench_spec_decode(on_tpu):
     }
 
 
+def _proc_fleet_model(**kw):
+    """Module-level so the replica spawn context can pickle it by
+    reference (the worker re-imports bench.py as __mp_main__)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+    pt.seed(0)
+    m = GPTForCausalLM(GPTConfig(**kw))
+    m.eval()
+    return m
+
+
+def _proc_fleet_reintegration(model_kw, engine_kw, n_new):
+    """Cold-vs-warm serving-fleet reintegration: two passes of an
+    N=2 REAL-OS-PROCESS fleet over one shared persistent executable
+    store. The cold pass starts from an empty store (spawn + XLA
+    compile + serve); the warm pass spawns FRESH processes over the
+    populated store under the SAME fleet names (spawn + deserialize +
+    serve — and the aggregator's pid-change detection books the
+    restarts). warm_over_cold is the whole-pass wall-clock ratio; a
+    warm pass that hit disk for every executable reports
+    warm_skipped_all_compiles=true (store misses 0, zero fresh
+    compiles in any warm worker's registry)."""
+    import shutil
+    import tempfile
+    from paddle_tpu.inference import Router
+    from paddle_tpu.inference.replica_proc import process_engine_factory
+    from paddle_tpu.observability import fleet as ofleet
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_exec_cache_")
+    agg = ofleet.serve_aggregator(stale_after_s=60.0)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, model_kw["vocab_size"],
+                            (12,)).astype(np.int32) for _ in range(6)]
+
+    def one_pass(tag):
+        factory = process_engine_factory(
+            _proc_fleet_model, model_kwargs=model_kw,
+            engine_kwargs=engine_kw, exec_cache_dir=cache_dir,
+            aggregator_endpoint=agg.endpoint,
+            name_prefix="bench-engine")
+        t0 = time.perf_counter()
+        router = Router(factory, n_replicas=2, affinity=True)
+        for i, p in enumerate(prompts):
+            router.submit(("fleet-%s" % tag, i), p,
+                          max_new_tokens=n_new)
+        outs = []
+        while router.has_unfinished:
+            outs.extend(router.step())
+        dt = time.perf_counter() - t0
+        outcomes = {}
+        store = {}
+        for h in router.replicas:
+            try:
+                for k, v in h.engine.compile_outcomes().items():
+                    okey = "%s/%s" % k
+                    outcomes[okey] = outcomes.get(okey, 0) + int(v)
+                for k, v in h.engine.exec_cache_stats().items():
+                    store[k] = store.get(k, 0) + int(v)
+            except Exception:
+                pass
+        for h in router.replicas:
+            try:
+                h.engine.shutdown()
+            except Exception:
+                pass
+        outputs = sorted((str(r.request_id),
+                          tuple(int(t) for t in r.output_ids))
+                         for r in outs)
+        return dt, outcomes, store, outputs
+
+    try:
+        cold_s, cold_out, cold_store, cold_txt = one_pass("cold")
+        warm_s, warm_out, warm_store, warm_txt = one_pass("warm")
+        warm_compiles = sum(v for k, v in warm_out.items()
+                            if k.endswith("/compile"))
+        caps = agg.capacity_records()
+        health = agg.health()
+        doc = json.loads(agg.to_json())
+        restarts = sum(
+            s.get("value", 0) for s in doc.get(
+                "paddle_tpu_fleet_process_restarts_total",
+                {}).get("series", ()))
+        return {
+            "replica_processes": 2,
+            "cold_s": round(cold_s, 3),
+            "warm_s": round(warm_s, 3),
+            "warm_over_cold": round(warm_s / max(cold_s, 1e-9), 4),
+            "warm_skipped_all_compiles": bool(
+                warm_compiles == 0
+                and warm_store.get("misses", 0) == 0
+                and warm_store.get("hits", 0) > 0),
+            "outputs_identical": bool(
+                [t for _, t in cold_txt] == [t for _, t in warm_txt]),
+            "cold_outcomes": cold_out, "warm_outcomes": warm_out,
+            "cold_store": cold_store, "warm_store": warm_store,
+            "fleet_restarts": int(restarts),
+            "fleet_capacity": [
+                {k: c.get(k) for k in ("process", "process_role",
+                                       "requests_total",
+                                       "tokens_total", "req_per_s",
+                                       "tok_per_s")}
+                for c in caps],
+            "fleet_up": {p: bool(h["up"]) for p, h in health.items()},
+        }
+    finally:
+        try:
+            agg.close()
+        except Exception:
+            pass
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def bench_router_serving(on_tpu):
     """Replicated serving through the failover Router on the workload
     prefix-cache AFFINITY exists for: S sessions, each with its own
@@ -1216,6 +1329,23 @@ def bench_router_serving(on_tpu):
     tok_on, t_on, hit_on, miss_on = best_of(r_on)
     tok_off, t_off, hit_off, miss_off = best_of(r_off)
     tps_on, tps_off = tok_on / t_on, tok_off / t_off
+    # the process-fleet reintegration phase rides this config: cold
+    # vs warm N=2 OS-process fleets over a shared executable store.
+    # Skipped on TPU — this parent already owns the TPU client, and
+    # spawned workers would fight it for the devices.
+    if on_tpu:
+        reintegration = {"skipped": "tpu single-client runtime"}
+    else:
+        try:
+            reintegration = _proc_fleet_reintegration(
+                kw, dict(max_batch=max_batch, block_size=block_size,
+                         num_blocks=num_blocks, decode_chunk=chunk,
+                         prompt_quantum=quantum,
+                         max_model_len=kw["max_position_embeddings"]),
+                n_new)
+        except Exception as e:
+            reintegration = {"error": "%s: %s"
+                             % (type(e).__name__, e)}
     return {
         "metric": "router_serving_tokens_per_sec",
         "value": round(tps_on, 1),
@@ -1229,6 +1359,7 @@ def bench_router_serving(on_tpu):
                 hit_off / max(hit_off + miss_off, 1), 4),
             "affinity_hit_tokens": int(hit_on),
             "blind_hit_tokens": int(hit_off),
+            "reintegration": reintegration,
             "replicas": 2, "sessions": n_sessions, "turns": turns,
             "shared_prefix_len": prefix_len, "new_tokens": n_new,
             "max_batch": max_batch, "block_size": block_size,
@@ -1802,6 +1933,15 @@ def _append_perf_ledger(path, name, result, modes=None):
         records.append(rec)
     if sweeps:
         records[0]["autotune_sweeps"] = sweeps
+    # fleet warm-reintegration summary (router_serving's process-
+    # fleet phase) rides the record so tools/perf_ledger.py --check
+    # can baseline the warm/cold ratio like the other cost mirrors
+    reint = (result.get("extra") or {}).get("reintegration") or {}
+    if "warm_over_cold" in reint:
+        records[0]["reintegration"] = {
+            k: reint.get(k) for k in (
+                "cold_s", "warm_s", "warm_over_cold",
+                "warm_skipped_all_compiles")}
     with open(path, "a", encoding="utf-8") as f:
         for rec in records:
             f.write(json.dumps(rec, sort_keys=True) + "\n")

@@ -203,7 +203,7 @@ class GradScaler:
         _pf.record_compile("amp_unscale", entry)
         if _om._ENABLED:
             c, h = _om.compile_metrics()
-            c.labels(family="amp_unscale").inc()
+            c.labels(family="amp_unscale", outcome="compile").inc()
             h.labels(family="amp_unscale").observe(
                 _time.perf_counter() - t0)
         return entry

@@ -233,7 +233,7 @@ class _FusedChain:
         self.pending = False
         if _m._ENABLED:
             c, h = _m.compile_metrics()
-            c.labels(family="backward_fused").inc()
+            c.labels(family="backward_fused", outcome="compile").inc()
             h.labels(family="backward_fused").observe(
                 time.perf_counter() - t0)
         return out

@@ -519,7 +519,9 @@ class LLMEngine:
                  max_waiting: Optional[int] = None,
                  step_timeout_s: Optional[float] = None,
                  enable_prefix_caching: bool = True,
-                 speculative_config=None):
+                 speculative_config=None,
+                 mesh=None, shard_param=None,
+                 exec_cache_dir: Optional[str] = None):
         """enable_prefix_caching (default on): full prompt blocks are
         hash-indexed so requests sharing a page-aligned prefix (system
         prompts, few-shot templates, multi-turn history) lease the
@@ -534,7 +536,26 @@ class LLMEngine:
         k+1 positions, the matching prefix commits in bulk, and the
         first mismatch rolls the KV lease back. Greedy outputs stay
         bit-identical with speculation on or off (greedy decoding
-        only: do_sample=True is refused)."""
+        only: do_sample=True is refused).
+
+        mesh/shard_param: tensor-parallel placement — a
+        `jax.sharding.Mesh` (typically a sub-mesh, so one logical
+        replica spans several devices) plus a
+        `(name, shape) -> PartitionSpec` rule table (e.g.
+        `models.shard_plans.gpt_tp_rules`). Params are device_put per
+        rule; the paged pool, rope tables and quant scales replicate
+        over the same mesh so every executable sees mesh-consistent
+        operands. Greedy outputs are unchanged up to XLA reduction
+        order for the same mesh shape.
+
+        exec_cache_dir (default: $PADDLE_TPU_EXEC_CACHE, unset = off):
+        persistent AOT executable store (`inference.exec_cache`).
+        Every `_fns` entry is keyed by a sha256 over the engine's
+        structural configuration + device/topology/jax fingerprint +
+        package source hash; first calls consult the store before
+        lowering and park fresh compiles back, so a crash-restarted
+        replica reintegrates WARM (outcome=disk_hit on
+        `paddle_tpu_compile_total`) instead of recompiling the zoo."""
         # fleet identity plumbing: a bare engine process ships its
         # series as process_role="engine" (weak suggestion — an
         # enclosing Router or an explicit set_identity outranks it)
@@ -596,8 +617,12 @@ class LLMEngine:
                       if self.fam.needs_rope else None)
 
         from ..jit import _collect_params
-        _, ptensors, _, btensors = _collect_params(model)
+        pnames, ptensors, bnames, btensors = _collect_params(model)
         self._tensors = ptensors + btensors
+        self._param_names = pnames + bnames
+        self.mesh = mesh
+        if mesh is not None:
+            self._shard_params(mesh, shard_param)
 
         self.waiting: collections.deque = collections.deque()
         self.slots: List[Optional[_Seq]] = [None] * self.max_batch
@@ -650,6 +675,99 @@ class LLMEngine:
         # in-step pool-occupancy high-water (pages off the free list
         # at the post-lease peak); plain attribute, reset at will
         self.peak_used_blocks = 0
+
+        # persistent executable store (inference.exec_cache): resolved
+        # once, consulted by every _fns entry's CompileTimed shim
+        # before lowering. Last in __init__ — the key parts read the
+        # full resolved configuration above.
+        from . import exec_cache as _exec_cache
+        self._exec_cache = None
+        self._exec_device_fp = None
+        self._exec_key_base = None
+        exec_cache_dir = exec_cache_dir or _exec_cache.default_dir()
+        if exec_cache_dir:
+            self._exec_cache = _exec_cache.ExecCache(exec_cache_dir)
+            self._exec_device_fp = _exec_cache.device_fingerprint(mesh)
+            self._exec_key_base = self._exec_cache_key_parts()
+
+    def _shard_params(self, mesh, shard_param) -> None:
+        """Tensor-parallel placement over `mesh`: every param lands per
+        its PartitionSpec rule (default: replicated), and every other
+        array the executables close over or take as operands — paged
+        pool, rope tables, kv quant scales — replicates over the SAME
+        mesh, so no executable ever sees operands committed to
+        disagreeing device sets."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(mesh, PartitionSpec())
+        for name, t in zip(self._param_names, self._tensors):
+            spec = None
+            if shard_param is not None:
+                spec = shard_param(name, tuple(t._data.shape))
+            if spec is None:
+                spec = PartitionSpec()
+            t._data = jax.device_put(t._data,
+                                     NamedSharding(mesh, spec))
+        self.cache.key_caches = [jax.device_put(k, repl)
+                                 for k in self.cache.key_caches]
+        self.cache.value_caches = [jax.device_put(v, repl)
+                                   for v in self.cache.value_caches]
+        if self._rope is not None:
+            self._rope = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, repl), self._rope)
+        if self._kq is not None:
+            self._kq = jax.device_put(self._kq, repl)
+            self._vq = jax.device_put(self._vq, repl)
+
+    def _exec_cache_key_parts(self) -> dict:
+        """Structural identity of this engine's executables — the
+        graftlint-audited base of every persistent-store key. Built
+        exclusively from plain value-comparable data (shapes, dtypes as
+        strings, config scalars, content hashes): exec_cache.fingerprint
+        raises on anything unstable rather than falling back to repr."""
+        from . import exec_cache as _exec_cache
+        params = [[n, list(t._data.shape), str(t._data.dtype)]
+                  for n, t in zip(self._param_names, self._tensors)]
+        pool = self.cache
+        return {
+            "schema": _exec_cache.SCHEMA_VERSION,
+            "code": _exec_cache.code_fingerprint(),
+            "device": self._exec_device_fp,
+            "model": type(self.model).__name__,
+            "family": type(self.fam).__name__,
+            "params": params,
+            "pool": {
+                "num_blocks": int(pool.allocator.num_blocks),
+                "block_size": int(self.block_size),
+                "kv_heads": int(self.fam.kv_heads),
+                "head_dim": int(self.fam.head_dim),
+                "cache_dtype": str(pool.key_caches[0].dtype),
+                "num_layers": len(pool.key_caches),
+            },
+            "engine": {
+                "max_batch": self.max_batch,
+                "decode_chunk": self.decode_chunk,
+                "prompt_quantum": self.prompt_quantum,
+                "max_model_len": self.max_model_len,
+                "do_sample": self.do_sample,
+                "temperature": self.temperature,
+                "top_p": self.top_p,
+                "top_k": self.top_k,
+                "spec_k": self._spec_k,
+                "kv_quant": self._kq is not None,
+            },
+        }
+
+    def _exec_store_opts(self, fkey) -> dict:
+        """CompileTimed kwargs binding `fkey`'s executable to its
+        persistent-store slot (empty when no store is configured)."""
+        if self._exec_cache is None:
+            return {}
+        from . import exec_cache as _exec_cache
+        parts = dict(self._exec_key_base)
+        parts["fkey"] = list(fkey)
+        return {"store": self._exec_cache,
+                "store_key": _exec_cache.fingerprint(parts),
+                "store_device": self._exec_device_fp}
 
     # -- request lifecycle -------------------------------------------------
     def _finish_obs(self, rid, reason: str, trace_id, root_span,
@@ -1120,7 +1238,8 @@ class LLMEngine:
                 return nxt, new_k, new_v
 
         fn = _CompileTimed(jax.jit(ragged, donate_argnums=(1, 2)),
-                           "engine_ragged")
+                           "engine_ragged",
+                           **self._exec_store_opts(fkey))
         self._fns[fkey] = fn
         return fn, path
 
@@ -1362,7 +1481,8 @@ class LLMEngine:
                 return new_k, new_v, jnp.transpose(toks)   # [B, chunk]
 
         fn = _CompileTimed(jax.jit(decode, donate_argnums=(1, 2)),
-                           "engine_decode")
+                           "engine_decode",
+                           **self._exec_store_opts(("decode", chunk)))
         self._fns[("decode", chunk)] = fn
         return fn
 

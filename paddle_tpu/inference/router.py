@@ -436,6 +436,14 @@ class Router:
                 deadline_s=deadline_s,
                 obs_carry=(req.trace_id, req.root_span, req.t_enq),
                 prefix_hashes=req.hashes)
+        except ReplicaGone as e:
+            # the peer vanished between routing and admission (a
+            # process-backed replica died) — trip the breaker and
+            # re-dispatch through whoever is left; _fail_replica's
+            # reroute drains pending, so park the request there first
+            self._pending.appendleft(req)
+            self._fail_replica(h, e)
+            return
         except Exception as e:
             # infeasible for every identically-provisioned replica
             # (over model len / over pool) — shed, don't crash.

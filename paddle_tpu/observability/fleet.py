@@ -548,6 +548,24 @@ class FleetAggregator:
                 "highest bundle sequence number accepted from the "
                 "process",
                 ("process",)),
+            "pid": h.gauge(
+                "paddle_tpu_fleet_process_pid",
+                "os pid of the process's current incarnation (from "
+                "its heartbeat), labeled with its fleet role — the "
+                "obs_top replica panel joins per-process rows on "
+                "this series",
+                ("process", "role")),
+            "cap_req": h.gauge(
+                "paddle_tpu_fleet_capacity_req_per_s",
+                "achieved finished-requests rate over the process's "
+                "reporting window (capacity_records(); absent until "
+                "a second bundle gives the window a width)",
+                ("process",)),
+            "cap_tok": h.gauge(
+                "paddle_tpu_fleet_capacity_tok_per_s",
+                "achieved decode-tokens rate over the process's "
+                "reporting window (capacity_records())",
+                ("process",)),
             "skew": h.gauge(
                 "paddle_tpu_collective_skew_seconds",
                 "cross-rank arrival skew of the op's most recently "
@@ -776,6 +794,10 @@ class FleetAggregator:
             up = age <= self.stale_after_s
             self._h["age"].labels(process=proc)._value = age
             self._h["up"].labels(process=proc)._value = 1.0 if up else 0.0
+            if st["pid"] is not None:
+                self._h["pid"].labels(
+                    process=proc,
+                    role=st["role"] or "")._value = float(st["pid"])
             out[proc] = {"role": st["role"], "age_s": age, "up": up,
                          "last_seq": st["last_seq"], "pid": st["pid"],
                          "bundles": st["bundles"]}
@@ -784,10 +806,12 @@ class FleetAggregator:
     # -- exports --
     def to_json(self) -> str:
         self.health()
+        self.capacity_records()     # refresh the capacity gauges
         return self.registry.to_json()
 
     def to_prometheus(self) -> str:
         self.health()
+        self.capacity_records()
         return self.registry.to_prometheus()
 
     def export_json(self, path: str) -> str:
@@ -873,6 +897,12 @@ class FleetAggregator:
                     snap, "paddle_tpu_roofline_utilization", proc,
                     bound="flops"),
             }
+            if rec["req_per_s"] is not None:
+                self._h["cap_req"].labels(
+                    process=proc)._value = rec["req_per_s"]
+            if rec["tok_per_s"] is not None:
+                self._h["cap_tok"].labels(
+                    process=proc)._value = rec["tok_per_s"]
             out.append(rec)
         return out
 

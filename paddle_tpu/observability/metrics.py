@@ -633,14 +633,19 @@ def compile_metrics() -> Tuple[Counter, Histogram]:
     by every reporter (LLMEngine bucket caches, the fused optimizer
     step) — the registry dedups on name but compares only
     kind/labels/buckets, so duplicated help literals would drift
-    silently."""
+    silently. The counter additionally carries outcome=compile (fresh
+    XLA compile) | disk_hit (executable deserialized from the
+    persistent exec cache — no XLA work); summing over outcome
+    recovers the historical per-family executable count."""
     return (
         _GLOBAL.counter(
             "paddle_tpu_compile_total",
-            "XLA executables compiled, by executable family (engine "
-            "bucket caches, fused optimizer); entries beyond the "
+            "XLA executables instantiated, by executable family "
+            "(engine bucket caches, fused optimizer) and outcome "
+            "(compile = fresh XLA compile, disk_hit = loaded from "
+            "the persistent exec cache); entries beyond the "
             "steady-state bucket set are recompiles",
-            ("family",)),
+            ("family", "outcome")),
         _GLOBAL.histogram(
             "paddle_tpu_compile_seconds",
             "wall time of each executable's compiling first call "

@@ -486,16 +486,51 @@ class CompileTimed:
     (jit would retrace; AOT raises TypeError before any donation is
     consumed), the shim permanently reverts to the polymorphic jit
     function — correctness first, telemetry only for the signatures it
-    saw first."""
+    saw first.
 
-    __slots__ = ("fn", "jit_fn", "family", "pending", "expected")
+    Persistent-cache hook: when constructed with `store`/`store_key`
+    (an `inference.exec_cache.ExecCache` and its graftlint-audited
+    fingerprint digest), the first call consults the store BEFORE
+    lowering. A hit deserializes a live executable — no trace, no XLA
+    compile — and accounts outcome=disk_hit; a miss compiles as before
+    and parks the fresh executable back in the store, outcome=compile.
+    A stale disk entry whose signature rejects the very first call is
+    discarded on the spot and the call falls through to a fresh
+    compile: the store can delay the compile, never substitute a wrong
+    executable."""
 
-    def __init__(self, fn, family: str):
+    __slots__ = ("fn", "jit_fn", "family", "pending", "expected",
+                 "store", "store_key", "store_device")
+
+    def __init__(self, fn, family: str, store=None, store_key=None,
+                 store_device=None):
         self.fn = fn
         self.jit_fn = fn
         self.family = family
         self.pending = True
         self.expected: Optional[CostModel] = None
+        self.store = store
+        self.store_key = store_key
+        self.store_device = store_device
+
+    def _load_from_store(self):
+        if self.store is None or self.store_key is None:
+            return None
+        try:
+            return self.store.load(self.store_key,
+                                   device=self.store_device)
+        except Exception:
+            return None
+
+    def _save_to_store(self, compiled) -> None:
+        if self.store is None or self.store_key is None:
+            return
+        try:
+            self.store.save(self.store_key, compiled,
+                            family=self.family,
+                            device=self.store_device)
+        except Exception:
+            pass
 
     def __call__(self, *args):
         if not self.pending:
@@ -515,12 +550,30 @@ class CompileTimed:
                 self.expected = None
                 return self.fn(*args)
         t0 = time.perf_counter()
-        compiled = None
-        try:
-            compiled = self.jit_fn.lower(*args).compile()
-        except Exception:
-            compiled = None     # fall back to plain jit dispatch
-        out = (compiled if compiled is not None else self.jit_fn)(*args)
+        outcome = "compile"
+        out = None
+        ran = False
+        compiled = self._load_from_store()
+        if compiled is not None:
+            try:
+                out = compiled(*args)
+                ran = True
+                outcome = "disk_hit"
+            except TypeError:
+                # stale entry with a mismatched signature (detected
+                # before donation consumes anything): discard it and
+                # pay the fresh compile below
+                compiled = None
+        if compiled is None:
+            try:
+                compiled = self.jit_fn.lower(*args).compile()
+            except Exception:
+                compiled = None     # fall back to plain jit dispatch
+            else:
+                self._save_to_store(compiled)
+        if not ran:
+            out = (compiled if compiled is not None
+                   else self.jit_fn)(*args)
         # cleared only on success: a first call that raises (watchdog,
         # injected fault) leaves the compile un-recorded, and the
         # retry — which pays the compile again or hits jax's cache —
@@ -531,7 +584,7 @@ class CompileTimed:
             self.expected = record_compile(self.family, compiled)
         if _m._ENABLED:
             c, h = _m.compile_metrics()
-            c.labels(family=self.family).inc()
+            c.labels(family=self.family, outcome=outcome).inc()
             h.labels(family=self.family).observe(
                 time.perf_counter() - t0)
         return out

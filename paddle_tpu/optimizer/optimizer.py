@@ -95,7 +95,7 @@ def _fused_compile_time(seconds: float) -> None:
     if _COMPILE_METRICS is None:
         _COMPILE_METRICS = _om.compile_metrics()
     c, h = _COMPILE_METRICS
-    c.labels(family="optimizer_fused").inc()
+    c.labels(family="optimizer_fused", outcome="compile").inc()
     h.labels(family="optimizer_fused").observe(seconds)
 
 

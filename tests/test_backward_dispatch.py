@@ -260,7 +260,7 @@ class TestFusion:
             (pt.matmul(pt.ops.tanh(pt.matmul(x, w1)), w2)
              ** 2).mean().backward()
         comp = obs.snapshot()["paddle_tpu_compile_total"]["series"]
-        assert comp[("backward_fused",)] == 1
+        assert comp[("backward_fused", "compile")] == 1
         fl = obs.snapshot()["paddle_tpu_executable_flops"]["series"]
         assert fl[("backward_fused",)] > 0
 
@@ -531,7 +531,7 @@ class TestBackwardFamilyBudget:
         assert batch["count"] == 3          # EXACTLY 1 fused call/step
         assert batch["min"] == batch["max"] >= 6
         comp = snap["paddle_tpu_compile_total"]["series"]
-        fused_compiles = sum(v for (fam,), v in comp.items()
+        fused_compiles = sum(v for (fam, _out), v in comp.items()
                              if fam == "backward_fused" and v)
         # steady state compiled NOTHING new (warmup predates obs)
         assert fused_compiles == 0

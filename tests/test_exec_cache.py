@@ -1,0 +1,285 @@
+"""Persistent executable cache (ISSUE 18): AOT-serialized engine
+executables keyed by structural fingerprints — save/load round trips,
+the degrade-to-compile contract on corrupt/torn/foreign entries, the
+atomic-write discipline, the operator CLI, and the CompileTimed
+disk_hit telemetry that makes warm reintegration observable."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import exec_cache as ec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _tiny_compiled(mul=2.0):
+    import jax
+    import jax.numpy as jnp
+
+    def f(a):
+        return (a * mul).sum()
+
+    a = jnp.arange(16, dtype=jnp.float32)
+    return jax.jit(f).lower(a).compile(), a
+
+
+def _key(tag):
+    return ec.fingerprint({"test": tag, "code": ec.code_fingerprint()})
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+class TestFingerprints:
+    def test_fingerprint_stable_and_order_free(self):
+        a = ec.fingerprint({"b": 2, "a": (1, "x")})
+        b = ec.fingerprint({"a": [1, "x"], "b": 2})
+        assert a == b and len(a) == 64
+
+    def test_fingerprint_distinguishes_values(self):
+        assert ec.fingerprint({"a": 1}) != ec.fingerprint({"a": 2})
+        # 1 vs 1.0 vs True are DIFFERENT compile signatures
+        assert ec.fingerprint({"a": 1}) != ec.fingerprint({"a": 1.0})
+
+    def test_fingerprint_rejects_unstable_components(self):
+        class Opaque:
+            pass
+        with pytest.raises(TypeError):
+            ec.fingerprint({"a": Opaque()})
+
+    def test_device_fingerprint_carries_topology(self):
+        fp = ec.device_fingerprint()
+        assert fp["platform"] and fp["jax"]
+        assert fp["n_local_devices"] >= 1
+
+    def test_code_fingerprint_cached_and_hexy(self):
+        a = ec.code_fingerprint()
+        assert a == ec.code_fingerprint() and len(a) == 64
+
+
+# ---------------------------------------------------------------------------
+# store round trip + degradation contract
+# ---------------------------------------------------------------------------
+class TestExecCacheStore:
+    def test_save_load_round_trip(self, tmp_path):
+        store = ec.ExecCache(str(tmp_path))
+        compiled, a = _tiny_compiled()
+        key = _key("round")
+        assert store.save(key, compiled, family="t_fam")
+        got = store.load(key)
+        assert got is not None
+        np.testing.assert_allclose(np.asarray(got(a)),
+                                   np.asarray(compiled(a)))
+        assert store.stats()["saves"] == 1
+        assert store.stats()["hits"] == 1
+
+    def test_missing_key_is_silent_miss(self, tmp_path):
+        store = ec.ExecCache(str(tmp_path))
+        assert store.load(_key("absent")) is None
+        assert store.stats()["misses"] == 1
+
+    def test_corrupt_payload_refused(self, tmp_path):
+        store = ec.ExecCache(str(tmp_path))
+        compiled, _ = _tiny_compiled()
+        key = _key("corrupt")
+        store.save(key, compiled, family="t_fam")
+        # bit rot: flip bytes mid-payload; the manifest hash check
+        # must refuse the entry and degrade to a miss, never raise
+        payload = tmp_path / (key + ".exec")
+        blob = bytearray(payload.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        payload.write_bytes(bytes(blob))
+        ok, why = store.verify(key)
+        assert not ok and "corrupt" in why
+        assert store.load(key) is None
+        assert store.stats()["corrupt"] == 1
+
+    def test_torn_write_refused(self, tmp_path):
+        store = ec.ExecCache(str(tmp_path))
+        compiled, _ = _tiny_compiled()
+        key = _key("torn")
+        store.save(key, compiled, family="t_fam")
+        payload = tmp_path / (key + ".exec")
+        payload.write_bytes(payload.read_bytes()[:10])
+        ok, why = store.verify(key)
+        assert not ok
+        assert store.load(key) is None
+
+    def test_foreign_topology_refused(self, tmp_path):
+        store = ec.ExecCache(str(tmp_path))
+        compiled, _ = _tiny_compiled()
+        key = _key("foreign")
+        dev = ec.device_fingerprint()
+        store.save(key, compiled, family="t_fam", device=dev)
+        other = dict(dev, n_local_devices=dev["n_local_devices"] + 8,
+                     mesh_axes=["mp"], mesh_shape=[4])
+        ok, why = store.verify(key, device=other)
+        assert not ok and "foreign" in why
+        assert store.load(key, device=other) is None
+        assert store.stats()["foreign"] == 1
+        # the matching topology still hits
+        assert store.load(key, device=dev) is not None
+
+    def test_entries_and_remove(self, tmp_path):
+        store = ec.ExecCache(str(tmp_path))
+        compiled, _ = _tiny_compiled()
+        k1, k2 = _key("e1"), _key("e2")
+        store.save(k1, compiled, family="fam_a")
+        store.save(k2, compiled, family="fam_b")
+        recs = {r["key"]: r for r in store.entries()}
+        assert set(recs) == {k1, k2}
+        assert recs[k1]["family"] == "fam_a"
+        assert recs[k1]["payload_bytes"] > 0
+        store.remove(k1)
+        assert store.keys() == [k2]
+
+    def test_prune_by_age_and_size(self, tmp_path):
+        store = ec.ExecCache(str(tmp_path))
+        compiled, _ = _tiny_compiled()
+        keys = [_key("p%d" % i) for i in range(3)]
+        for k in keys:
+            store.save(k, compiled, family="t_fam")
+        # age out the first entry by back-dating its manifest
+        man = tmp_path / (keys[0] + ".json")
+        rec = json.loads(man.read_text())
+        rec["created_unix"] -= 10 * 86400
+        man.write_text(json.dumps(rec))
+        removed = store.prune(max_age_s=86400.0)
+        assert removed == [keys[0]]
+        # size cap: keep only what fits (one entry's worth)
+        one = store.entries()[0]["payload_bytes"]
+        removed = store.prune(max_bytes=one)
+        assert len(store.keys()) == 1
+
+    def test_prune_reaps_stale_staging_files(self, tmp_path):
+        store = ec.ExecCache(str(tmp_path))
+        stale = tmp_path / ".tmp-1234-deadbeef"
+        stale.write_bytes(b"partial")
+        old = os.path.getmtime(stale) - 7200
+        os.utime(stale, (old, old))
+        store.prune()
+        assert not stale.exists()
+
+
+# ---------------------------------------------------------------------------
+# CompileTimed integration: outcome telemetry + stale-entry fallback
+# ---------------------------------------------------------------------------
+class TestCompileTimedStore:
+    def _outcomes(self):
+        # series keys are (family, outcome) label tuples
+        return obs.snapshot().get("paddle_tpu_compile_total",
+                                  {"series": {}})["series"]
+
+    def test_cold_compile_saves_then_warm_disk_hit(self, tmp_path):
+        import jax
+        from paddle_tpu.observability import perf
+        obs.enable()
+        store = ec.ExecCache(str(tmp_path))
+        key = _key("ct")
+        fn = perf.CompileTimed(jax.jit(lambda a: (a * 2).sum()),
+                               "t_store_fam", store=store,
+                               store_key=key)
+        a = np.arange(8, dtype=np.float32)
+        cold = np.asarray(fn(a))
+        assert store.stats()["saves"] == 1
+        # a FRESH CompileTimed (new process stand-in) must come up
+        # from disk: outcome=disk_hit, no second compile
+        fn2 = perf.CompileTimed(jax.jit(lambda a: (a * 2).sum()),
+                                "t_store_fam2", store=store,
+                                store_key=key)
+        warm = np.asarray(fn2(a))
+        np.testing.assert_allclose(cold, warm)
+        comp = self._outcomes()
+        assert comp[("t_store_fam", "compile")] == 1
+        assert comp[("t_store_fam2", "disk_hit")] == 1
+        assert ("t_store_fam2", "compile") not in comp
+        assert store.stats()["hits"] == 1
+
+    def test_stale_signature_discards_and_recompiles(self, tmp_path):
+        import jax
+        from paddle_tpu.observability import perf
+        obs.enable()
+        store = ec.ExecCache(str(tmp_path))
+        key = _key("stale")
+        fn = perf.CompileTimed(jax.jit(lambda a: (a * 2).sum()),
+                               "t_stale_a", store=store, store_key=key)
+        fn(np.arange(8, dtype=np.float32))
+        # same key, DIFFERENT call signature: the disk entry's first
+        # call fails, is discarded, and the same call compiles fresh
+        fn2 = perf.CompileTimed(
+            jax.jit(lambda a, b: (a * b).sum()), "t_stale_b",
+            store=store, store_key=key)
+        out = np.asarray(fn2(np.arange(4, dtype=np.float32),
+                             np.arange(4, dtype=np.float32)))
+        np.testing.assert_allclose(out, float((np.arange(4) ** 2).sum()))
+        comp = self._outcomes()
+        assert comp[("t_stale_b", "compile")] == 1
+        assert ("t_stale_b", "disk_hit") not in comp
+
+
+# ---------------------------------------------------------------------------
+# operator CLI
+# ---------------------------------------------------------------------------
+class TestExecCacheCLI:
+    def _cli(self):
+        tools = os.path.join(REPO, "tools")
+        sys.path.insert(0, tools)
+        try:
+            import exec_cache as cli
+        finally:
+            sys.path.remove(tools)
+        return cli
+
+    def _seed(self, tmp_path, n=2):
+        store = ec.ExecCache(str(tmp_path))
+        compiled, _ = _tiny_compiled()
+        keys = [_key("cli%d" % i) for i in range(n)]
+        for k in keys:
+            store.save(k, compiled, family="t_cli")
+        return store, keys
+
+    def test_list(self, tmp_path, capsys):
+        self._seed(tmp_path)
+        cli = self._cli()
+        assert cli.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "2 entries" in out and "t_cli" in out
+
+    def test_verify_flags_corruption(self, tmp_path, capsys):
+        _, keys = self._seed(tmp_path)
+        cli = self._cli()
+        assert cli.main([str(tmp_path), "--verify"]) == 0
+        payload = tmp_path / (keys[0] + ".exec")
+        payload.write_bytes(b"rotten")
+        assert cli.main([str(tmp_path), "--verify"]) == 1
+        out = capsys.readouterr().out
+        assert "BAD" in out
+
+    def test_prune_and_json(self, tmp_path, capsys):
+        self._seed(tmp_path)
+        cli = self._cli()
+        assert cli.main([str(tmp_path), "--prune",
+                         "--max-bytes", "0"]) == 0
+        assert cli.main([str(tmp_path), "--json"]) == 0
+        out = capsys.readouterr().out
+        doc = json.loads(out.splitlines()[-1].strip() or "{}") \
+            if out.strip().startswith("{") else json.loads(
+                out[out.index("{"):])
+        assert doc["entries"] == []
+
+    def test_missing_dir_errors(self, tmp_path):
+        cli = self._cli()
+        assert cli.main([str(tmp_path / "nope")]) == 1

@@ -316,6 +316,48 @@ class TestRecompile:
         """, rules={"unstable-cache-key"})
         assert fs == []
 
+    def test_bad_fstring_key_to_persistent_store(self):
+        # the persistent-store verbs (load/save/...) are audited like
+        # dict verbs: an f-string key reaching disk is never hit again
+        fs = analyze("""
+            class Eng:
+                def save(self, fam, shape, compiled):
+                    self._exec_cache.save(f"{fam}-{shape}", compiled)
+        """, rules={"unstable-cache-key"})
+        assert rule_ids(fs) == ["unstable-cache-key"]
+        assert "f-string" in fs[0].message
+
+    def test_bad_repr_key_built_then_loaded_from_store(self):
+        fs = analyze("""
+            class Eng:
+                def warm(self, obj):
+                    key = repr(obj)
+                    return self.store.load(key)
+        """, rules={"unstable-cache-key"})
+        assert rule_ids(fs) == ["unstable-cache-key"]
+        assert "repr()" in fs[0].message
+
+    def test_good_structural_key_to_persistent_store(self):
+        fs = analyze("""
+            class Eng:
+                def save(self, key, compiled):
+                    self._exec_cache.save(key, compiled, family="eng")
+                def warm(self, key):
+                    return self.store.load(key)
+        """, rules={"unstable-cache-key"})
+        assert fs == []
+
+    def test_good_identity_map_not_a_store(self):
+        # an id()-keyed identity dict does not speak the persistent-
+        # store verb surface and must stay clean (tape.py node_store)
+        fs = analyze("""
+            class Tape:
+                def remember(self, node, val):
+                    self.node_store[id(node)] = val
+                    return self.node_store.get(id(node))
+        """, rules={"unstable-cache-key"})
+        assert fs == []
+
     def test_bad_unhashable_static_arg(self):
         fs = analyze("""
             import jax

@@ -87,7 +87,7 @@ class TestCompileTimed:
         out2 = fn(x)
         assert float(out1) == float(out2) == 8.0
         comp = _series("paddle_tpu_compile_total")
-        assert comp[("t_fam_ct",)] == 1      # once, not per call
+        assert comp[("t_fam_ct", "compile")] == 1      # once, not per call
         assert fn.expected is not None and fn.expected.flops > 0
         fl = _series("paddle_tpu_executable_flops")
         assert fl[("t_fam_ct",)] == fn.expected.flops
@@ -121,7 +121,7 @@ class TestCompileTimed:
         # metric recording; the registry saw nothing
         assert fn.expected is not None and fn.expected.flops > 0
         assert _series("paddle_tpu_compile_total").get(
-            ("t_fam_off",), 0) == 0
+            ("t_fam_off", "compile"), 0) == 0
 
 
 # ---------------------------------------------------------------------------
@@ -210,7 +210,7 @@ class TestWiredFamilies:
         assert all(r.ok for r in res)
         _one_train_and_eager_step()
 
-        live = {fam for (fam,), v in
+        live = {fam for (fam, _out), v in
                 _series("paddle_tpu_compile_total").items() if v}
         assert {"engine_ragged", "engine_decode", "optimizer_fused",
                 "train_step"} <= live

@@ -1,0 +1,241 @@
+"""Cross-process serving fleet (ISSUE 18): real-OS-process replicas
+speaking the engine contract over HMAC RPC, SIGKILL chaos through the
+router's failover machinery with bit-identical rerouted outputs, warm
+reintegration of replacement processes from the persistent executable
+store, and the obs_top fleet panel's per-process rows."""
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENGINE_KW = dict(max_batch=4, decode_chunk=4)
+N_NEW = 8
+
+
+def _chaos_model():
+    """Module-level so the replica spawn context can pickle it by
+    reference (the worker re-imports this test module)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    pt.seed(0)
+    return GPTForCausalLM(gpt_tiny())
+
+
+def _prompts(n):
+    rng = np.random.default_rng(11)
+    return [rng.integers(1, 50, (3 + i,)).astype(np.int32)
+            for i in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _reference_outputs(prompts):
+    """Greedy outputs from a never-killed in-process engine on the
+    SAME tp=2 ("mp",) mesh shape the process replicas use — GSPMD
+    reduction order matches, so rerouted fleet outputs must be
+    bit-identical to these."""
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models.shard_plans import gpt_tp_rules
+    mesh = Mesh(np.array(jax.devices()[:2]),  # graftlint: disable=host-sync
+                ("mp",))
+    eng = LLMEngine(_chaos_model(), mesh=mesh,
+                    shard_param=gpt_tp_rules, **ENGINE_KW)
+    for i, p in enumerate(prompts):
+        eng.add_request(f"r{i}", p, N_NEW)
+    out = {}
+    while eng.has_unfinished:
+        for r in eng.step():
+            assert r.ok, r.error
+            out[r.request_id] = tuple(int(t) for t in r.output_ids)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL chaos: failover + bit-identical reroute + warm replacement
+# ---------------------------------------------------------------------------
+class TestProcessFleetChaos:
+    def test_kill9_failover_reroute_and_warm_reintegration(
+            self, tmp_path):
+        from paddle_tpu.inference import Router
+        from paddle_tpu.inference.replica_proc import (
+            process_engine_factory)
+        from paddle_tpu.models.shard_plans import gpt_tp_rules
+
+        prompts = _prompts(6)
+        reference = _reference_outputs(prompts)
+
+        obs.enable()
+        factory = process_engine_factory(
+            _chaos_model, engine_kwargs=ENGINE_KW, tp=2,
+            shard_param=gpt_tp_rules, exec_cache_dir=str(tmp_path),
+            name_prefix="chaos-engine")
+        router = Router(factory, n_replicas=2, affinity=False,
+                        cooldown_s=0.05, max_cooldown_s=0.1)
+        try:
+            for i, p in enumerate(prompts):
+                router.submit(f"r{i}", p, max_new_tokens=N_NEW)
+            got = {}
+
+            def drain_one_pass():
+                for r in router.step():
+                    assert r.ok, (r.request_id, r.finish_reason,
+                                  r.error)
+                    got[r.request_id] = tuple(
+                        int(t) for t in r.output_ids)
+
+            # step until the fleet is mid-stream (both replicas hold
+            # in-flight work and at least one step ran), then SIGKILL
+            # the busier replica — the hard-crash path: no goodbye,
+            # no flush, the OS just takes the process
+            drain_one_pass()
+            victim = max(router.replicas.handles, key=lambda h: h.load)
+            survivor = next(h for h in router.replicas.handles
+                            if h is not victim)
+            assert victim.load > 0
+            victim_rids = set(victim.inflight)
+            victim_pid = victim.engine.pid
+            os.kill(victim_pid, signal.SIGKILL)
+
+            deadline = time.monotonic() + 300
+            while router.has_unfinished:
+                assert time.monotonic() < deadline, "drain wedged"
+                drain_one_pass()
+
+            # every request finished, and every output — including the
+            # rerouted victims' — is bit-identical to the never-killed
+            # reference engine
+            assert set(got) == set(reference)
+            assert got == reference
+            assert victim_rids, "chaos did not catch in-flight work"
+            assert router.stats["failovers"] >= 1
+            assert router.stats["reroutes"] >= len(victim_rids)
+
+            # the breaker replaced the dead process via the factory:
+            # same stable fleet name, NEW pid, serving again
+            assert victim.live and victim.engine is not None
+            assert victim.engine.pid != victim_pid
+            assert victim.engine.pid != survivor.engine.pid
+
+            # the replacement reintegrates WARM: route it fresh work,
+            # then read its own registry — every executable it
+            # instantiated came from the persistent store
+            # (outcome=disk_hit pinned, zero fresh compiles)
+            for i, p in enumerate(prompts):
+                router.submit(f"w{i}", p, max_new_tokens=N_NEW)
+            got2 = {}
+            deadline = time.monotonic() + 300
+            while router.has_unfinished:
+                assert time.monotonic() < deadline, "drain wedged"
+                for r in router.step():
+                    assert r.ok, (r.request_id, r.error)
+                    got2[r.request_id] = tuple(
+                        int(t) for t in r.output_ids)
+            assert {k[1:] for k in got2} == {k[1:] for k in reference}
+            for rid, toks in got2.items():
+                assert toks == reference["r" + rid[1:]]
+
+            outcomes = victim.engine.compile_outcomes()
+            assert outcomes, "replacement replica never ran"
+            assert all(out == "disk_hit" for _fam, out in outcomes)
+            stats = victim.engine.exec_cache_stats()
+            assert stats["hits"] > 0
+            assert stats["misses"] == 0 and stats["saves"] == 0
+        finally:
+            for h in router.replicas.handles:
+                eng = h.engine
+                if eng is not None:
+                    try:
+                        eng.shutdown(timeout_s=10)
+                    except Exception:
+                        pass
+
+
+# ---------------------------------------------------------------------------
+# obs_top fleet panel: per-process rows (pid, role, capacity, cache)
+# ---------------------------------------------------------------------------
+class TestObsTopFleetProcessRows:
+    def _obs_top(self):
+        tools = os.path.join(REPO, "tools")
+        sys.path.insert(0, tools)
+        try:
+            import obs_top
+        finally:
+            sys.path.remove(tools)
+        return obs_top
+
+    def _engine_delta(self, compiles, disk_hits, requests, tokens):
+        """A metrics delta shaped like a serving worker's bundle."""
+        from paddle_tpu.observability import fleet, metrics as om
+        obs.reset()
+        obs.enable()
+        c, _ = om.compile_metrics()
+        for _ in range(compiles):
+            c.labels(family="engine_ragged", outcome="compile").inc()
+        for _ in range(disk_hits):
+            c.labels(family="engine_ragged", outcome="disk_hit").inc()
+        om.registry().counter(
+            "paddle_tpu_request_finished_total",
+            "requests by terminal reason",
+            ("reason",)).labels(reason="length").inc(requests)
+        om.registry().counter(
+            "paddle_tpu_engine_events_total", "engine events",
+            ("event",)).labels(event="decode_tokens").inc(tokens)
+        md = fleet.delta_snapshot(om.registry().snapshot(), None)
+        obs.reset()
+        return md
+
+    def test_renders_process_rows(self):
+        obs_top = self._obs_top()
+        from paddle_tpu.observability import fleet
+        agg = fleet.FleetAggregator()
+        try:
+            agg.ingest(fleet.make_bundle(
+                "engine-0", "engine", 1,
+                metrics_delta=self._engine_delta(2, 0, 3, 24),
+                heartbeat_extra={"pid": 4242}))
+            time.sleep(0.05)    # a real capacity window
+            agg.ingest(fleet.make_bundle(
+                "engine-0", "engine", 2,
+                metrics_delta=self._engine_delta(0, 3, 5, 40),
+                heartbeat_extra={"pid": 4242}))
+            doc = json.loads(agg.to_json())
+            frame = obs_top.render(doc)
+            assert "== replicas ==" in frame
+            row = [ln for ln in frame.splitlines()
+                   if "engine-0" in ln][0]
+            assert "pid=4242" in row
+            assert "engine" in row
+            assert "cache hit=3 compile=2" in row
+            assert "req/s=" in row and "req/s=     -" not in row
+        finally:
+            agg.close()
+
+    def test_compiles_panel_splits_disk_hits(self):
+        obs_top = self._obs_top()
+        obs.enable()
+        from paddle_tpu.observability import metrics as om
+        c, _ = om.compile_metrics()
+        c.labels(family="engine_ragged", outcome="compile").inc()
+        c.labels(family="engine_ragged", outcome="disk_hit").inc(2)
+        frame = obs_top.render(json.loads(obs.to_json()))
+        line = [ln for ln in frame.splitlines()
+                if "engine_ragged" in ln][0]
+        assert "(disk_hit=2)" in line

@@ -472,11 +472,13 @@ class TestEngineRequestTracing:
         # -- compile telemetry agrees with the dispatch caches --
         comp = _series("paddle_tpu_compile_total")
         engine_compiles = sum(
-            v for (fam,), v in comp.items() if fam.startswith("engine"))
+            v for (fam, _out), v in comp.items()
+            if fam.startswith("engine"))
         assert engine_compiles == len(eng._fns)
         # prefix caching + preemption means the pool-reading ragged
         # variant compiled (prefix-resume rides the ragged family now)
-        assert comp[("engine_ragged",)] >= 1
+        assert sum(v for (fam, _out), v in comp.items()
+                   if fam == "engine_ragged") >= 1
         ct = _series("paddle_tpu_compile_seconds")
         assert sum(v["count"] for v in ct.values()) == engine_compiles
 
@@ -615,11 +617,11 @@ class TestCompileFamilyBudget:
         # zero-valued rows are label sets other tests registered before
         # obs.reset() (reset zeroes values but keeps series) — only
         # families that actually compiled THIS workload count
-        fams = {fam for (fam,), v in comp.items() if v}
+        fams = {fam for (fam, _out), v in comp.items() if v}
         # the whole point: TWO engine families, nothing else
         assert fams <= {"engine_ragged", "engine_decode"}, fams
         assert "engine_ragged" in fams
-        engine_compiles = sum(v for (fam,), v in comp.items()
+        engine_compiles = sum(v for (fam, _out), v in comp.items()
                               if fam.startswith("engine"))
         # counter == executable cache (no recompiles, no untimed fns)
         assert engine_compiles == len(eng._fns), (

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Operator CLI for the persistent executable store
+(`paddle_tpu.inference.exec_cache`).
+
+    python tools/exec_cache.py <dir>                 # list entries
+    python tools/exec_cache.py <dir> --verify        # integrity audit
+    python tools/exec_cache.py <dir> --prune \\
+        [--max-age-days N] [--max-bytes BYTES]       # evict
+    python tools/exec_cache.py <dir> --json          # machine-readable
+
+Listing shows each entry's key, compile family, payload bytes, device
+fingerprint summary and age. --verify re-hashes every payload against
+its manifest (the same check the engine's load path runs) and exits 1
+if any entry is torn/corrupt — the store's writes are atomic
+(tmp+fsync+rename, manifest last), so a bad entry means bit rot or a
+foreign writer, not a crashed save. --prune drops by age then by
+total-size cap (oldest first) and reaps stale staging files.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from paddle_tpu.inference.exec_cache import ExecCache  # noqa: E402
+
+
+def _fmt_age(s: float) -> str:
+    if s < 120:
+        return "%.0fs" % s
+    if s < 7200:
+        return "%.0fm" % (s / 60)
+    if s < 172800:
+        return "%.1fh" % (s / 3600)
+    return "%.1fd" % (s / 86400)
+
+
+def _fmt_device(dev: dict) -> str:
+    if not dev:
+        return "?"
+    parts = ["%s x%s" % (dev.get("device_kind", "?"),
+                         dev.get("n_local_devices", "?")),
+             "jax " + str(dev.get("jax", "?"))]
+    if "mesh_shape" in dev:
+        parts.append("mesh " + "x".join(
+            str(s) for s in dev["mesh_shape"]))
+    return ", ".join(parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="list / verify / prune a persistent executable "
+                    "store directory")
+    ap.add_argument("dir", help="store directory "
+                    "(e.g. $PADDLE_TPU_EXEC_CACHE)")
+    ap.add_argument("--verify", action="store_true",
+                    help="re-hash every payload against its manifest; "
+                         "exit 1 on any corrupt/torn entry")
+    ap.add_argument("--prune", action="store_true",
+                    help="evict entries per --max-age-days / "
+                         "--max-bytes and reap stale staging files")
+    ap.add_argument("--max-age-days", type=float, default=None,
+                    help="with --prune: drop entries older than this")
+    ap.add_argument("--max-bytes", type=int, default=None,
+                    help="with --prune: evict oldest-first until the "
+                         "store fits under this many payload bytes")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.dir):
+        print("exec_cache: no such directory: %s" % args.dir,
+              file=sys.stderr)
+        return 1
+    store = ExecCache(args.dir)
+
+    if args.prune:
+        max_age_s = (args.max_age_days * 86400.0
+                     if args.max_age_days is not None else None)
+        removed = store.prune(max_age_s=max_age_s,
+                              max_bytes=args.max_bytes)
+        if args.json:
+            print(json.dumps({"pruned": removed}, indent=2))
+        else:
+            for k in removed:
+                print("pruned %s" % k)
+            print("exec_cache: pruned %d of %d entries"
+                  % (len(removed), len(removed) + len(store.keys())))
+        return 0
+
+    recs = store.entries()
+    bad = 0
+    if args.verify:
+        for r in recs:
+            ok, why = store.verify(r["key"])
+            r["ok"] = ok
+            r["why"] = why
+            bad += 0 if ok else 1
+
+    if args.json:
+        print(json.dumps({"root": store.root, "entries": recs},
+                         indent=2, sort_keys=True))
+        return 1 if bad else 0
+
+    if not recs:
+        print("exec_cache: %s is empty" % store.root)
+        return 0
+    total = sum(r["payload_bytes"] for r in recs)
+    print("exec_cache: %d entries, %.1f MB in %s"
+          % (len(recs), total / 1e6, store.root))
+    for r in recs:
+        line = "  %s  %-16s %9.2f MB  %-5s  %s" % (
+            r["key"][:16], r["family"] or "?",
+            r["payload_bytes"] / 1e6, _fmt_age(r["age_s"]),
+            _fmt_device(r["device"]))
+        if args.verify:
+            line += "  OK" if r["ok"] else "  BAD (%s)" % r["why"]
+        print(line)
+    if bad:
+        print("exec_cache: %d corrupt entries (run --prune or remove "
+              "them; the engine load path already refuses them)" % bad)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

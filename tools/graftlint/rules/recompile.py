@@ -30,6 +30,17 @@ _KEYFN_RE = re.compile(
     r"fingerprint|cache_key|cachekey|hyper_fp|(^|_)fp$|_key$")
 # container names that are executable/compile caches
 _CACHE_RE = re.compile(r"cache|_fns$|_executables?$", re.IGNORECASE)
+# dict verbs through which a key reaches an in-memory cache
+_DICT_METHODS = ("get", "setdefault", "pop")
+# the persistent-store surface (exec_cache.ExecCache and kin): keys
+# passed to these verbs reach DISK, where an unstable component is
+# strictly worse than an in-memory one — a repr()-keyed entry is
+# never hit again AND accumulates forever. Receivers are matched more
+# broadly (*cache*/*store*) because the verbs themselves are specific;
+# plain identity maps (e.g. an id()-keyed node_store dict) don't
+# speak this surface.
+_STORE_RE = re.compile(r"cache|store", re.IGNORECASE)
+_STORE_METHODS = ("load", "save", "put", "verify", "remove")
 
 
 def _unstable_why(node) -> str:
@@ -62,6 +73,16 @@ def _cache_name(node) -> bool:
         return False
     leaf = d.split(".")[-1]
     return bool(_CACHE_RE.search(leaf))
+
+
+def _store_name(node) -> bool:
+    """`node` names a persistent-store-like object (`store.load(...)`,
+    `self._exec_cache.save(...)`)."""
+    d = U.dotted(node)
+    if not d:
+        return False
+    leaf = d.split(".")[-1]
+    return bool(_STORE_RE.search(leaf))
 
 
 @register
@@ -101,8 +122,11 @@ class UnstableCacheKey(Rule):
                     key_exprs.append(node.slice)
                 elif isinstance(node, ast.Call) and \
                         isinstance(node.func, ast.Attribute) and \
-                        node.func.attr in ("get", "setdefault", "pop") \
-                        and _cache_name(node.func.value) and node.args:
+                        ((node.func.attr in _DICT_METHODS
+                          and _cache_name(node.func.value))
+                         or (node.func.attr in _STORE_METHODS
+                             and _store_name(node.func.value))) \
+                        and node.args:
                     key_exprs.append(node.args[0])
                 for ke in key_exprs:
                     for sub in ast.walk(ke):

@@ -292,13 +292,43 @@ def render(doc, prev=None, dt=None) -> str:
     for s in _series(doc, "paddle_tpu_router_replica_state"):
         if s["value"]:
             states[s["labels"]["replica"]] = s["labels"]["state"]
-    if states:
+    # per-PROCESS rows (fleet-merged docs: replicas running as real OS
+    # processes): pid + role from the heartbeat join series, capacity
+    # rates from the aggregator's capacity gauges, exec-cache
+    # reintegration split from the merged compile counter
+    procs = {}
+    for s in _series(doc, "paddle_tpu_fleet_process_pid"):
+        procs[s["labels"]["process"]] = {
+            "pid": int(s["value"]),
+            "role": s["labels"].get("role", "")}
+    if states or procs:
         lines.append("== replicas ==")
         for rep in sorted(states):
             infl = _value(doc, "paddle_tpu_router_replica_inflight",
                           replica=rep)
             lines.append(f"  {rep:<12} {states[rep]:<10} "
                          f"inflight={int(infl or 0)}")
+        for proc in sorted(procs):
+            info = procs[proc]
+            req = _value(doc, "paddle_tpu_fleet_capacity_req_per_s",
+                         process=proc)
+            tok = _value(doc, "paddle_tpu_fleet_capacity_tok_per_s",
+                         process=proc)
+            hit = _counter_sum(doc, "paddle_tpu_compile_total",
+                               process=proc, outcome="disk_hit")
+            miss = _counter_sum(doc, "paddle_tpu_compile_total",
+                                process=proc, outcome="compile")
+            row = (f"  {proc:<12} pid={info['pid']:<7} "
+                   f"{info['role']:<8}")
+            row += (f" req/s={req:6.2f}" if req is not None
+                    else " req/s=     -")
+            row += (f" tok/s={tok:7.1f}" if tok is not None
+                    else " tok/s=      -")
+            if hit or miss:
+                row += (f"  cache hit={int(hit)} "
+                        f"compile={int(miss)}")
+            lines.append(row)
+    if states:
         fo = _counter_sum(doc, "paddle_tpu_router_failovers_total")
         rr = _counter_sum(doc, "paddle_tpu_router_reroutes_total")
         totals = f"  failovers={int(fo)}  reroutes={int(rr)}"
@@ -488,9 +518,18 @@ def render(doc, prev=None, dt=None) -> str:
     comp = _series(doc, "paddle_tpu_compile_total")
     if comp:
         lines.append("== compiles ==")
-        for s in sorted(comp, key=lambda s: s["labels"]["family"]):
-            lines.append(f"  {s['labels']['family']:<20} "
-                         f"{int(s['value']):>4}")
+        fams = {}
+        for s in comp:
+            lbl = s["labels"]
+            slot = fams.setdefault(lbl["family"], {})
+            out = lbl.get("outcome", "compile")
+            slot[out] = slot.get(out, 0.0) + s["value"]
+        for fam in sorted(fams):
+            slot = fams[fam]
+            row = f"  {fam:<20} {int(sum(slot.values())):>4}"
+            if slot.get("disk_hit"):
+                row += f"  (disk_hit={int(slot['disk_hit'])})"
+            lines.append(row)
 
     hbm_pool = _series(doc, "paddle_tpu_hbm_page_pool_bytes")
     hbm_live = _value(doc, "paddle_tpu_hbm_live_array_bytes")

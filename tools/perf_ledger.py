@@ -48,7 +48,14 @@ hot path in this repo is bandwidth-dominated, see BENCH_EXTRA).
     `capacity.tok_per_s` follow the bytes/s rule — a role's achieved
     rate dropping below (1 - tol) x the best prior-revision record
     fails, naming the role the elastic scaler is about to mis-size
-    from.
+    from;
+  * the router_serving record's `reintegration` block (bench.py's
+    cold-vs-warm process-fleet phase over the persistent executable
+    store) is a cost mirror: `warm_over_cold` rising above (1 + tol)
+    x the best prior-revision ratio AND past an absolute floor fails,
+    and `warm_skipped_all_compiles=false` fails outright — a warm
+    replacement re-compiling executables it should have disk-loaded
+    is the store not working, not a slow box.
 
 Records keep absolute achieved rates, so cross-revision diffs carry
 the same box-noise caveat as any non-interleaved comparison — the
@@ -126,6 +133,21 @@ def _numerics_ratio(rec):
     if not isinstance(num, dict):
         return None
     v = num.get("overhead_ratio")
+    return float(v) if v is not None else None
+
+
+# warm/cold fleet-reintegration is a wall-clock ratio on a noisy box
+# (process spawn + RPC + deserialize over a spawn + RPC + compile
+# baseline): require the regression to clear an absolute floor on top
+# of the relative tolerance, the NUMERICS_OVERHEAD_FLOOR idiom
+REINTEGRATION_FLOOR_RATIO = 0.05
+
+
+def _reint_ratio(rec):
+    reint = rec.get("reintegration")
+    if not isinstance(reint, dict):
+        return None
+    v = reint.get("warm_over_cold")
     return float(v) if v is not None else None
 
 
@@ -251,6 +273,45 @@ def check(records, tol: float, only_config=None) -> dict:
                     nout["regressed"] = True
                     out["pass"] = False
             out["numerics"] = nout
+        # fleet warm-reintegration regression (router_serving's
+        # process-fleet phase): warm_over_cold is the fraction of a
+        # cold fleet bring-up a WARM replacement still pays — a COST,
+        # so the gap/numerics mirror rule: latest above (1 + tol) x
+        # the best (lowest) prior-revision ratio AND past an absolute
+        # floor fails. A warm pass that re-compiled anything it
+        # should have disk-loaded (warm_skipped_all_compiles false)
+        # fails outright — that is the persistent store silently not
+        # working, not a slow box.
+        cur_reint = _reint_ratio(latest)
+        if cur_reint is not None:
+            reint = latest.get("reintegration") or {}
+            rout = {"warm_over_cold": cur_reint,
+                    "cold_s": reint.get("cold_s"),
+                    "warm_s": reint.get("warm_s"),
+                    "warm_skipped_all_compiles":
+                        reint.get("warm_skipped_all_compiles"),
+                    "ratio_vs_history": None, "baseline_rev": None,
+                    "regressed": False}
+            if reint.get("warm_skipped_all_compiles") is False:
+                rout["regressed"] = True
+                out["pass"] = False
+            prior = [(_reint_ratio(prev), prev.get("rev"))
+                     for prev in history]
+            prior = [p for p in prior if p[0] is not None]
+            other_rev = [p for p in prior if p[1] != latest.get("rev")]
+            pool = other_rev or prior
+            if pool:
+                best_r, best_rev = min(pool)
+                if best_r > 0:
+                    rout["ratio_vs_history"] = round(
+                        cur_reint / best_r, 4)
+                rout["baseline_rev"] = best_rev
+                if best_rev != latest.get("rev") and cur_reint > max(
+                        best_r * (1.0 + tol),
+                        best_r + REINTEGRATION_FLOOR_RATIO):
+                    rout["regressed"] = True
+                    out["pass"] = False
+            out["reintegration"] = rout
         # fleet capacity regression: achieved rates are the bytes/s
         # rule again — the latest record's req/s / tok/s below
         # (1 - tol) x the best prior-revision record for the same
@@ -327,6 +388,16 @@ def trajectory(records) -> str:
                 f"overhead=x{nr:.4f}"
                 + (f" grad_norm={gnorm:.4g}" if gnorm is not None
                    else ""))
+        rr = _reint_ratio(rec)
+        if rr is not None:
+            reint = rec.get("reintegration") or {}
+            lines.append(
+                f"{ckey:<22} {rec.get('rev', '?'):<19} "
+                f"{'(reintegration)':<16} "
+                f"warm/cold=x{rr:.4f} "
+                f"cold={reint.get('cold_s', '-')}s "
+                f"warm={reint.get('warm_s', '-')}s "
+                f"all_disk_hits={reint.get('warm_skipped_all_compiles')}")
         cap = rec.get("capacity")
         if isinstance(cap, dict):
             req, tok = cap.get("req_per_s"), cap.get("tok_per_s")
