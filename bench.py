@@ -1373,6 +1373,348 @@ def bench_router_serving(on_tpu):
     }
 
 
+def bench_traffic(on_tpu):
+    """The serving SLO control plane acceptance experiment: the SAME
+    heavy-tailed many-user schedule (bursty on/off Poisson arrivals,
+    lognormal prompt/output tails, multi-turn shared-prefix sessions —
+    inference.traffic.TrafficModel, fixed seed) driven twice against
+    the Router fleet:
+
+      A. a STATIC max-size fleet (n_replicas = the scaling ceiling);
+      B. an AUTOSCALED fleet starting at 1 replica, grown/retired by
+         the SLO-driven Autoscaler reading a windowed FleetSLOMonitor
+         over the live registry.
+
+    On CPU the replicas are REAL OS PROCESSES
+    (inference.replica_proc.process_engine_factory): each worker
+    computes in its own process and the router steps the fleet
+    concurrently, so fleet size buys actual throughput and the A/B
+    measures capacity, not batch slots. Worker TTFT histograms ride
+    FleetAgent bundles to one aggregator; each phase uses its own
+    fleet name prefix, so the bench reads any phase's fleet-wide
+    TTFT distribution from the aggregator's process-merged series
+    after the workers' farewell flush. The autoscaled leg grows
+    through an ASYNC actuator: scan() kicks a background spawn and
+    returns None (the Autoscaler journals the abort and retries on
+    its streaks) until the ready client attaches through
+    `add_replica(engine_factory=...)` in O(ms) — growth never stalls
+    the serving loop. On TPU the replicas stay in-process (they
+    share one device population), stepped sequentially over shared
+    batch slots.
+
+    Both legs share one persistent executable store (a grown replica
+    reintegrates warm — growth costs process/pool setup, not XLA),
+    and the SLO threshold is calibrated from an uncontended warm-up
+    phase so the bench measures queueing, not box speed. Headline
+    value = the capacity-planning line req/s per replica AT the SLO
+    (autoscaled leg's ok-requests over its replica-seconds);
+    vs_baseline = static replica-seconds over autoscaled
+    replica-seconds (> 1 means the autoscaler met demand on less
+    fleet). extra carries both legs' TTFT p95 / SLO attainment,
+    per-cohort accounting and every committed scale decision."""
+    import json
+    import tempfile
+    import threading
+
+    import jax
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import fleet as ofleet
+    from paddle_tpu.observability import metrics as _m
+    from paddle_tpu.observability import slo, slo_fleet
+    from paddle_tpu.inference import (Autoscaler, LLMEngine, Router,
+                                      RouterActuator, TrafficModel,
+                                      run_traffic)
+    from paddle_tpu.inference.replica_proc import process_engine_factory
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if on_tpu:
+        kw = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                  num_heads=16, max_position_embeddings=2048,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        max_batch, block_size, chunk, quantum = 8, 64, 16, 128
+        num_blocks, max_prompt, n_new_cap = 120, 768, 64
+        n_events, max_replicas = 120, 3
+    else:
+        kw = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                  num_heads=4, max_position_embeddings=256,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        max_batch, block_size, chunk, quantum = 4, 16, 4, 16
+        num_blocks, max_prompt, n_new_cap = 48, 96, 32
+        n_events, max_replicas = 300, 3
+    # the SLO control plane IS the observability plane: the monitor
+    # reads the request histograms and the autoscaler reads the
+    # monitor, so this config forces recording on even under --no-obs
+    obs.enable()
+    store = tempfile.mkdtemp(prefix="paddle_tpu_traffic_store_")
+    proc_fleet = not on_tpu
+    engine_kw = dict(max_batch=max_batch, block_size=block_size,
+                     num_blocks=num_blocks, decode_chunk=chunk,
+                     prompt_quantum=quantum,
+                     max_model_len=kw["max_position_embeddings"])
+
+    tm = TrafficModel(seed=7, base_rate=3.0, burst_rate=30.0,
+                      off_s=2.0, on_s=1.5, max_body=max_prompt,
+                      max_out=n_new_cap)
+    evs = list(tm.events(n_events))
+
+    agg = None
+    if proc_fleet:
+        agg = ofleet.serve_aggregator(stale_after_s=600.0)
+
+        def make_factory(prefix):
+            return process_engine_factory(
+                _proc_fleet_model, model_kwargs=kw,
+                engine_kwargs=engine_kw, exec_cache_dir=store,
+                aggregator_endpoint=agg.endpoint,
+                name_prefix=prefix)
+
+        def shutdown_fleet(router):
+            for h in list(router.replicas):
+                try:
+                    if h.engine is not None:
+                        h.engine.shutdown()
+                except Exception:
+                    pass
+
+        def ttft_stats(prefix, threshold):
+            """Fleet-wide TTFT for one phase: sum the aggregator's
+            process-labeled bucket vectors over that phase's name
+            prefix (the slo_fleet merge idiom, scoped)."""
+            doc = json.loads(agg.registry.to_json())
+            rec = doc.get("paddle_tpu_request_ttft_seconds")
+            buckets, lo, hi = None, None, None
+            for s in (rec or {}).get("series", ()):
+                pname = str(s["labels"].get("process", ""))
+                if not pname.startswith(prefix):
+                    continue
+                v = s["value"]
+                if buckets is None:
+                    buckets = list(v["buckets"])
+                    lo, hi = v["min"], v["max"]
+                else:
+                    buckets = [a + b for a, b in
+                               zip(buckets, v["buckets"])]
+                    if v["min"] is not None:
+                        lo = v["min"] if lo is None \
+                            else min(lo, v["min"])
+                    if v["max"] is not None:
+                        hi = v["max"] if hi is None \
+                            else max(hi, v["max"])
+            if not buckets or not sum(buckets):
+                return {"p50_s": None, "p95_s": None,
+                        "attained": None, "count": 0}
+            return {
+                "p50_s": round(_m.quantile_from_buckets(
+                    rec["buckets"], buckets, 0.5, lo=lo, hi=hi), 4),
+                "p95_s": round(_m.quantile_from_buckets(
+                    rec["buckets"], buckets, 0.95, lo=lo, hi=hi), 4),
+                "attained": round(_m.fraction_le(
+                    rec["buckets"], buckets, threshold, hi=hi), 4),
+                "count": int(sum(buckets)),
+            }
+    else:
+        cfg = GPTConfig(**kw)
+        model = GPTForCausalLM(cfg).bfloat16()
+        model.eval()
+
+        def make_factory(prefix):
+            def factory(_i):
+                return LLMEngine(model, exec_cache_dir=store,
+                                 **engine_kw)
+            return factory
+
+        def shutdown_fleet(router):
+            pass
+
+        def ttft_stats(prefix, threshold):
+            h = _m.registry().get("paddle_tpu_request_ttft_seconds")
+            child = h._children.get(()) if h is not None else None
+            if child is None or not child._count:
+                return {"p50_s": None, "p95_s": None,
+                        "attained": None, "count": 0}
+            return {
+                "p50_s": round(child.quantile(0.5), 4),
+                "p95_s": round(child.quantile(0.95), 4),
+                "attained": round(_m.fraction_le(
+                    child._bounds, child._buckets, threshold,
+                    hi=child._max), 4),
+                "count": child._count,
+            }
+
+    class _AsyncGrowActuator(RouterActuator):
+        """grow() never blocks the serving loop: the first call kicks
+        a background worker spawn and returns None — the Autoscaler
+        journals the abort WITHOUT resetting its breach streak and
+        retries next scan — until the ready client attaches through
+        the router's engine_factory override in O(ms)."""
+
+        def __init__(self, router, factory):
+            super().__init__(router)
+            self._factory = factory
+            self._lock = threading.Lock()
+            self.ready = []
+            self._spawning = False
+            self._next_idx = 100     # grown replicas' index namespace
+
+        def grow(self):
+            with self._lock:
+                if self.ready:
+                    client = self.ready.pop()
+                    return self.router.add_replica(
+                        engine_factory=lambda _i, c=client: c)
+                if not self._spawning:
+                    self._spawning = True
+                    idx = self._next_idx
+                    self._next_idx += 1
+                    threading.Thread(target=self._spawn, args=(idx,),
+                                     daemon=True).start()
+            return None
+
+        def _spawn(self, idx):
+            try:
+                client = self._factory(idx)
+            except Exception:
+                client = None
+            with self._lock:
+                if client is not None:
+                    self.ready.append(client)
+                self._spawning = False
+
+    # warm-up, two phases: (1) a throwaway replica floods the
+    # schedule's head so every executable shape lands in the shared
+    # store; (2) a FRESH warm-store replica serves a few SERIAL
+    # requests whose uncontended TTFT calibrates the SLO threshold —
+    # the bench then measures queueing under load, not this box's
+    # absolute speed. (Separate fleet prefixes: the flood's
+    # compile-stalled TTFTs must not pollute the calibration read.)
+    obs.reset()
+    warm_router = Router(make_factory("traffic-warm"), n_replicas=1,
+                         max_inflight=64)
+    run_traffic(warm_router, evs[:20], time_scale=0.0,
+                max_prompt=max_prompt)
+    shutdown_fleet(warm_router)
+    obs.reset()
+    cal_router = Router(make_factory("traffic-cal"), n_replicas=1,
+                        max_inflight=64)
+    for j, ev in enumerate(evs[20:28]):
+        cal_router.submit(("warm", j), ev.prompt[:max_prompt],
+                          max_new_tokens=4)
+        while cal_router.has_unfinished:
+            cal_router.step()
+    shutdown_fleet(cal_router)
+    warm = ttft_stats("traffic-cal", 1.0)
+    # threshold off the warm MEDIAN (the p95 is one first-touch
+    # executable deserialize, not steady state): a request whose
+    # first token took this many times the uncontended median sat
+    # in a queue
+    thr = max(0.3, 10.0 * (warm["p50_s"] or 0.05))
+    objective = 0.9
+    # compress the schedule: the burst phases must exceed one
+    # replica's capacity (or the controller has nothing to do) while
+    # staying inside the max-size fleet's
+    time_scale = 1.0 if proc_fleet else 0.5
+
+    def leg(tag, autoscaled):
+        obs.reset()
+        prefix = "traffic-%s" % tag
+        factory = make_factory(prefix)
+        router = Router(
+            factory, n_replicas=1 if autoscaled else max_replicas,
+            max_inflight=64)
+        if not proc_fleet:
+            # in-process replicas share the parent registry: warm each
+            # leg's STARTING replicas off the clock so first-touch
+            # executable loads don't masquerade as queueing in the
+            # static baseline, then zero the local series (the proc
+            # fleet doesn't need this — workers load warm from the
+            # store and each leg reads its own fleet prefix)
+            for h in router.replicas:
+                h.engine.generate([ev.prompt[:max_prompt]
+                                   for ev in evs[:6]],
+                                  max_new_tokens=2)
+            obs.reset()
+        asc = None
+        actu = None
+        if autoscaled:
+            mon = slo_fleet.FleetSLOMonitor(
+                agg=agg, min_count=3,
+                flight_on_breach=False, rules=[
+                    slo.SLO("ttft_p95",
+                            "paddle_tpu_request_ttft_seconds",
+                            threshold_s=thr, objective=objective)])
+            # prime the window so earlier phases' cumulative series
+            # don't read as this leg's first delta
+            mon.evaluate()
+            actu = (_AsyncGrowActuator(router, factory) if proc_fleet
+                    else RouterActuator(router))
+            asc = Autoscaler(actu, mon,
+                             min_replicas=1, max_replicas=max_replicas,
+                             grow_after=2, retire_after=16,
+                             cooldown_scans=8)
+        rep = run_traffic(router, evs, autoscaler=asc,
+                          scan_every_s=0.25 if proc_fleet else 0.1,
+                          time_scale=time_scale,
+                          max_prompt=max_prompt)
+        shutdown_fleet(router)
+        if actu is not None and getattr(actu, "ready", None):
+            for client in actu.ready:    # spawned but never attached
+                try:
+                    client.shutdown()
+                except Exception:
+                    pass
+        rep["ttft"] = ttft_stats(prefix, thr)
+        rep["slo_met"] = (rep["ttft"]["attained"] is not None
+                          and rep["ttft"]["attained"] >= objective)
+        return rep
+
+    try:
+        rep_static = leg("static", autoscaled=False)
+        rep_auto = leg("auto", autoscaled=True)
+    finally:
+        if agg is not None:
+            agg.close()
+    cap = rep_auto["ok"] / max(rep_auto["replica_seconds"], 1e-9)
+    return {
+        "metric": "traffic_req_per_replica_s_at_slo",
+        "value": round(cap, 4),
+        "unit": "req/s/replica",
+        "vs_baseline": round(
+            rep_static["replica_seconds"]
+            / max(rep_auto["replica_seconds"], 1e-9), 4),
+        "extra": {
+            "slo": {"metric": "paddle_tpu_request_ttft_seconds",
+                    "threshold_s": round(thr, 4),
+                    "objective": objective,
+                    "calibration_warm_p95_s": warm["p95_s"]},
+            "static": {
+                "replicas": max_replicas,
+                "replica_seconds": round(
+                    rep_static["replica_seconds"], 2),
+                "ttft": rep_static["ttft"],
+                "slo_met": rep_static["slo_met"],
+                "req_per_s": round(rep_static["req_per_s"], 3),
+                "shed_rate": round(rep_static["shed_rate"], 4),
+                "cohorts": rep_static["cohorts"],
+            },
+            "autoscaled": {
+                "max_replicas": max_replicas,
+                "replica_seconds": round(
+                    rep_auto["replica_seconds"], 2),
+                "ttft": rep_auto["ttft"],
+                "slo_met": rep_auto["slo_met"],
+                "req_per_s": round(rep_auto["req_per_s"], 3),
+                "shed_rate": round(rep_auto["shed_rate"], 4),
+                "cohorts": rep_auto["cohorts"],
+                "decisions": rep_auto.get("decisions", []),
+            },
+            "events": n_events,
+            "device": str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform)),
+        },
+    }
+
+
 def bench_comms(on_tpu):
     """Collective microbench sweep (op x payload size) over the full
     device mesh (main() forces the 8-device CPU mesh when the config is
@@ -1754,6 +2096,7 @@ CONFIGS = {
     "prefix_serving": bench_prefix_serving,
     "spec_decode": bench_spec_decode,
     "router_serving": bench_router_serving,
+    "traffic": bench_traffic,
     "autopilot": bench_autopilot,
 }
 
@@ -1925,14 +2268,34 @@ def _append_perf_ledger(path, name, result, modes=None):
         sweeps = _autotune.drain_sweeps()
     except Exception:
         sweeps = []
+    # the traffic config's capacity-planning summary rides the ledger
+    # (req/s per replica at SLO history for tools/perf_ledger.py);
+    # its engines compile inside worker processes, so the parent has
+    # no perf families for it to ride on — carry it explicitly
+    extra = result.get("extra") or {}
+    traffic = None
+    if name == "traffic" and "slo" in extra:
+        def _leg(d):
+            return {k: d.get(k) for k in
+                    ("replica_seconds", "slo_met", "req_per_s",
+                     "shed_rate", "ttft")}
+        traffic = {
+            "slo": extra["slo"],
+            "autoscaled": _leg(extra.get("autoscaled") or {}),
+            "static": _leg(extra.get("static") or {}),
+            "decisions": len((extra.get("autoscaled") or {})
+                             .get("decisions") or []),
+        }
     if not records:
-        if not sweeps:
+        if not sweeps and traffic is None:
             return None
         rec = dict(base)
         rec["families"] = {}
         records.append(rec)
     if sweeps:
         records[0]["autotune_sweeps"] = sweeps
+    if traffic is not None:
+        records[0]["traffic"] = traffic
     # fleet warm-reintegration summary (router_serving's process-
     # fleet phase) rides the record so tools/perf_ledger.py --check
     # can baseline the warm/cold ratio like the other cost mirrors
@@ -2088,7 +2451,7 @@ def main():
                     help=argparse.SUPPRESS)   # internal: --gate child
     args = ap.parse_args()
 
-    if args.config in ("comms", "embedding") and not args.all:
+    if args.config in ("comms", "embedding", "traffic") and not args.all:
         # the comms sweep and the sharded-embedding exchange want the
         # 8-device mesh; on a CPU box that
         # means the forced host-platform device count, and it must be
@@ -2117,7 +2480,7 @@ def main():
     from paddle_tpu import observability as obs
     names = list(CONFIGS) if args.all else [args.config]
     for name in names:
-        if name in ("comms", "embedding") and args.all:
+        if name in ("comms", "embedding", "traffic") and args.all:
             # device topology is process-global: these configs' forced
             # 8-device mesh must not re-topology the other configs of
             # an --all run, so each gets its own process (which
@@ -2136,10 +2499,15 @@ def main():
                 print(line, flush=True)
             else:
                 print(json.dumps({
-                    "metric": ("comms_bytes_per_sec" if name == "comms"
-                               else "embedding_lookup_rows_per_sec"),
+                    "metric": {
+                        "comms": "comms_bytes_per_sec",
+                        "embedding": "embedding_lookup_rows_per_sec",
+                        "traffic": "traffic_req_per_replica_s_at_slo",
+                    }[name],
                     "value": None,
-                    "unit": "bytes/s" if name == "comms" else "rows/s",
+                    "unit": {"comms": "bytes/s",
+                             "embedding": "rows/s",
+                             "traffic": "req/s/replica"}[name],
                     "vs_baseline": 0.0,
                     "extra": {"error": f"{name} child failed",
                               "rc": child.returncode,

@@ -151,3 +151,11 @@ from .speculative import (SpeculativeConfig,  # noqa: E402,F401
 # (prefix-cache affinity, failover, circuit breaking, load shedding)
 from .router import (Router, ReplicaSet,  # noqa: E402,F401
                      ReplicaHandle, ReplicaGone)
+# serving SLO control plane: SLO-driven elastic autoscaling over the
+# router's add_replica/retire_replica surface, plus the heavy-tailed
+# traffic harness that exercises it (see README "Serving SLO control
+# plane")
+from .autoscaler import (Autoscaler, RouterActuator,  # noqa: E402,F401
+                         SCALE_ACTIONS)
+from .traffic import (Cohort, TrafficModel,  # noqa: E402,F401
+                      TrafficEvent, run_traffic)

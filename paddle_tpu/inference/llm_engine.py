@@ -149,6 +149,22 @@ def _metrics():
                 "paddle_tpu_request_ttft_seconds",
                 "per-request time to first token: enqueue -> first "
                 "sampled token (includes queue wait and prefill)"),
+            "ttft_budget": r.histogram(
+                "paddle_tpu_request_ttft_budget_seconds",
+                "per-request TTFT latency-budget decomposition, one "
+                "observation per component when the first token lands:"
+                " queue_wait = (re)enqueue -> admission, summed across"
+                " requeues; prefill_compute = first-build prefill wall"
+                " the request rode; affinity_miss = re-prefill wall "
+                "spent REBUILDING context the fleet had already "
+                "computed (preemption resume, or a router re-serve/"
+                "failover landing off the request's warm replica); "
+                "compile_stall = ragged-executable compile wall the "
+                "request waited behind; other = the remainder "
+                "(scheduler overhead + time burned by a failed-over "
+                "life). Components sum to the request's "
+                "paddle_tpu_request_ttft_seconds observation",
+                ("component",)),
             "tpot": r.histogram(
                 "paddle_tpu_request_tpot_seconds",
                 "per-request mean inter-token latency over the decode "
@@ -252,6 +268,18 @@ class _Request:                         # ndarray prompts would make
     t_enq: float = 0.0                       # first enqueue (perf_counter)
     t_queued: float = 0.0                    # latest (re)enqueue
     t_first: Optional[float] = None          # first token landed
+    # TTFT latency-budget accumulators (seconds; see the
+    # paddle_tpu_request_ttft_budget_seconds registration). They ride
+    # preemption requeues like the trace identity does, so the final
+    # observation covers every life of the request in THIS engine.
+    # recompute: this life re-builds context a replica had already
+    # computed (preempt resume / router re-serve) — its prefill wall
+    # charges to affinity_miss instead of prefill_compute.
+    bud_queue: float = 0.0
+    bud_prefill: float = 0.0
+    bud_miss: float = 0.0
+    bud_compile: float = 0.0
+    recompute: bool = False
 
     @property
     def context_len(self) -> int:
@@ -262,7 +290,8 @@ class _Request:                         # ndarray prompts would make
 class _Seq:
     __slots__ = ("rid", "prompt", "max_new", "slot", "length", "out",
                  "admit_seq", "deadline", "cached_len", "trace_id",
-                 "root_span", "t_enq", "t_first")
+                 "root_span", "t_enq", "t_first", "bud_queue",
+                 "bud_prefill", "bud_miss", "bud_compile", "recompute")
 
     def __init__(self, req: _Request, slot: int, admit_seq: int):
         self.rid = req.rid
@@ -278,6 +307,11 @@ class _Seq:
         self.root_span = req.root_span
         self.t_enq = req.t_enq
         self.t_first = req.t_first
+        self.bud_queue = req.bud_queue  # TTFT budget (see _Request)
+        self.bud_prefill = req.bud_prefill
+        self.bud_miss = req.bud_miss
+        self.bud_compile = req.bud_compile
+        self.recompute = req.recompute or bool(req.resume_out)
 
     @property
     def token_budget(self) -> int:
@@ -613,6 +647,10 @@ class LLMEngine:
             sum(k.nbytes for k in self.cache.key_caches) \
             + sum(v.nbytes for v in self.cache.value_caches)
         self._hbm_sampled_at = -1.0
+        # wall seconds the LAST ragged launch spent on a compiling
+        # first call (0.0 when it hit a warm executable) — the TTFT
+        # budget's compile_stall attribution read by _run_prefills
+        self._last_ragged_compile_s = 0.0
         self._rope = (self.fam.rope_tables(self.max_model_len)
                       if self.fam.needs_rope else None)
 
@@ -817,13 +855,17 @@ class LLMEngine:
         with finish_reason="deadline" (evicted mid-decode if running)
         while other requests keep serving.
 
-        obs_carry: a (trace_id, root_span, t_enq) triple from an
-        EARLIER life of this request — the serving router re-serves a
-        failed-over request from its original prompt on a surviving
-        replica and passes the original trace identity and first
-        enqueue timestamp here, so the request stays ONE connected
-        trace tree and TTFT/queue-wait/e2e SLO accounting keeps
-        charging the time the dead replica burned.
+        obs_carry: a (trace_id, root_span, t_enq[, reserve]) tuple
+        from an EARLIER life of this request — the serving router
+        re-serves a failed-over request from its original prompt on a
+        surviving replica and passes the original trace identity and
+        first enqueue timestamp here, so the request stays ONE
+        connected trace tree and TTFT/queue-wait/e2e SLO accounting
+        keeps charging the time the dead replica burned. The optional
+        4th element marks a RE-serve (a prior replica already prefilled
+        this context): the new life's prefill wall then charges to the
+        affinity_miss component of the TTFT budget instead of
+        prefill_compute.
 
         prefix_hashes: a precomputed `cache.block_hashes(prompt)`
         chain for THIS prompt — the router's affinity peek already
@@ -861,8 +903,10 @@ class LLMEngine:
         # the timestamps are two perf_counter reads either way — SLO
         # accounting needs them if metrics get enabled mid-flight)
         t_now = time.perf_counter()
+        reserve = False
         if obs_carry is not None:
-            trace_id, root, t_enq = obs_carry
+            trace_id, root, t_enq = obs_carry[:3]
+            reserve = bool(obs_carry[3]) if len(obs_carry) > 3 else False
         else:
             trace_id = _ot.new_trace_id() if _ot._ENABLED else None
             root = _ot.new_span_id() if _ot._ENABLED else None
@@ -874,7 +918,8 @@ class LLMEngine:
                                                  if prefix_hashes
                                                  else None),
                                      trace_id=trace_id, root_span=root,
-                                     t_enq=t_enq, t_queued=t_now))
+                                     t_enq=t_enq, t_queued=t_now,
+                                     recompute=reserve))
 
     def abort_request(self, request_id) -> bool:
         """Cancel a queued or running request: leased pages return to
@@ -981,8 +1026,9 @@ class LLMEngine:
                 if ncached:
                     pm.labels(outcome="hit").inc(ncached)
                 pm.labels(outcome="miss").inc(req.context_len - ncached)
-                m["queue_wait"].observe(
-                    time.perf_counter() - req.t_queued)
+                qw = time.perf_counter() - req.t_queued
+                seq.bud_queue += qw     # TTFT budget: queue segment
+                m["queue_wait"].observe(qw)
             if _ot._ENABLED and req.trace_id is not None:
                 now = time.perf_counter()
                 _ot.add_event(
@@ -1022,7 +1068,10 @@ class LLMEngine:
             victim.rid, victim.prompt, victim.max_new,
             resume_out=list(victim.out), deadline=victim.deadline,
             trace_id=victim.trace_id, root_span=victim.root_span,
-            t_enq=victim.t_enq, t_queued=now, t_first=victim.t_first))
+            t_enq=victim.t_enq, t_queued=now, t_first=victim.t_first,
+            bud_queue=victim.bud_queue, bud_prefill=victim.bud_prefill,
+            bud_miss=victim.bud_miss, bud_compile=victim.bud_compile,
+            recompute=True))
         return True
 
     def _grow(self, seq: _Seq, by: int) -> bool:
@@ -1048,6 +1097,24 @@ class LLMEngine:
             out = self._run_prefills_impl(seqs)
         t1 = time.perf_counter()
         _metrics()["prefill"].observe(t1 - t0)
+        if _om._ENABLED:
+            # TTFT budget: every sequence in the wave waited the whole
+            # wall, so each is charged the full pass — the compile
+            # stall (the ragged call's wall while its executable was
+            # still compiling, stashed by _run_ragged) separately from
+            # the compute, and a recompute life's compute to
+            # affinity_miss (it is re-building context some replica
+            # already held) instead of prefill_compute
+            stall = self._last_ragged_compile_s
+            work = max((t1 - t0) - stall, 0.0)
+            for s in seqs:
+                if self.slots[s.slot] is not s:
+                    continue
+                s.bud_compile += stall
+                if s.recompute:
+                    s.bud_miss += work
+                else:
+                    s.bud_prefill += work
         if _ot._ENABLED:
             # per-request attribution of the batched pass: each
             # sequence gets a child event in ITS trace spanning the
@@ -1315,6 +1382,7 @@ class LLMEngine:
             self.cache.update(i, kcs[i], vcs[i])
         self.stats["ragged_launches"] += 1
         if _om._ENABLED:
+            self._last_ragged_compile_s = t1 - t0 if compiling else 0.0
             _metrics()["ragged"].observe(t1 - t0)
             if not compiling:
                 # roofline: the launch is blocking-timed (the
@@ -1981,8 +2049,28 @@ class LLMEngine:
                 if seq.t_first is None:     # resumed seqs keep theirs
                     seq.t_first = time.perf_counter()
                     if _om._ENABLED:
-                        _metrics()["ttft"].observe(
-                            seq.t_first - seq.t_enq)
+                        m = _metrics()
+                        ttft = seq.t_first - seq.t_enq
+                        m["ttft"].observe(ttft)
+                        # latency-budget attribution: the accumulated
+                        # components, plus a residual so the five
+                        # observations sum to the TTFT observation
+                        # exactly — "other" is scheduler overhead plus
+                        # anything a failed-over life burned on a
+                        # replica this engine never saw
+                        known = (seq.bud_queue + seq.bud_prefill
+                                 + seq.bud_miss + seq.bud_compile)
+                        bh = m["ttft_budget"]
+                        bh.labels(component="queue_wait").observe(
+                            seq.bud_queue)
+                        bh.labels(component="prefill_compute").observe(
+                            seq.bud_prefill)
+                        bh.labels(component="affinity_miss").observe(
+                            seq.bud_miss)
+                        bh.labels(component="compile_stall").observe(
+                            seq.bud_compile)
+                        bh.labels(component="other").observe(
+                            max(ttft - known, 0.0))
                 self._maybe_finish(seq, finished)
         if self._proposer is not None and self._run_spec_step(finished):
             # speculative step committed tokens, rolled back the KV

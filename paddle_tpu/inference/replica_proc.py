@@ -244,6 +244,12 @@ class ReplicaProcessClient:
     as-is, so the router classifies them exactly like an in-process
     replica's."""
 
+    # the router may step this replica from a worker thread alongside
+    # its siblings: each RPC opens its own socket and the worker
+    # computes in its own process, so concurrent steps of DIFFERENT
+    # clients share nothing parent-side
+    concurrent_step_safe = True
+
     def __init__(self, endpoint: str, proc=None,
                  step_timeout_s: float = 600.0):
         self.endpoint = endpoint

@@ -173,6 +173,7 @@ class ReplicaHandle:
         self.name = f"replica-{idx}"
         self._factory = factory
         self.engine = factory(idx)
+        self.t_added = time.monotonic()     # replica-seconds anchor
         self.state = "healthy"      # healthy | probation | dead
         self.inflight: Dict[object, _RoutedRequest] = {}
         # rids aborted out of this ENGINE by a quarantine drain: their
@@ -215,12 +216,34 @@ class ReplicaSet:
         self.factory = engine_factory
         self.handles = [ReplicaHandle(i, engine_factory)
                         for i in range(n_replicas)]
+        # elastic scaling: indices are MONOTONIC, never recycled — a
+        # retired replica-3's gauges must not be inherited by a later
+        # grow, and a process-backed factory keys its process name on
+        # the index
+        self._next_idx = n_replicas
 
     def __len__(self) -> int:
         return len(self.handles)
 
     def __iter__(self):
         return iter(self.handles)
+
+    def add(self, engine_factory=None) -> ReplicaHandle:
+        """Grow the set by one fresh replica (elastic scale-up; the
+        autoscaler's actuator path). Eager like construction: the
+        engine exists before this returns. `engine_factory` overrides
+        the set's factory for THIS handle — an actuator that
+        provisioned the engine out-of-band (async process spawn)
+        passes a factory returning the ready client, so attach cost
+        is O(ms) regardless of spawn cost."""
+        h = ReplicaHandle(self._next_idx,
+                          engine_factory or self.factory)
+        self._next_idx += 1
+        self.handles.append(h)
+        return h
+
+    def remove(self, h: ReplicaHandle) -> None:
+        self.handles.remove(h)
 
     def live(self) -> List[ReplicaHandle]:
         """Replicas currently accepting traffic (healthy or on
@@ -299,11 +322,14 @@ class Router:
         self._sessions: "collections.OrderedDict[object, ReplicaHandle]" \
             = collections.OrderedDict()
         self._ema_serve_s: Optional[float] = None
+        self._step_pool = None          # lazy: concurrent fleet steps
+        self._retired_replica_s = 0.0   # replica-seconds of retirees
         # per-router exact counts (plain dict — bench/tests read it;
         # the process-global series carry the same numbers)
         self.stats = dict(
             routed=0, shed=0, failovers=0, reroutes=0,
-            affinity_hit_tokens=0, affinity_miss_tokens=0)
+            affinity_hit_tokens=0, affinity_miss_tokens=0,
+            grown=0, retired=0)
 
     # -- admission ---------------------------------------------------------
     def _terminal(self, rid, prompt, finish_reason: str, error: str,
@@ -431,10 +457,15 @@ class Router:
                                req=req)
                 return
         try:
+            # the 4th obs_carry element marks a RE-serve: a prior
+            # replica already prefilled this context, so the new
+            # life's prefill charges to the TTFT budget's
+            # affinity_miss component (see llm_engine.add_request)
             h.engine.add_request(
                 req.rid, req.prompt, req.max_new,
                 deadline_s=deadline_s,
-                obs_carry=(req.trace_id, req.root_span, req.t_enq),
+                obs_carry=(req.trace_id, req.root_span, req.t_enq,
+                           req.attempts > 0),
                 prefix_hashes=req.hashes)
         except ReplicaGone as e:
             # the peer vanished between routing and admission (a
@@ -563,6 +594,141 @@ class Router:
         h.probation_left = self.probation_steps
         h.probation_fresh = True
 
+    # -- elastic scaling (the autoscaler's actuator surface) ---------------
+    def add_replica(self, engine_factory=None) -> str:
+        """Grow the fleet by one replica (fresh engine from the
+        factory — a cold cache, like a reintegrated crash; or from
+        `engine_factory` when the caller pre-provisioned the engine,
+        see ReplicaSet.add). Returns the new replica's name. Pending
+        failover victims drain onto it immediately."""
+        h = self.replicas.add(engine_factory)
+        self.stats["grown"] += 1
+        if _ot._ENABLED:
+            _ot.add_event(
+                "router.scale", time.perf_counter() * 1e6, 0.0,
+                args={"action": "grow", "replica": h.name,
+                      "replicas": len(self.replicas)})
+        self._drain_pending()
+        self._update_gauges()
+        return h.name
+
+    def retire_replica(self, name: Optional[str] = None
+                       ) -> Optional[str]:
+        """Shrink the fleet by one replica (elastic scale-down):
+        in-flight requests are DRAINED through abort_request and
+        re-served on the survivors (the quarantine idiom — pages were
+        going away with the engine regardless), a process-backed
+        engine's `shutdown()` is called so the OS process exits, and
+        the retired replica's gauges zero so exports stop naming it.
+        Picks the least-loaded live replica (newest on ties — older
+        replicas hold the warmer prefix caches) unless `name` says
+        otherwise. Refuses (returns None) when retirement would leave
+        no live replica; returns the retired name otherwise."""
+        live = self.replicas.live()
+        if name is not None:
+            h = next((x for x in self.replicas if x.name == name),
+                     None)
+            if h is None:
+                return None
+        elif live:
+            h = min(live, key=lambda x: (x.load, -x.idx))
+        elif len(self.replicas) > 1:
+            # no live replica — retire a cooling-down dead one; it
+            # has no engine and no inflight, so this is bookkeeping
+            h = max(self.replicas.handles, key=lambda x: x.idx)
+        else:
+            return None
+        if h.live and len([x for x in live if x is not h]) == 0:
+            return None     # never retire the last serving replica
+        victims = list(h.inflight.values())
+        if h.engine is not None:
+            for req in victims:
+                try:
+                    h.engine.abort_request(req.rid)
+                except Exception:
+                    pass    # best-effort: the engine is going away
+            shutdown = getattr(h.engine, "shutdown", None)
+            if callable(shutdown):
+                try:
+                    shutdown()
+                except Exception:
+                    pass
+        h.inflight.clear()
+        h.engine = None
+        h.state = "dead"    # stale session stickiness sees not-live
+        h.drained.clear()
+        self.replicas.remove(h)
+        self._retired_replica_s += time.monotonic() - h.t_added
+        self.stats["retired"] += 1
+        if _om._ENABLED:
+            m = _metrics()
+            for state in ("healthy", "probation", "dead"):
+                m["state"].labels(replica=h.name, state=state).set(0.0)
+            m["inflight"].labels(replica=h.name).set(0)
+        if _ot._ENABLED:
+            _ot.add_event(
+                "router.scale", time.perf_counter() * 1e6, 0.0,
+                args={"action": "retire", "replica": h.name,
+                      "replicas": len(self.replicas),
+                      "victims": len(victims)})
+        self._reroute(victims)
+        self._update_gauges()
+        return h.name
+
+    def replica_seconds(self) -> float:
+        """Cumulative replica-alive seconds across the router's
+        lifetime (retired replicas included) — the capacity cost an
+        elastic fleet is trying to minimize; the traffic bench
+        compares this against a static max-size fleet at equal work."""
+        now = time.monotonic()
+        return self._retired_replica_s + sum(
+            now - h.t_added for h in self.replicas)
+
+    # -- fleet stepping ----------------------------------------------------
+    def _step_replicas(self, steppable):
+        """Step every replica that has work; CONCURRENTLY when every
+        engine declares `concurrent_step_safe` (process-backed
+        replicas: the router thread only waits on a socket while the
+        worker computes in its own process, so N replicas genuinely
+        overlap — stepped sequentially, the whole fleet's compute
+        would serialize through this one thread and fleet size would
+        add batch slots but no throughput). In-process engines share
+        this thread's devices, so they keep the sequential path.
+        Returns [(handle, results, step_seconds, compiled, error)];
+        all POLICY (failover, quarantine, collection) stays with the
+        caller on the router thread."""
+        def one(h):
+            # steps that compiled a new executable are exempt from
+            # the latency health check: an XLA compile is seconds
+            # of legitimate one-time work, and quarantining every
+            # replica on its first bucket would melt a cold fleet
+            fns = getattr(h.engine, "_fns", None)
+            n_fns = len(fns) if fns is not None else -1
+            t0 = time.perf_counter()
+            try:
+                faults.fault_point("router.replica.step",
+                                   replica=h.name)
+                results = h.engine.step()
+            except Exception as e:
+                return (h, None, time.perf_counter() - t0, False, e)
+            dt = time.perf_counter() - t0
+            compiled = fns is not None and len(fns) != n_fns
+            return (h, results, dt, compiled, None)
+
+        if len(steppable) > 1 and all(
+                getattr(h.engine, "concurrent_step_safe", False)
+                for h in steppable):
+            import concurrent.futures as _cf
+            if self._step_pool is None or \
+                    self._step_pool._max_workers < len(steppable):
+                if self._step_pool is not None:
+                    self._step_pool.shutdown(wait=False)
+                self._step_pool = _cf.ThreadPoolExecutor(
+                    max_workers=max(4, len(steppable)),
+                    thread_name_prefix="router-step")
+            return list(self._step_pool.map(one, steppable))
+        return [one(h) for h in steppable]
+
     # -- result plumbing ---------------------------------------------------
     def _collect(self, h: ReplicaHandle, results, finished) -> None:
         for r in results:
@@ -649,28 +815,15 @@ class Router:
                 if h.state == "dead" and now >= h.cooldown_until:
                     self._reintegrate(h)
             self._drain_pending()
-            for h in self.replicas:
-                if not h.live or not h.inflight:
+            steppable = [h for h in self.replicas
+                         if h.live and h.inflight
+                         and h.engine.has_unfinished]
+            for h, results, dt, compiled, err in \
+                    self._step_replicas(steppable):
+                if err is not None:
+                    self._fail_replica(h, err)
                     continue
-                if not h.engine.has_unfinished:
-                    continue
-                # steps that compiled a new executable are exempt from
-                # the latency health check: an XLA compile is seconds
-                # of legitimate one-time work, and quarantining every
-                # replica on its first bucket would melt a cold fleet
-                fns = getattr(h.engine, "_fns", None)
-                n_fns = len(fns) if fns is not None else -1
-                t0 = time.perf_counter()
-                try:
-                    faults.fault_point("router.replica.step",
-                                       replica=h.name)
-                    results = h.engine.step()
-                except Exception as e:
-                    self._fail_replica(h, e)
-                    continue
-                dt = time.perf_counter() - t0
                 h.last_step_s = dt
-                compiled = fns is not None and len(fns) != n_fns
                 self._collect(h, results, finished)
                 if self.unhealthy_step_s is not None \
                         and not compiled \

@@ -20,7 +20,10 @@ numerics divergence (nonfinite grads/params/loss, grad-norm spike,
 loss-scale floor — `observability.numerics`, one bundle per episode),
 and — in a fleet aggregator process — cross-rank collective arrival
 skew over `collective_skew_s` (the straggler attribution plane, see
-README "Collective & mesh observability"). Anything else can call
+README "Collective & mesh observability"). The serving autoscaler
+dumps one `autoscale_decision` bundle per committed scale decision
+(the triggering series, threshold and observed values ride the meta —
+see README "Serving SLO control plane"). Anything else can call
 `flight.trigger(reason, detail=...)` directly.
 
 A bundle is one directory, written to a hidden tmp name and renamed
@@ -64,7 +67,7 @@ _BUNDLES_COUNTER = None
 TRIGGER_REASONS = ("step_latency", "deadline_miss", "preempt_storm",
                    "fault_point", "slo_breach", "collective_skew",
                    "numerics_divergence", "autopilot_remediation",
-                   "manual")
+                   "autoscale_decision", "manual")
 
 
 class FlightConfig:

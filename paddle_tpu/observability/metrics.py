@@ -35,8 +35,8 @@ from typing import Dict, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "enable", "disable", "enabled", "DEFAULT_BUCKETS",
-    "quantile_from_buckets", "fraction_le", "MergeSkewError",
-    "quarantine_name",
+    "quantile_from_buckets", "fraction_le", "quantiles_by_label",
+    "MergeSkewError", "quarantine_name",
 ]
 
 # module-global so instrumented call sites pay exactly one attribute
@@ -227,6 +227,70 @@ def fraction_le(bounds, counts, v, hi=None) -> Optional[float]:
             acc += c * (v - b_lo) / (b_hi - b_lo)
         return min(acc / total, 1.0)
     return min(acc / total, 1.0)
+
+
+def quantiles_by_label(doc, name, label, qs=(0.5, 0.95), prev=None):
+    """Per-label-value percentile estimates for a labeled histogram in
+    a to_json() document, summing bucket vectors across the remaining
+    label dimensions (e.g. paddle_tpu_collective_seconds{op,group}
+    aggregated per op, or a fleet-merged request histogram per
+    process). `doc` is the parsed `to_json()` shape: {name: {kind,
+    help, buckets?, series: [{labels: {...}, value}]}}.
+
+    With `prev` (an earlier doc of the same export), quantiles come
+    from the BETWEEN-FRAMES bucket delta — the live read for high-rate
+    histograms, where the cumulative distribution would bury the last
+    few seconds; window extrema are unknowable from two cumulative
+    frames, so delta estimates are bounded by the bucket grid instead
+    of the observed min/max. Falls back to the cumulative series when
+    the delta is empty (idle between frames). Returns {label_value:
+    {"count": n, "p50": ..., "p95": ...}} with one pNN key per entry
+    of `qs`; label values with no samples are omitted."""
+    rec = doc.get(name)
+    if not rec or rec.get("kind") != "histogram":
+        return {}
+
+    def collect(d):
+        acc = {}
+        for s in (d.get(name) or {}).get("series", []):
+            key = s["labels"].get(label)
+            if key is None:
+                continue
+            v = s["value"]
+            cur = acc.get(key)
+            if cur is None:
+                acc[key] = {"buckets": list(v["buckets"]),
+                            "lo": v["min"], "hi": v["max"]}
+            else:
+                cur["buckets"] = [a + b for a, b in
+                                  zip(cur["buckets"], v["buckets"])]
+                if v["min"] is not None:
+                    cur["lo"] = v["min"] if cur["lo"] is None \
+                        else min(cur["lo"], v["min"])
+                if v["max"] is not None:
+                    cur["hi"] = v["max"] if cur["hi"] is None \
+                        else max(cur["hi"], v["max"])
+        return acc
+
+    out = {}
+    acc, pacc = collect(doc), collect(prev) if prev else {}
+    for key, v in acc.items():
+        counts, lo, hi = v["buckets"], v["lo"], v["hi"]
+        pv = pacc.get(key)
+        if pv is not None:
+            dl = [c - p for c, p in zip(counts, pv["buckets"])]
+            if sum(dl) > 0:
+                counts, lo, hi = dl, None, None
+        n = sum(counts)
+        if not n:
+            continue
+        out[key] = {
+            "count": n,
+            **{f"p{int(q * 100)}": quantile_from_buckets(
+                rec["buckets"], counts, q, lo=lo, hi=hi)
+               for q in qs},
+        }
+    return out
 
 
 # ---------------------------------------------------------------------------

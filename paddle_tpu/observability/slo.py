@@ -92,7 +92,15 @@ class SLOResult:
         self.missing = missing
 
     def to_dict(self) -> dict:
-        return {s: getattr(self, s) for s in self.__slots__}
+        # walk the MRO: `self.__slots__` alone resolves to the most
+        # derived class's tuple, silently dropping these base fields
+        # from subclass dumps (FleetSLOResult bundles lost the rule
+        # name and threshold)
+        out = {}
+        for klass in reversed(type(self).__mro__):
+            for s in getattr(klass, "__slots__", ()):
+                out[s] = getattr(self, s)
+        return out
 
     def __repr__(self):
         att = "n/a" if self.attained is None else f"{self.attained:.4f}"

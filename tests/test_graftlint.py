@@ -477,6 +477,37 @@ class TestObsDiscipline:
             readme=_README, path="paddle_tpu/engine/thing.py")
         assert fs == []
 
+    def test_bad_autoscale_action_undocumented(self):
+        fs = analyze("""
+            SCALE_ACTIONS = ("grow", "annihilate")
+
+            def scan(self):
+                self._decide("annihilate", 3, trigger={})
+        """, rules={"autoscale-action-documented"},
+            readme=_README + " autoscaler actions: grow retire",
+            path="paddle_tpu/inference/autoscaler.py")
+        assert rule_ids(fs) == ["autoscale-action-documented"]
+        assert "annihilate" in fs[0].message
+
+    def test_good_autoscale_actions(self):
+        fs = analyze("""
+            SCALE_ACTIONS = ("grow", "retire")
+
+            def scan(self):
+                self._decide("grow", 1, trigger={})
+                self._decide("retire", 2, trigger={})
+        """, rules={"autoscale-action-documented"},
+            readme=_README + " autoscaler actions: grow retire",
+            path="paddle_tpu/inference/autoscaler.py")
+        assert fs == []
+
+    def test_autoscale_rule_scoped_to_autoscaler(self):
+        fs = analyze("""
+            SCALE_ACTIONS = ("annihilate",)
+        """, rules={"autoscale-action-documented"},
+            readme=_README, path="paddle_tpu/inference/router.py")
+        assert fs == []
+
     def test_good_stats_keys(self):
         fs = analyze("""
             class E:

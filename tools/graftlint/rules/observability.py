@@ -395,3 +395,50 @@ class AutopilotActionDocumented(Rule):
                     mod, line,
                     f"autopilot action '{name}' is not documented in "
                     "the README Training-autopilot policy table")
+
+
+@register
+class AutoscaleActionDocumented(Rule):
+    id = "autoscale-action-documented"
+    family = "obs"
+    severity = "error"
+    invariant = ("every scale action the serving autoscaler can "
+                 "commit — literals in the SCALE_ACTIONS vocabulary "
+                 "and first-argument literals of _decide(\"...\") "
+                 "calls under paddle_tpu/inference/autoscaler.py — "
+                 "appears verbatim in the README Serving-SLO-control-"
+                 "plane section")
+    history = ("ISSUE 19: scale actions are what an operator sees in "
+               "the scale journal, autoscale_decision bundles and the "
+               "paddle_tpu_autoscaler_decisions_total series; an "
+               "action name the README does not carry is a fleet-size "
+               "change nobody can audit")
+
+    def check(self, mod):
+        if not mod.path.startswith("paddle_tpu/inference/autoscaler"):
+            return
+        seen: Dict[str, int] = {}
+        for node in ast.walk(mod.tree):
+            # the closed vocabulary: SCALE_ACTIONS = ("grow", ...)
+            if isinstance(node, ast.Assign):
+                targets = [U.dotted(t) or "" for t in node.targets]
+                if any(t.split(".")[-1] == "SCALE_ACTIONS"
+                       for t in targets) and \
+                        isinstance(node.value, (ast.Tuple, ast.List)):
+                    for el in node.value.elts:
+                        name = _literal_str(el)
+                        if name is not None and name not in seen:
+                            seen[name] = el.lineno
+            # commit sites: self._decide("grow", ...)
+            if isinstance(node, ast.Call):
+                d = U.dotted(node.func) or ""
+                if d.split(".")[-1] == "_decide" and node.args:
+                    name = _literal_str(node.args[0])
+                    if name is not None and name not in seen:
+                        seen[name] = node.lineno
+        for name, line in sorted(seen.items(), key=lambda kv: kv[1]):
+            if _readme_missing(name, mod.project.readme):
+                yield self.finding(
+                    mod, line,
+                    f"autoscaler action '{name}' is not documented in "
+                    "the README Serving SLO control plane section")

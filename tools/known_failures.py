@@ -17,6 +17,13 @@ reported as such); exit 1 listing each NEW failure otherwise. Entries
 under "flaky" (timing-sensitive tests that measure real wall clocks
 on a shared box) are reported when they fail but never fatal — rerun
 them standalone before treating one as a regression.
+
+`--staleness` audits the manifest itself: entries whose nodeid no
+longer exists in the tree (file deleted, test renamed) or that did
+not fail this run are flagged so the manifest tracks reality instead
+of accreting dead entries. The staleness report is informational —
+it never changes the exit code — and a one-line summary rides every
+default run so drift is visible without asking for it.
 """
 from __future__ import annotations
 
@@ -87,6 +94,48 @@ def check_log(log_path: str, manifest_path: Optional[str] = None
     )
 
 
+def classify_staleness(manifest: Dict, failed: List[str],
+                       root: Optional[str] = None) -> Dict[str, List[str]]:
+    """Audit manifest entries (failures + flaky) against the tree and
+    this run's failure set. Buckets:
+
+    - "file_missing": the test file no longer exists — the entry is
+      definitely stale, delete it.
+    - "test_missing": the file exists but defines no matching test
+      function — renamed or removed, delete or update the entry.
+    - "absent_this_run": the test still exists but did not fail this
+      run — it may pass now (fixed? environment changed?) or simply
+      have been deselected; candidate for manifest removal after a
+      full-tree run confirms it.
+    """
+    root = root or os.path.dirname(_HERE)
+    failed_set = set(failed)
+    out: Dict[str, List[str]] = {
+        "file_missing": [], "test_missing": [], "absent_this_run": []}
+    src_cache: Dict[str, Optional[str]] = {}
+    for nodeid in sorted(set(manifest["failures"]) | set(manifest["flaky"])):
+        path = nodeid.split("::", 1)[0]
+        fpath = os.path.join(root, path)
+        if fpath not in src_cache:
+            try:
+                with open(fpath, encoding="utf-8") as f:
+                    src_cache[fpath] = f.read()
+            except OSError:
+                src_cache[fpath] = None
+        src = src_cache[fpath]
+        if src is None:
+            out["file_missing"].append(nodeid)
+            continue
+        # last :: component is the test function; strip the
+        # parametrization id ("test_x[cpu-4]" -> "test_x")
+        name = nodeid.rsplit("::", 1)[-1].split("[", 1)[0]
+        if f"def {name}" not in src:
+            out["test_missing"].append(nodeid)
+        elif nodeid not in failed_set:
+            out["absent_this_run"].append(nodeid)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="check a tier-1 pytest log against the known-"
@@ -94,10 +143,30 @@ def main(argv=None) -> int:
     ap.add_argument("log", help="pytest output log (tee of tier-1)")
     ap.add_argument("--manifest", default=None,
                     help=f"manifest path (default {DEFAULT_MANIFEST})")
+    ap.add_argument("--staleness", action="store_true",
+                    help="print the detailed manifest-staleness audit "
+                         "(entries whose nodeid no longer exists or "
+                         "that did not fail this run); never fatal")
     args = ap.parse_args(argv)
     r = check_log(args.log, args.manifest)
     print(f"known environment failures seen: {len(r.known_seen)} of "
           f"{len(r.known_seen) + len(r.known_missing)}")
+    stale = classify_staleness(
+        load_manifest(args.manifest),
+        r.new + r.known_seen + r.flaky_seen)
+    n_dead = len(stale["file_missing"]) + len(stale["test_missing"])
+    print(f"manifest staleness: {n_dead} dead entries, "
+          f"{len(stale['absent_this_run'])} absent this run"
+          + ("" if args.staleness or not n_dead
+             else " (--staleness for details)"))
+    if args.staleness:
+        for bucket, label in (
+                ("file_missing", "test file gone — delete the entry"),
+                ("test_missing", "test renamed/removed — update"),
+                ("absent_this_run",
+                 "did not fail this run (fixed, or deselected)")):
+            for n in stale[bucket]:
+                print(f"  ? {n}  [{label}]")
     if r.known_missing:
         print("known failures ABSENT this run (fixed? environment "
               "changed? update the manifest):")

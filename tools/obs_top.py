@@ -44,7 +44,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from paddle_tpu.observability.metrics import quantile_from_buckets  # noqa: E402
+from paddle_tpu.observability.metrics import (  # noqa: E402
+    quantile_from_buckets, quantiles_by_label)
 
 
 # ---------------------------------------------------------------------------
@@ -114,56 +115,9 @@ def _ms(x):
     return "-" if x is None else f"{x * 1e3:8.2f}ms"
 
 
-def _hist_quantiles_by(doc, name, label, qs=(0.5, 0.95), prev=None):
-    """Per-label-value percentile estimates for a labeled histogram,
-    summing bucket vectors across the remaining label dimensions
-    (e.g. paddle_tpu_collective_seconds{op,group} aggregated per op).
-    Between-frames deltas with `prev`, like _hist_quantiles."""
-    rec = doc.get(name)
-    if not rec or rec.get("kind") != "histogram":
-        return {}
-
-    def collect(d):
-        acc = {}
-        for s in (d.get(name) or {}).get("series", []):
-            key = s["labels"].get(label)
-            if key is None:
-                continue
-            v = s["value"]
-            cur = acc.get(key)
-            if cur is None:
-                acc[key] = {"buckets": list(v["buckets"]),
-                            "lo": v["min"], "hi": v["max"]}
-            else:
-                cur["buckets"] = [a + b for a, b in
-                                  zip(cur["buckets"], v["buckets"])]
-                if v["min"] is not None:
-                    cur["lo"] = v["min"] if cur["lo"] is None \
-                        else min(cur["lo"], v["min"])
-                if v["max"] is not None:
-                    cur["hi"] = v["max"] if cur["hi"] is None \
-                        else max(cur["hi"], v["max"])
-        return acc
-
-    out = {}
-    acc, pacc = collect(doc), collect(prev) if prev else {}
-    for key, v in acc.items():
-        counts, lo, hi = v["buckets"], v["lo"], v["hi"]
-        pv = pacc.get(key)
-        if pv is not None:
-            dl = [c - p for c, p in zip(counts, pv["buckets"])]
-            if sum(dl) > 0:
-                counts, lo, hi = dl, None, None
-        n = sum(counts)
-        if not n:
-            continue
-        out[key] = {
-            "count": n,
-            **{f"p{int(q * 100)}": quantile_from_buckets(
-                rec["buckets"], counts, q, lo=lo, hi=hi)
-               for q in qs},
-        }
-    return out
+# promoted to observability.metrics.quantiles_by_label (PR 19); the
+# alias keeps this module's long-standing internal name working
+_hist_quantiles_by = quantiles_by_label
 
 
 def render_fleet(doc, prev=None, dt=None) -> str:
@@ -583,6 +537,51 @@ def render(doc, prev=None, dt=None) -> str:
         if mttr:
             lines.append(f"  mttr         p50={_ms(mttr['p50'])}  "
                          f"p95={_ms(mttr['p95'])}")
+
+    # slo: serving SLO control plane — fleet SLO verdicts, TTFT budget
+    # attribution, autoscaler state (README "Serving SLO control
+    # plane"); present only where a FleetSLOMonitor/Autoscaler runs
+    att = _series(doc, "paddle_tpu_slo_attained_fraction")
+    bud = _series(doc, "paddle_tpu_request_ttft_budget_seconds")
+    asc_n = _value(doc, "paddle_tpu_autoscaler_replicas")
+    if att or bud or asc_n is not None:
+        lines.append("== slo ==")
+        for s in sorted(att, key=lambda s: s["labels"]["slo"]):
+            slo_name = s["labels"]["slo"]
+            obj = _value(doc, "paddle_tpu_slo_objective_fraction",
+                         slo=slo_name)
+            ok = obj is None or s["value"] >= obj
+            breaches = _counter_sum(
+                doc, "paddle_tpu_slo_breaches_total", slo=slo_name)
+            row = (f"  {slo_name:<14} attained {s['value'] * 100:6.2f}%"
+                   f"  objective {(obj or 0.0) * 100:6.2f}%  "
+                   f"{'ok' if ok else 'BREACH'}")
+            if breaches:
+                row += f"  (breached evals {int(breaches)})"
+            lines.append(row)
+        tot = sum(s["value"]["sum"] for s in bud)
+        if tot > 0:
+            lines.append("  ttft budget (component share of "
+                         "fleet-total ttft)")
+            for s in sorted(bud, key=lambda s: -s["value"]["sum"]):
+                frac = s["value"]["sum"] / tot
+                lines.append(f"    {s['labels']['component']:<15} "
+                             f"{frac * 100:5.1f}% "
+                             f"{'#' * int(round(frac * 24))}")
+        if asc_n is not None:
+            decs = {s["labels"]["action"]: int(s["value"]) for s in
+                    _series(doc, "paddle_tpu_autoscaler_decisions_total")
+                    if s["value"]}
+            last = [s["labels"]["action"] for s in
+                    _series(doc, "paddle_tpu_autoscaler_last_decision")
+                    if s["value"]]
+            row = f"  autoscaler   replicas={int(asc_n)}"
+            if decs:
+                row += "  " + "  ".join(
+                    f"{a}={n}" for a, n in sorted(decs.items()))
+            if last:
+                row += f"   last={last[0]}"
+            lines.append(row)
 
     fl = _series(doc, "paddle_tpu_flight_bundles_total")
     if fl:
