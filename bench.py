@@ -1715,6 +1715,356 @@ def bench_traffic(on_tpu):
     }
 
 
+def bench_disagg(on_tpu):
+    """Prefill/decode disaggregation A/B at EQUAL total pool HBM: the
+    same heavy-tailed traffic schedule (inference.traffic.TrafficModel,
+    fixed seed) driven against
+
+      A. a role-less Router fleet of N replicas (every replica serves
+         both halves of the workload);
+      B. a DisaggRouter over the SAME N replicas — same engine config,
+         same per-replica page pool, so equal total HBM — split into
+         role pools (1 prefill + N-1 decode): every multi-token
+         request prefills on the prefill pool, then its committed
+         prefix pages migrate over the replica RPC to a decode
+         replica that re-admits it with `prefix_hashes=` (see README
+         "Prefill/decode disaggregation").
+
+    On CPU the replicas are real OS processes with per-role fleet
+    names and process_role=engine_prefill/engine_decode, so the
+    aggregator's process-merged request histograms split TTFT/TPOT
+    per role and the extra carries per-role capacity lines
+    (sessions-per-replica-second for the prefill pool, completions
+    for the decode pool — static pools, so replica-seconds per role
+    is exactly pool_size x leg wall).
+
+    The CPU gate is NOT the latency ratio — one time-sliced box
+    cannot measure a disaggregation win (both legs share the same
+    cores, so the A/B ratio reflects scheduler noise; it is reported
+    under extra with exactly that caveat). The gate is:
+      (1) bit-exactness — a fixed greedy prompt set served through
+          the disaggregated fleet matches a role-less single-engine
+          oracle token for token, and
+      (2) handoff-path accounting — handoffs == completed multi-token
+          sessions, with the migrated path > 0 under the default
+          config (migration on, no chaos).
+    Headline value = the disaggregated leg's capacity line (ok
+    requests per replica-second); vs_baseline = that capacity over
+    the role-less leg's."""
+    import json
+    import tempfile
+
+    import jax
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import fleet as ofleet
+    from paddle_tpu.observability import metrics as _m
+    from paddle_tpu.inference import (DisaggRouter, LLMEngine, Router,
+                                      TrafficModel, run_traffic)
+    from paddle_tpu.inference.disagg import PROCESS_ROLES
+    from paddle_tpu.inference.replica_proc import process_engine_factory
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if on_tpu:
+        kw = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+                  num_heads=16, max_position_embeddings=2048,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        max_batch, block_size, chunk, quantum = 8, 64, 16, 128
+        num_blocks, max_prompt, n_new_cap = 120, 768, 64
+        n_events = 80
+    else:
+        kw = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                  num_heads=4, max_position_embeddings=256,
+                  hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        max_batch, block_size, chunk, quantum = 4, 16, 4, 16
+        num_blocks, max_prompt, n_new_cap = 48, 96, 32
+        n_events = 200
+    n_total, n_prefill = 3, 1           # equal pool size in both legs
+    n_decode = n_total - n_prefill
+    obs.enable()
+    store = tempfile.mkdtemp(prefix="paddle_tpu_disagg_store_")
+    proc_fleet = not on_tpu
+    engine_kw = dict(max_batch=max_batch, block_size=block_size,
+                     num_blocks=num_blocks, decode_chunk=chunk,
+                     prompt_quantum=quantum,
+                     max_model_len=kw["max_position_embeddings"])
+
+    tm = TrafficModel(seed=7, base_rate=3.0, burst_rate=30.0,
+                      off_s=2.0, on_s=1.5, max_body=max_prompt,
+                      max_out=n_new_cap)
+    evs = list(tm.events(n_events))
+
+    agg = None
+    if proc_fleet:
+        agg = ofleet.serve_aggregator(stale_after_s=600.0)
+        oracle_model = _proc_fleet_model(**kw)
+
+        def make_factory(prefix, role=None):
+            return process_engine_factory(
+                _proc_fleet_model, model_kwargs=kw,
+                engine_kwargs=engine_kw, exec_cache_dir=store,
+                aggregator_endpoint=agg.endpoint,
+                name_prefix=prefix, role=role)
+
+        def shutdown_fleet(router):
+            for h in list(router.replicas):
+                try:
+                    if h.engine is not None:
+                        h.engine.shutdown()
+                except Exception:
+                    pass
+
+        def tail_stats(prefix, metric):
+            """Fleet-wide request-latency tail for one leg (or one
+            role pool): sum the aggregator's process-labeled bucket
+            vectors over the fleet name prefix."""
+            doc = json.loads(agg.registry.to_json())
+            rec = doc.get(metric)
+            buckets, lo, hi = None, None, None
+            for s in (rec or {}).get("series", ()):
+                pname = str(s["labels"].get("process", ""))
+                if not pname.startswith(prefix):
+                    continue
+                v = s["value"]
+                if buckets is None:
+                    buckets = list(v["buckets"])
+                    lo, hi = v["min"], v["max"]
+                else:
+                    buckets = [a + b for a, b in
+                               zip(buckets, v["buckets"])]
+                    if v["min"] is not None:
+                        lo = v["min"] if lo is None \
+                            else min(lo, v["min"])
+                    if v["max"] is not None:
+                        hi = v["max"] if hi is None \
+                            else max(hi, v["max"])
+            if not buckets or not sum(buckets):
+                return {"p50_s": None, "p95_s": None, "count": 0}
+            return {
+                "p50_s": round(_m.quantile_from_buckets(
+                    rec["buckets"], buckets, 0.5, lo=lo, hi=hi), 4),
+                "p95_s": round(_m.quantile_from_buckets(
+                    rec["buckets"], buckets, 0.95, lo=lo, hi=hi), 4),
+                "count": int(sum(buckets)),
+            }
+    else:
+        cfg = GPTConfig(**kw)
+        oracle_model = GPTForCausalLM(cfg).bfloat16()
+        oracle_model.eval()
+
+        def make_factory(prefix, role=None):
+            def factory(_i):
+                return LLMEngine(oracle_model, exec_cache_dir=store,
+                                 **engine_kw)
+            return factory
+
+        def shutdown_fleet(router):
+            pass
+
+        def tail_stats(prefix, metric):
+            # in-process replicas share one registry with no process
+            # labels: whole-leg tails only (obs.reset() between legs
+            # scopes them); per-role splits need the proc fleet
+            h = _m.registry().get(metric)
+            child = h._children.get(()) if h is not None else None
+            if child is None or not child._count:
+                return {"p50_s": None, "p95_s": None, "count": 0}
+            return {"p50_s": round(child.quantile(0.5), 4),
+                    "p95_s": round(child.quantile(0.95), 4),
+                    "count": child._count}
+
+    def make_disagg(prefix):
+        return DisaggRouter(
+            make_factory(prefix + "-prefill", role=PROCESS_ROLES[0]),
+            make_factory(prefix + "-decode", role=PROCESS_ROLES[1]),
+            n_prefill=n_prefill, n_decode=n_decode, max_inflight=64)
+
+    def warm_inproc(router):
+        if proc_fleet:
+            return
+        for h in router.replicas:
+            h.engine.generate([ev.prompt[:max_prompt]
+                               for ev in evs[:6]], max_new_tokens=2)
+        obs.reset()
+
+    # phase 1 — warm the shared executable store off the clock (proc
+    # workers then deserialize every shape instead of compiling it)
+    obs.reset()
+    warm_router = Router(make_factory("disagg-warm"), n_replicas=1,
+                         max_inflight=64)
+    run_traffic(warm_router, evs[:20], time_scale=0.0,
+                max_prompt=max_prompt)
+    shutdown_fleet(warm_router)
+
+    # phase 2 — the CPU gate: fixed greedy prompts through a
+    # disaggregated fleet vs a role-less single-engine oracle
+    rng = np.random.default_rng(11)
+    gate_prompts = [rng.integers(0, kw["vocab_size"],
+                                 (int(n),)).astype(np.int32)
+                    for n in (37, 53, 41, 29, 64, 47)]
+    gate_new = 12
+    oracle = LLMEngine(oracle_model, exec_cache_dir=store, **engine_kw)
+    want = {}
+    for i, p in enumerate(gate_prompts):
+        oracle.add_request(i, p, gate_new)
+    while oracle.has_unfinished:
+        for r in oracle.step():
+            if not r.ok:
+                raise RuntimeError("gate oracle failed: %s" % r.error)
+            want[r.request_id] = tuple(int(t) for t in r.output_ids)
+
+    obs.reset()
+    gate_router = make_disagg("disagg-gate")
+    got = {}
+    for i, p in enumerate(gate_prompts):
+        gate_router.submit(i, p, max_new_tokens=gate_new)
+    t0 = time.perf_counter()
+    while gate_router.has_unfinished:
+        if time.perf_counter() - t0 > 300:
+            raise RuntimeError("disagg gate fleet wedged")
+        for r in gate_router.step():
+            if not r.ok:
+                raise RuntimeError(
+                    "gate request %r failed: %s %s"
+                    % (r.request_id, r.finish_reason, r.error))
+            got[r.request_id] = tuple(int(t) for t in r.output_ids)
+    gstats = dict(gate_router.stats)
+    shutdown_fleet(gate_router)
+    bit_exact = got == want
+    accounted = (gstats["handoffs"] == len(gate_prompts)
+                 and gstats["handoff_migrated"] > 0
+                 and gstats["handoff_fallback"] == 0
+                 and gstats["migrated_bytes"] > 0)
+    if not (bit_exact and accounted):
+        raise RuntimeError(
+            "disagg gate failed: bit_exact=%s handoffs=%s/%s "
+            "migrated=%s fallback=%s migrated_bytes=%s"
+            % (bit_exact, gstats["handoffs"], len(gate_prompts),
+               gstats["handoff_migrated"], gstats["handoff_fallback"],
+               gstats["migrated_bytes"]))
+
+    # phase 3 — the equal-pool traffic A/B
+    time_scale = 1.0 if proc_fleet else 0.5
+
+    def leg(tag):
+        obs.reset()
+        prefix = "disagg-%s" % tag
+        if tag == "split":
+            router = make_disagg(prefix)
+        else:
+            router = Router(make_factory(prefix), n_replicas=n_total,
+                            max_inflight=64)
+        warm_inproc(router)
+        rep = run_traffic(router, evs, time_scale=time_scale,
+                          max_prompt=max_prompt)
+        rep["router_stats"] = dict(router.stats)
+        shutdown_fleet(router)
+        rep["ttft"] = tail_stats(prefix,
+                                 "paddle_tpu_request_ttft_seconds")
+        rep["tpot"] = tail_stats(prefix,
+                                 "paddle_tpu_request_tpot_seconds")
+        if tag == "split" and proc_fleet:
+            rep["per_role"] = {
+                "prefill": {
+                    "replicas": n_prefill,
+                    "ttft": tail_stats(
+                        prefix + "-prefill",
+                        "paddle_tpu_request_ttft_seconds"),
+                },
+                "decode": {
+                    "replicas": n_decode,
+                    "ttft": tail_stats(
+                        prefix + "-decode",
+                        "paddle_tpu_request_ttft_seconds"),
+                    "tpot": tail_stats(
+                        prefix + "-decode",
+                        "paddle_tpu_request_tpot_seconds"),
+                },
+            }
+        return rep
+
+    try:
+        rep_flat = leg("flat")
+        rep_split = leg("split")
+    finally:
+        if agg is not None:
+            agg.close()
+
+    def capacity(rep):
+        return rep["ok"] / max(rep.get("replica_seconds",
+                                       rep["wall_s"] * n_total), 1e-9)
+
+    cap_split = capacity(rep_split)
+    cap_flat = capacity(rep_flat)
+    sstats = rep_split["router_stats"]
+    wall = max(rep_split["wall_s"], 1e-9)
+    # per-role capacity lines: static pools, so replica-seconds per
+    # role is exactly pool_size x wall
+    cap_prefill = sstats["handoffs"] / (n_prefill * wall)
+    cap_decode = rep_split["ok"] / (n_decode * wall)
+    caveat = (
+        "both legs time-slice one host's cores, so the A/B latency "
+        "and capacity ratios measure scheduling on shared CPUs, not "
+        "a TPU disaggregation win; the CPU gate is bit-exactness + "
+        "handoff-path accounting" if proc_fleet else
+        "in-process replicas share one device population; whole-leg "
+        "tails only")
+    return {
+        "metric": "disagg_req_per_replica_s",
+        "value": round(cap_split, 4),
+        "unit": "req/s/replica",
+        "vs_baseline": round(cap_split / max(cap_flat, 1e-9), 4),
+        "extra": {
+            "gate": {
+                "bit_exact": bit_exact,
+                "sessions": len(gate_prompts),
+                "handoffs": gstats["handoffs"],
+                "migrated": gstats["handoff_migrated"],
+                "readmitted": gstats["handoff_readmitted"],
+                "fallback": gstats["handoff_fallback"],
+                "migrated_bytes": gstats["migrated_bytes"],
+            },
+            "roleless": {
+                "replicas": n_total,
+                "ttft": rep_flat["ttft"],
+                "tpot": rep_flat["tpot"],
+                "req_per_s": round(rep_flat["req_per_s"], 3),
+                "req_per_replica_s": round(cap_flat, 4),
+                "shed_rate": round(rep_flat["shed_rate"], 4),
+            },
+            "disaggregated": {
+                "n_prefill": n_prefill,
+                "n_decode": n_decode,
+                # stage accounting: the request histograms count each
+                # stage as its own request — a session is one
+                # prefill-pool entry plus one decode-pool re-admission,
+                # so user-perceived TTFT ~= prefill TTFT + handoff +
+                # decode TTFT (the per_role split keeps them apart)
+                "ttft": rep_split["ttft"],
+                "tpot": rep_split["tpot"],
+                "per_role": rep_split.get("per_role"),
+                "req_per_s": round(rep_split["req_per_s"], 3),
+                "shed_rate": round(rep_split["shed_rate"], 4),
+                "capacity_lines": {
+                    "prefill_sessions_per_replica_s":
+                        round(cap_prefill, 4),
+                    "decode_completions_per_replica_s":
+                        round(cap_decode, 4),
+                },
+                "handoffs": sstats["handoffs"],
+                "handoff_migrated": sstats["handoff_migrated"],
+                "handoff_readmitted": sstats["handoff_readmitted"],
+                "handoff_fallback": sstats["handoff_fallback"],
+                "migrated_bytes": sstats["migrated_bytes"],
+            },
+            "events": n_events,
+            "caveat": caveat,
+            "device": str(getattr(jax.devices()[0], "device_kind",
+                                  jax.devices()[0].platform)),
+        },
+    }
+
+
 def bench_comms(on_tpu):
     """Collective microbench sweep (op x payload size) over the full
     device mesh (main() forces the 8-device CPU mesh when the config is
@@ -2097,6 +2447,7 @@ CONFIGS = {
     "spec_decode": bench_spec_decode,
     "router_serving": bench_router_serving,
     "traffic": bench_traffic,
+    "disagg": bench_disagg,
     "autopilot": bench_autopilot,
 }
 
@@ -2451,7 +2802,8 @@ def main():
                     help=argparse.SUPPRESS)   # internal: --gate child
     args = ap.parse_args()
 
-    if args.config in ("comms", "embedding", "traffic") and not args.all:
+    if args.config in ("comms", "embedding", "traffic", "disagg") \
+            and not args.all:
         # the comms sweep and the sharded-embedding exchange want the
         # 8-device mesh; on a CPU box that
         # means the forced host-platform device count, and it must be
@@ -2480,7 +2832,8 @@ def main():
     from paddle_tpu import observability as obs
     names = list(CONFIGS) if args.all else [args.config]
     for name in names:
-        if name in ("comms", "embedding", "traffic") and args.all:
+        if name in ("comms", "embedding", "traffic", "disagg") \
+                and args.all:
             # device topology is process-global: these configs' forced
             # 8-device mesh must not re-topology the other configs of
             # an --all run, so each gets its own process (which
@@ -2503,11 +2856,13 @@ def main():
                         "comms": "comms_bytes_per_sec",
                         "embedding": "embedding_lookup_rows_per_sec",
                         "traffic": "traffic_req_per_replica_s_at_slo",
+                        "disagg": "disagg_req_per_replica_s",
                     }[name],
                     "value": None,
                     "unit": {"comms": "bytes/s",
                              "embedding": "rows/s",
-                             "traffic": "req/s/replica"}[name],
+                             "traffic": "req/s/replica",
+                             "disagg": "req/s/replica"}[name],
                     "vs_baseline": 0.0,
                     "extra": {"error": f"{name} child failed",
                               "rc": child.returncode,

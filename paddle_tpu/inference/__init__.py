@@ -159,3 +159,8 @@ from .autoscaler import (Autoscaler, RouterActuator,  # noqa: E402,F401
                          SCALE_ACTIONS)
 from .traffic import (Cohort, TrafficModel,  # noqa: E402,F401
                       TrafficEvent, run_traffic)
+# prefill/decode disaggregation: role-based replica pools with
+# cross-process KV-page migration (see README "Prefill/decode
+# disaggregation")
+from .disagg import (DisaggRouter, DisaggActuator,  # noqa: E402,F401
+                     ROLES, PROCESS_ROLES)

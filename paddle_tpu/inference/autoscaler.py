@@ -213,8 +213,15 @@ class Autoscaler:
             "capacity_req_per_s": self._capacity(),
         }
         self._journal(dict(rec, state="pending"))
-        name = self.actuator.grow() if action == "grow" \
-            else self.actuator.retire()
+        if action == "grow":
+            # role-aware actuators (inference.disagg) expose grow_for
+            # and route the decision by the breached series — TTFT
+            # breaches grow the prefill pool, TPOT the decode pool
+            grow_for = getattr(self.actuator, "grow_for", None)
+            name = grow_for(trigger) if callable(grow_for) \
+                else self.actuator.grow()
+        else:
+            name = self.actuator.retire()
         if name is None:
             # the actuator refused (e.g. retiring would strand the
             # last live replica) — journal the abort so the intent

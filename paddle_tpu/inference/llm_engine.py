@@ -965,6 +965,57 @@ class LLMEngine:
         return (bool(self.waiting) or bool(self._failed)
                 or any(s is not None for s in self.slots))
 
+    # -- KV-page migration (prefill/decode disaggregation) -----------------
+    def _kv_scale_digest(self) -> Optional[str]:
+        """Content digest of the int8 quant scales (None on fp pools).
+        Migrated int8 page bytes are only meaningful under the SAME
+        static scales, so the digest rides every migration chunk and
+        the importer refuses a mismatch."""
+        if self._kq is None:
+            return None
+        dig = getattr(self, "_kq_digest", None)
+        if dig is None:
+            import hashlib
+            # scales are small, immutable engine config; one host read
+            dig = hashlib.sha256(
+                np.asarray(self._kq, np.float32).tobytes()  # graftlint: disable=host-sync
+                + np.asarray(self._vq, np.float32).tobytes()  # graftlint: disable=host-sync
+            ).hexdigest()
+            self._kq_digest = dig
+        return dig
+
+    def export_kv_pages(self, hashes: list, start: int = 0,
+                        limit: Optional[int] = None) -> dict:
+        """One migration chunk: the committed pages for
+        `hashes[start:start+limit]` (stopping at the first hash this
+        pool does not hold) plus the pool-compatibility metadata the
+        importer validates — geometry, cache dtype, int8-scale digest.
+        The disagg driver ships consecutive chunks sequence-numbered;
+        see README "Prefill/decode disaggregation" for the wire
+        format."""
+        meta = self.cache.page_meta()
+        meta["kv_scale_digest"] = self._kv_scale_digest()
+        return {"v": 1, "start": int(start), "meta": meta,
+                "pages": self.cache.export_pages(hashes, start, limit)}
+
+    def import_kv_pages(self, payload: dict) -> int:
+        """Register one migration chunk's pages in this engine's pool
+        (parked in the prefix-cache LRU, leased on the next matching
+        admission). Raises ValueError on any pool-compatibility
+        mismatch — migrated bytes are only valid bit-for-bit on an
+        identically-provisioned pool; the disagg driver degrades to
+        prefix-hash re-admission. Returns how many of the chunk's
+        pages are now resident (pool exhaustion imports a valid chain
+        prefix and stops)."""
+        meta = dict(payload.get("meta") or {})
+        mine = self.cache.page_meta()
+        mine["kv_scale_digest"] = self._kv_scale_digest()
+        if payload.get("v") != 1 or meta != mine:
+            raise ValueError(
+                "incompatible KV-page migration chunk: peer pool %r "
+                "vs local %r" % (meta, mine))
+        return self.cache.import_pages(payload.get("pages") or [])
+
     # -- scheduling --------------------------------------------------------
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self.slots):

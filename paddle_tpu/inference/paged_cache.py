@@ -164,6 +164,8 @@ class PagedKVCache:
         byte-for-byte the pre-caching behavior."""
         self.num_layers = num_layers
         self.block_size = block_size
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
         if layout not in ("block", "token"):
             raise ValueError(f"unknown cache layout {layout!r}")
         self.layout = layout
@@ -366,6 +368,116 @@ class PagedKVCache:
                         arr[src * bs:(src + 1) * bs])
                 else:
                     caches[li] = arr.at[dst].set(arr[src])
+
+    # -- cross-pool page migration (prefill/decode disaggregation) ---------
+    def export_pages(self, hashes: List[bytes], start: int = 0,
+                     limit: Optional[int] = None) -> List[dict]:
+        """Serialize committed content-addressed pages for a chained
+        hash prefix, for KV-page migration between pools (see
+        inference/disagg.py). Walks `hashes[start:start+limit]` IN
+        CHAIN ORDER and stops at the first hash this pool does not
+        hold — an exported slice is always a contiguous extension of
+        the chain, so the importer never registers a page whose
+        ancestors are missing. Each entry carries the page's raw pool
+        rows for every layer (host copies — the page bytes are the
+        migration payload) plus the hash that addresses it. Leased and
+        parked pages both export (reads only; refcounts untouched)."""
+        out: List[dict] = []
+        if not self.enable_prefix_caching:
+            return out
+        bs = self.block_size
+        stop = len(hashes) if limit is None else \
+            min(len(hashes), start + int(limit))
+        for h in hashes[start:stop]:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            if self.layout == "token":
+                sl = slice(page * bs, (page + 1) * bs)
+            else:
+                sl = page
+            # page rows leave the device here by design: migration
+            # ships raw cache bytes over host RPC
+            k = np.stack([np.asarray(kc[sl])  # graftlint: disable=host-sync
+                          for kc in self.key_caches])
+            v = np.stack([np.asarray(vc[sl])  # graftlint: disable=host-sync
+                          for vc in self.value_caches])
+            out.append({"hash": h, "k": k, "v": v})
+        return out
+
+    def import_pages(self, pages: List[dict]) -> int:
+        """Register migrated pages under their content hashes: each
+        entry from a peer pool's `export_pages` is written into a
+        freshly allocated block and PARKED in the LRU (refcount held by
+        the LRU, exactly like a finished sequence's committed page), so
+        a later `add_sequence(match=...)` leases it as a normal prefix
+        hit and pool pressure can evict it first. Entries must arrive
+        in chain order (export_pages guarantees it per slice; the
+        migration driver ships slices in sequence). Already-present
+        hashes count as imported without touching the pool (first
+        writer wins, same as commit_prefix). Stops cleanly at pool
+        exhaustion — the chain prefix registered so far stays valid and
+        re-admission falls back to re-prefilling the tail. Returns how
+        many of `pages` are now resident."""
+        if not self.enable_prefix_caching:
+            return 0
+        bs = self.block_size
+        done = 0
+        placed: set = set()     # this chain's pages — never evicted
+        for ent in pages:
+            h = ent["hash"]
+            page = self._hash_to_page.get(h)
+            if page is not None:
+                placed.add(page)
+                done += 1
+                continue
+            if self.allocator.num_free < 1:
+                # displace the coldest parked page that is NOT part of
+                # the chain being imported — _alloc's oldest-first
+                # eviction would cannibalize the pages this very call
+                # just registered and break its own chain
+                victim = next((p for p in self._lru
+                               if p not in placed), None)
+                if victim is None:
+                    break
+                vh = self._lru.pop(victim)
+                del self._hash_to_page[vh]
+                del self._page_hash[victim]
+                self.allocator.free([victim])
+            try:
+                (page,) = self.allocator.alloc(1)
+            except MemoryError:
+                break
+            k, v = ent["k"], ent["v"]
+            if self.layout == "token":
+                sl = slice(page * bs, (page + 1) * bs)
+            else:
+                sl = page
+            for li in range(self.num_layers):
+                self.key_caches[li] = \
+                    self.key_caches[li].at[sl].set(
+                        jnp.asarray(k[li], self.key_caches[li].dtype))
+                self.value_caches[li] = \
+                    self.value_caches[li].at[sl].set(
+                        jnp.asarray(v[li], self.value_caches[li].dtype))
+            self._hash_to_page[h] = page
+            self._page_hash[page] = h
+            self._lru[page] = h         # parked: LRU inherits the ref
+            placed.add(page)
+            done += 1
+        return done
+
+    def page_meta(self) -> dict:
+        """Pool-compatibility metadata shipped with every migration
+        chunk: an importer refuses pages whose geometry or dtype does
+        not match its own pool byte-for-byte."""
+        return {
+            "num_layers": int(self.num_layers),
+            "block_size": int(self.block_size),
+            "kv_heads": int(self.kv_heads),
+            "head_dim": int(self.head_dim),
+            "dtype": str(self.key_caches[0].dtype),
+        }
 
     # -- capacity views ----------------------------------------------------
     @property

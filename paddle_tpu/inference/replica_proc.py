@@ -112,6 +112,15 @@ def _w_exec_cache_stats():
     return store.stats() if store is not None else {}
 
 
+def _w_export_kv_pages(hashes, start=0, limit=None):
+    return _WORKER["engine"].export_kv_pages(hashes, start=start,
+                                             limit=limit)
+
+
+def _w_import_kv_pages(payload):
+    return int(_WORKER["engine"].import_kv_pages(payload))
+
+
 def _w_shutdown():
     _WORKER["stop"].set()
     return True
@@ -122,11 +131,15 @@ def _w_shutdown():
 # ---------------------------------------------------------------------------
 def _worker_main(model_builder, model_kwargs, engine_kwargs, tp,
                  shard_param, exec_cache_dir, bind, process_name,
-                 aggregator_endpoint, ready_q):
+                 aggregator_endpoint, ready_q, role=None):
     """Body of the replica process. Builds model + engine, serves the
     transport contract, ships fleet telemetry, then parks until
     _w_shutdown (or SIGKILL — the chaos path — in which case the
-    parent's next RPC raises and becomes ReplicaGone)."""
+    parent's next RPC raises and becomes ReplicaGone). `role` is the
+    fleet process_role this replica self-identifies as — "engine" by
+    default; a disaggregated pool passes "engine_prefill" /
+    "engine_decode" so telemetry, capacity lines and perf-ledger
+    baselines split per role."""
     from ..observability import fleet as _ofleet
     from ..observability import metrics as _om
     from ..distributed import rpc as _rpc
@@ -134,9 +147,10 @@ def _worker_main(model_builder, model_kwargs, engine_kwargs, tp,
     try:
         _om.enable()
         if process_name:
-            _ofleet.set_identity(process=process_name, role="engine")
+            _ofleet.set_identity(process=process_name,
+                                 role=role or "engine")
         else:
-            _ofleet.suggest_role("engine")
+            _ofleet.suggest_role(role or "engine")
 
         model = model_builder(**(model_kwargs or {}))
         mesh = None
@@ -211,6 +225,12 @@ class _ProcCacheProxy:
     served over RPC. Affinity is an optimization, never a correctness
     edge: any transport hiccup degrades to 'nothing cached here' and
     the next step() RPC surfaces the real failure as ReplicaGone."""
+
+    # the router's affinity scorer batches peeks of remote caches into
+    # one concurrent RPC round per admission (a serial per-replica
+    # probe would add one round-trip of routing latency per pool
+    # member)
+    remote = True
 
     def __init__(self, client: "ReplicaProcessClient",
                  enable_prefix_caching: bool, block_size: int):
@@ -323,6 +343,15 @@ class ReplicaProcessClient:
     def has_unfinished(self) -> bool:
         return self._has_unfinished
 
+    # -- KV-page migration (disagg handoff) ---------------------------
+    def export_kv_pages(self, hashes, start: int = 0,
+                        limit: Optional[int] = None) -> dict:
+        return self._call(_w_export_kv_pages, list(hashes),
+                          start=int(start), limit=limit)
+
+    def import_kv_pages(self, payload: dict) -> int:
+        return int(self._call(_w_import_kv_pages, payload))
+
     # -- introspection / lifecycle ------------------------------------
     def compile_outcomes(self) -> Dict[Tuple[str, str], float]:
         return self._call(_w_compile_outcomes)
@@ -355,6 +384,7 @@ def start_replica_process(model_builder, model_kwargs=None,
                           exec_cache_dir: Optional[str] = None,
                           aggregator_endpoint: Optional[str] = None,
                           process_name: Optional[str] = None,
+                          role: Optional[str] = None,
                           bind: str = "127.0.0.1",
                           start_timeout_s: float = 600.0,
                           step_timeout_s: float = 600.0,
@@ -364,14 +394,16 @@ def start_replica_process(model_builder, model_kwargs=None,
     module-level importable callables (the spawn context and the RPC
     layer both pickle by reference). The worker inherits the parent's
     environment — set XLA_FLAGS/JAX_PLATFORMS before calling when the
-    replica needs a forced device population."""
+    replica needs a forced device population. `role`: the fleet
+    process_role the worker identifies as (default "engine"; a
+    disaggregated pool uses "engine_prefill" / "engine_decode")."""
     ctx = ctx or multiprocessing.get_context("spawn")
     ready_q = ctx.Queue()
     proc = ctx.Process(
         target=_worker_main,
         args=(model_builder, model_kwargs, engine_kwargs, tp,
               shard_param, exec_cache_dir, bind, process_name,
-              aggregator_endpoint, ready_q),
+              aggregator_endpoint, ready_q, role),
         daemon=True)
     proc.start()
     deadline = time.monotonic() + start_timeout_s
@@ -401,18 +433,22 @@ def process_engine_factory(model_builder, model_kwargs=None,
                            shard_param=None, exec_cache_dir=None,
                            aggregator_endpoint=None,
                            name_prefix: str = "engine",
+                           role: Optional[str] = None,
                            **spawn_kwargs):
     """An `engine_factory` for Router(...) whose replicas are worker
     PROCESSES. The router's breaker calls factory(i) again after a
     crash; the replacement keeps the replica's stable fleet name (the
     aggregator's pid-change detection counts the restart) and — when
     `exec_cache_dir` is shared — reintegrates WARM from disk instead
-    of recompiling."""
+    of recompiling. `role` names the pool for a disaggregated fleet
+    (see `inference.disagg`): every replica this factory spawns ships
+    telemetry and capacity lines under that process_role."""
     def factory(idx: int) -> ReplicaProcessClient:
         return start_replica_process(
             model_builder, model_kwargs, engine_kwargs, tp=tp,
             shard_param=shard_param, exec_cache_dir=exec_cache_dir,
             aggregator_endpoint=aggregator_endpoint,
             process_name="%s-%d" % (name_prefix, idx),
+            role=role,
             **spawn_kwargs)
     return factory

@@ -271,6 +271,7 @@ class Router:
 
     def __init__(self, engine_factory, n_replicas: int = 2, *,
                  affinity: bool = True,
+                 affinity_max_inflight_factor: Optional[float] = 2.0,
                  max_inflight: Optional[int] = None,
                  unhealthy_step_s: Optional[float] = None,
                  cooldown_s: float = 0.25,
@@ -282,6 +283,14 @@ class Router:
                  session_cache_size: int = 4096):
         """affinity: route on the prefix-cache peek (False = pure
         least-loaded; the A/B the router bench measures).
+        affinity_max_inflight_factor: load headroom on the affinity
+        pick — when the cached replica's inflight (counting this
+        request) exceeds this factor times the least-loaded live
+        candidate's, the pick falls back to least-loaded instead:
+        re-prefilling a shared prefix on an idle replica beats
+        queueing behind the pile affinity concentrated (session
+        affinity erases fleet pipelining otherwise — the PR 19
+        traffic-harness gotcha). None = always honor affinity.
         max_inflight: admission cap PER HEALTHY REPLICA — total
         accepted-and-unfinished requests above max_inflight *
         len(live) shed with reason "capacity"; None = never shed on
@@ -306,6 +315,9 @@ class Router:
         _ofleet.suggest_role("router")
         self.replicas = ReplicaSet(engine_factory, n_replicas)
         self.affinity = bool(affinity)
+        self.affinity_max_inflight_factor = (
+            float(affinity_max_inflight_factor)
+            if affinity_max_inflight_factor is not None else None)
         self.max_inflight = max_inflight
         self.unhealthy_step_s = unhealthy_step_s
         self.cooldown_s = float(cooldown_s)
@@ -323,6 +335,7 @@ class Router:
             = collections.OrderedDict()
         self._ema_serve_s: Optional[float] = None
         self._step_pool = None          # lazy: concurrent fleet steps
+        self._probe_pool = None         # lazy: concurrent cache peeks
         self._retired_replica_s = 0.0   # replica-seconds of retirees
         # per-router exact counts (plain dict — bench/tests read it;
         # the process-global series carry the same numbers)
@@ -402,23 +415,70 @@ class Router:
         self._dispatch(req)
 
     # -- routing -----------------------------------------------------------
+    def _route_candidates(self, req: _RoutedRequest
+                          ) -> List[ReplicaHandle]:
+        """Live replicas eligible to serve `req` — the hook a
+        disaggregated router (inference.disagg) narrows to one role
+        pool, so a prefill admission never probes (or lands on) the
+        decode pool."""
+        return self.replicas.live()
+
+    def _probe_affinity(self, req: _RoutedRequest, live
+                        ) -> Dict[ReplicaHandle, int]:
+        """Per-candidate cached-prefix peeks for the affinity scorer.
+        Remote (process-backed) caches answer over RPC, so they are
+        probed CONCURRENTLY — one RPC round per admission instead of
+        one serial round-trip per pool member. Returns
+        {handle: ncached_tokens} (candidates without prefix caching
+        are absent — they score 0)."""
+        cands = [h for h in live
+                 if h.engine.cache.enable_prefix_caching]
+        if not cands:
+            return {}
+        if req.hashes is None:  # hash the prompt ONCE — the chain is
+            # reused across replicas, re-routes, and (via add_request)
+            # the engine scheduler itself
+            req.hashes = cands[0].engine.cache.block_hashes(req.prompt)
+        if not req.hashes:      # sub-page prompt: nothing can match
+            return {}
+        out: Dict[ReplicaHandle, int] = {}
+        remote = [h for h in cands
+                  if getattr(h.engine.cache, "remote", False)]
+        if len(remote) > 1:
+            import concurrent.futures as _cf
+            if self._probe_pool is None or \
+                    self._probe_pool._max_workers < len(remote):
+                if self._probe_pool is not None:
+                    self._probe_pool.shutdown(wait=False)
+                self._probe_pool = _cf.ThreadPoolExecutor(
+                    max_workers=max(4, len(remote)),
+                    thread_name_prefix="router-probe")
+            futs = [(h, self._probe_pool.submit(
+                h.engine.cache.match_prefix, req.prompt, req.hashes))
+                for h in remote]
+            for h, f in futs:
+                out[h] = f.result()[0]
+        for h in cands:
+            if h not in out:
+                out[h] = h.engine.cache.match_prefix(
+                    req.prompt, req.hashes)[0]
+        return out
+
     def _route(self, req: _RoutedRequest) -> ReplicaHandle:
         """Pick a live replica: longest prefix-cache peek first
         (affinity), then the session's sticky replica, then
-        least-loaded (lowest index on ties — deterministic)."""
-        live = self.replicas.live()
-        best, best_cached = None, 0
+        least-loaded (lowest index on ties — deterministic). An
+        affinity/sticky pick whose inflight has blown the
+        `affinity_max_inflight_factor` headroom over the least-loaded
+        candidate is abandoned for least-loaded."""
+        live = self._route_candidates(req)
+        best = None
+        cached: Dict[ReplicaHandle, int] = {}
         if self.affinity:
+            cached = self._probe_affinity(req, live)
+            best_cached = 0
             for h in live:
-                cache = h.engine.cache
-                if not cache.enable_prefix_caching:
-                    continue
-                if req.hashes is None:  # hash the prompt ONCE — the
-                    # chain is reused across replicas, re-routes, and
-                    # (via add_request) the engine scheduler itself
-                    req.hashes = cache.block_hashes(req.prompt)
-                ncached, _pages = cache.match_prefix(req.prompt,
-                                                     req.hashes)
+                ncached = cached.get(h, 0)
                 if ncached > best_cached or (
                         ncached == best_cached and ncached > 0
                         and best is not None and h.load < best.load):
@@ -428,10 +488,18 @@ class Router:
                 # session's first turn has committed any block (and
                 # prompts shorter than a page, which never index)
                 sticky = self._sessions.get(req.session)
-                if sticky is not None and sticky.live:
+                if sticky is not None and sticky.live \
+                        and sticky in live:
                     best = sticky
+        if best is not None and \
+                self.affinity_max_inflight_factor is not None:
+            lmin = min(h.load for h in live)
+            if best.load + 1 > \
+                    self.affinity_max_inflight_factor * (lmin + 1):
+                best = None     # headroom blown — spread the load
         if best is None:
             best = min(live, key=lambda h: (h.load, h.idx))
+        best_cached = cached.get(best, 0)
         self.stats["affinity_hit_tokens"] += best_cached
         self.stats["affinity_miss_tokens"] += \
             len(req.prompt) - best_cached

@@ -40,6 +40,9 @@ Registered fault points (grep `fault_point(` for ground truth):
     supervisor.act            training-autopilot supervisor, before each
                               remediation action commits (ctx: action,
                               kind, process)
+    disagg.migrate            prefill->decode handoff, once per shipped
+                              KV-page chunk, after export / before
+                              import (ctx: request, seq, pages)
 
 Injection specs support:
 

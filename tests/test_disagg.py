@@ -1,0 +1,461 @@
+"""Prefill/decode disaggregation (inference/disagg.py): role pools,
+KV-page migration, and the handoff rungs.
+
+Oracle: a role-less single LLMEngine (itself oracle-pinned against
+models.generation.generate in test_llm_engine). Greedy decoding is
+deterministic, so the disaggregated fleet's outputs must be
+bit-identical whichever handoff rung served each request — real page
+migration, prefix-hash re-admission, or the fallback after a prefill
+replica was SIGKILLed mid-migration."""
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import (DisaggActuator, DisaggRouter,
+                                  LLMEngine, calibrate_kv_scales)
+from paddle_tpu.models import GPTForCausalLM
+from paddle_tpu.models.gpt import gpt_tiny
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.resilience import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENGINE_KW = dict(max_batch=2, block_size=16, decode_chunk=4,
+                 prompt_quantum=16, max_model_len=96)
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    pt.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    pt.seed(0)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear_all()
+    obs.disable()
+    obs.reset()
+    yield
+    faults.clear_all()
+    obs.disable()
+    obs.reset()
+
+
+def _factory(model, **overrides):
+    kw = dict(ENGINE_KW, **overrides)
+
+    def make(_i):
+        return LLMEngine(model, **kw)
+    return make
+
+
+def _prompts(lengths, seed=0, vocab=128):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (int(n),)).astype(np.int32)
+            for n in lengths]
+
+
+def _oracle(model, prompts, n_new, **overrides):
+    eng = _factory(model, **overrides)(0)
+    out = {}
+    for i, p in enumerate(prompts):
+        eng.add_request(i, p, n_new)
+    while eng.has_unfinished:
+        for r in eng.step():
+            assert r.ok, r.error
+            out[r.request_id] = tuple(int(t) for t in r.output_ids)
+    return out
+
+
+def _reconciled(engine):
+    """Idle-pool invariant: every page is free or parked reusable —
+    only the engine's trash page stays leased."""
+    c = engine.cache
+    return c.available_blocks == c.allocator.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# KV-page serialization round-trip (the migration wire format)
+# ---------------------------------------------------------------------------
+class TestKVPageRoundTrip:
+    def _roundtrip(self, model, **engine_overrides):
+        prompts = _prompts((41, 37), seed=3)
+        src = _factory(model, **engine_overrides)(0)
+        dst = _factory(model, **engine_overrides)(1)
+        for i, p in enumerate(prompts):
+            src.add_request(i, p, 4)
+        while src.has_unfinished:
+            src.step()
+
+        p = prompts[0]
+        hashes = src.cache.block_hashes(p)
+        assert len(hashes) >= 2
+        payload = src.export_kv_pages(hashes)
+        assert payload["v"] == 1 and payload["start"] == 0
+        assert len(payload["pages"]) == len(hashes)
+        assert [e["hash"] for e in payload["pages"]] == list(hashes)
+
+        n = dst.import_kv_pages(payload)
+        assert n == len(hashes)
+        # registered under the same hashes: a full-chain peek hits
+        ncached, pages = dst.cache.match_prefix(p, hashes)
+        assert len(pages) == len(hashes)
+        assert ncached == len(hashes) * dst.block_size
+        # page BYTES survive the trip bit-exactly (rope'd keys, int8
+        # codes — whatever the pool dtype holds)
+        back = dst.export_kv_pages(hashes)
+        for a, b in zip(payload["pages"], back["pages"]):
+            np.testing.assert_array_equal(a["k"], b["k"])
+            np.testing.assert_array_equal(a["v"], b["v"])
+        # import is idempotent (re-delivered chunk after a retry)
+        assert dst.import_kv_pages(payload) == len(hashes)
+        assert _reconciled(src) and _reconciled(dst)
+
+        # the migrated prefix is SERVABLE: admission with the same
+        # hash chain leases the imported pages and greedy decode
+        # matches the source engine bit-for-bit
+        want = _oracle(model, [p], 6, **engine_overrides)[0]
+        dst.add_request("re", p, 6, prefix_hashes=hashes)
+        got = []
+        while dst.has_unfinished:
+            for r in dst.step():
+                assert r.ok, r.error
+                got = tuple(int(t) for t in r.output_ids)
+        assert got == want
+        assert dst.stats["prefix_cache_hit_tokens"] >= \
+            len(hashes) * dst.block_size
+
+    def test_roundtrip_fp_llama_rope_layout(self, tiny_llama):
+        """LLaMA pools hold ROPE'D keys — the wire format must ship
+        them verbatim (re-rotating would corrupt the chain)."""
+        self._roundtrip(tiny_llama)
+
+    def test_roundtrip_int8_pool(self, tiny_gpt):
+        scales = calibrate_kv_scales(
+            tiny_gpt, _prompts((24,), seed=9)[0][None])
+        self._roundtrip(tiny_gpt, kv_quant_scales=scales)
+
+    def test_scale_mismatch_rejected(self, tiny_gpt):
+        """int8 pages are raw codes — importing them under different
+        quant scales would silently decode garbage, so mismatched
+        scale digests must be refused (the fallback rung serves)."""
+        p = _prompts((41,), seed=3)[0]
+        s1 = calibrate_kv_scales(tiny_gpt, p[None])
+        src = _factory(tiny_gpt, kv_quant_scales=s1)(0)
+        dst = _factory(tiny_gpt, kv_quant_scales=(
+            s1[0] * 2.0, s1[1] * 2.0))(1)
+        src.generate([p], max_new_tokens=2)
+        hashes = src.cache.block_hashes(p)
+        payload = src.export_kv_pages(hashes)
+        with pytest.raises(ValueError, match="incompatible"):
+            dst.import_kv_pages(payload)
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated serving: both handoff rungs bit-identical
+# ---------------------------------------------------------------------------
+class TestDisaggBitExact:
+    N_NEW = 12
+
+    def _serve(self, router, prompts, n_new=N_NEW):
+        for i, p in enumerate(prompts):
+            router.submit(i, p, max_new_tokens=n_new)
+        got = {}
+        deadline = time.monotonic() + 300
+        while router.has_unfinished:
+            assert time.monotonic() < deadline, "drain wedged"
+            for r in router.step():
+                assert r.ok, (r.request_id, r.finish_reason, r.error)
+                got[r.request_id] = tuple(int(t) for t in r.output_ids)
+        return got
+
+    def test_migrated_rung_bit_identical(self, tiny_gpt):
+        prompts = _prompts((37, 20, 45, 33), seed=0)
+        want = _oracle(tiny_gpt, prompts, self.N_NEW)
+        router = DisaggRouter(_factory(tiny_gpt),
+                              n_prefill=1, n_decode=1)
+        got = self._serve(router, prompts)
+        assert got == want
+        # handoff accounting: one handoff per completed session, the
+        # migrated path dominant under the default config (every
+        # prompt here spans >= 1 full block)
+        s = router.stats
+        assert s["handoffs"] == len(prompts)
+        assert s["handoff_migrated"] == len(prompts)
+        assert s["handoff_fallback"] == 0
+        assert s["migrated_bytes"] > 0
+        for h in router.replicas:
+            assert _reconciled(h.engine)
+        # every request ran both stages: prefill pool routed N, decode
+        # pool routed N more
+        assert s["routed"] == 2 * len(prompts)
+
+    def test_readmission_rung_bit_identical(self, tiny_gpt):
+        """migrate=False pins the degraded rung: the decode replica
+        re-prefills from the original prompt."""
+        prompts = _prompts((37, 20, 45, 33), seed=0)
+        want = _oracle(tiny_gpt, prompts, self.N_NEW)
+        router = DisaggRouter(_factory(tiny_gpt), migrate=False,
+                              n_prefill=1, n_decode=1)
+        got = self._serve(router, prompts)
+        assert got == want
+        s = router.stats
+        assert s["handoffs"] == len(prompts)
+        assert s["handoff_readmitted"] == len(prompts)
+        assert s["migrated_bytes"] == 0
+
+    def test_single_token_requests_skip_handoff(self, tiny_gpt):
+        """max_new_tokens=1 IS pure prefill — it serves one-stage on
+        the prefill pool, no decode handoff."""
+        prompts = _prompts((37, 20), seed=0)
+        want = _oracle(tiny_gpt, prompts, 1)
+        router = DisaggRouter(_factory(tiny_gpt),
+                              n_prefill=1, n_decode=1)
+        got = self._serve(router, prompts, n_new=1)
+        assert got == want
+        assert router.stats["handoffs"] == 0
+
+    def test_decode_pool_lost_degrades(self, tiny_gpt):
+        """An empty decode pool must not strand handoffs: candidates
+        degrade to the whole live set and the prefill replica serves
+        the decode stage itself."""
+        router = DisaggRouter(_factory(tiny_gpt),
+                              n_prefill=1, n_decode=1)
+        prompts = _prompts((37, 33), seed=1)
+        want = _oracle(tiny_gpt, prompts, self.N_NEW)
+        (decode_h,) = router.pool("decode")
+        assert router.retire_replica(decode_h.name) == decode_h.name
+        got = self._serve(router, prompts)
+        assert got == want
+        assert router.stats["handoffs"] == len(prompts)
+
+
+# ---------------------------------------------------------------------------
+# Migration under LRU-eviction pressure on the receiving pool
+# ---------------------------------------------------------------------------
+class TestMigrationUnderPressure:
+    def test_partial_import_into_tiny_pool(self, tiny_gpt):
+        """The receiving pool can't hold the chain: import registers a
+        valid PREFIX, reports the shortfall, and leaks nothing."""
+        p = _prompts((65,), seed=4)[0]      # 4 full blocks
+        src = _factory(tiny_gpt)(0)
+        # 4 blocks: 1 leased trash page + 3 free — one short of the
+        # 4-block chain, so the import MUST stop partial (it never
+        # evicts its own just-imported chain to place the tail)
+        dst = _factory(tiny_gpt, num_blocks=4)(1)
+        src.generate([p], max_new_tokens=2)
+        hashes = src.cache.block_hashes(p)
+        assert len(hashes) == 4
+        n = dst.import_kv_pages(src.export_kv_pages(hashes))
+        assert 0 < n < len(hashes)
+        # whatever landed is a chain PREFIX — match_prefix walks it
+        ncached, pages = dst.cache.match_prefix(p, hashes)
+        assert len(pages) == n
+        assert _reconciled(dst)
+
+    def test_evicted_before_readmission_falls_back(self, tiny_gpt):
+        """Migrated pages evicted (LRU churn) between import and
+        re-admission: the engine re-prefills the tail from the
+        original prompt — outputs identical, allocator reconciled."""
+        p = _prompts((65,), seed=4)[0]
+        want = _oracle(tiny_gpt, [p], 6)[0]
+        src = _factory(tiny_gpt)(0)
+        dst = _factory(tiny_gpt, num_blocks=12)(1)
+        src.generate([p], max_new_tokens=2)
+        hashes = src.cache.block_hashes(p)
+        assert dst.import_kv_pages(src.export_kv_pages(hashes)) \
+            == len(hashes)
+        # churn the receiving pool until the migrated chain is gone
+        churn = _prompts((65, 65, 65), seed=7)
+        dst.generate(churn, max_new_tokens=2)
+        ncached, _pages = dst.cache.match_prefix(p, hashes)
+        assert ncached < len(hashes) * dst.block_size
+        # re-admission with the full hash chain still serves exactly:
+        # the scheduler leases whatever prefix survived and
+        # re-prefills the evicted tail
+        dst.add_request("re", p, 6, prefix_hashes=hashes)
+        got = None
+        while dst.has_unfinished:
+            for r in dst.step():
+                assert r.ok, r.error
+                got = tuple(int(t) for t in r.output_ids)
+        assert got == want
+        assert _reconciled(dst)
+
+
+# ---------------------------------------------------------------------------
+# Role-aware elastic scaling
+# ---------------------------------------------------------------------------
+class TestDisaggScaling:
+    def test_grow_for_routes_by_breached_series(self, tiny_gpt):
+        router = DisaggRouter(_factory(tiny_gpt),
+                              n_prefill=1, n_decode=1)
+        act = DisaggActuator(router)
+        assert act.replicas() == 2
+        act.grow_for({"series": "paddle_tpu_request_ttft_seconds",
+                      "slo": "ttft_p95"})
+        assert len(router.pool("prefill")) == 2
+        act.grow_for({"series": "paddle_tpu_request_tpot_seconds",
+                      "slo": "tpot_p95"})
+        assert len(router.pool("decode")) == 2
+        # unknown series balances; pools are even, so either grows
+        act.grow_for({"series": "paddle_tpu_request_e2e_seconds"})
+        assert act.replicas() == 5
+
+    def test_retire_never_strands_a_role(self, tiny_gpt):
+        router = DisaggRouter(_factory(tiny_gpt),
+                              n_prefill=2, n_decode=1)
+        act = DisaggActuator(router)
+        name = act.retire()     # only prefill can spare one
+        assert name is not None
+        assert len(router.pool("prefill")) == 1
+        assert len(router.pool("decode")) == 1
+        assert act.retire() is None     # both pools at 1 — refuse
+
+    def test_replica_keeps_role_across_restart(self, tiny_gpt):
+        from paddle_tpu.inference import ReplicaGone
+        router = DisaggRouter(_factory(tiny_gpt), n_prefill=1,
+                              n_decode=1, cooldown_s=0.0)
+        (h,) = router.pool("prefill")
+        router._fail_replica(h, ReplicaGone("chaos"))
+        assert not h.live
+        router.step()           # cooldown elapsed -> reintegrate
+        assert h.live and h.role == "prefill"
+        assert router.pool("prefill") == [h]
+
+
+# ---------------------------------------------------------------------------
+# Chaos: prefill replica SIGKILLed mid-migration (process fleet)
+# ---------------------------------------------------------------------------
+def _chaos_model():
+    """Module-level so the replica spawn context can pickle it by
+    reference (the worker re-imports this test module)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    pt.seed(0)
+    return GPTForCausalLM(gpt_tiny())
+
+
+CHAOS_ENGINE_KW = dict(max_batch=4, block_size=16, decode_chunk=4,
+                       prompt_quantum=16, max_model_len=96)
+
+
+class TestChaosMidMigration:
+    def test_sigkill_prefill_mid_migration_falls_back(self, tmp_path):
+        """The prefill replica dies between migration chunks: the
+        in-handoff request falls back to re-admission on the decode
+        pool and every output stays bit-identical to a never-killed
+        single engine."""
+        from paddle_tpu.inference.replica_proc import (
+            process_engine_factory)
+
+        prompts = _prompts((37, 41, 45), seed=6)
+        n_new = 8
+        want = _oracle(_chaos_model(), prompts, n_new,
+                       **CHAOS_ENGINE_KW)
+
+        router = DisaggRouter(
+            process_engine_factory(
+                _chaos_model, engine_kwargs=CHAOS_ENGINE_KW,
+                exec_cache_dir=str(tmp_path),
+                name_prefix="disagg-prefill", role="engine_prefill"),
+            process_engine_factory(
+                _chaos_model, engine_kwargs=CHAOS_ENGINE_KW,
+                exec_cache_dir=str(tmp_path),
+                name_prefix="disagg-decode", role="engine_decode"),
+            n_prefill=1, n_decode=1, migrate_chunk_pages=1,
+            cooldown_s=0.05, max_cooldown_s=0.1)
+        try:
+            (prefill_h,) = router.pool("prefill")
+            victim_pid = prefill_h.engine.pid
+            killed = []
+
+            def kill_prefill(ctx):
+                # fires between export and import of chunk 0: the
+                # exported chunk still imports (the decode end is
+                # alive), then the NEXT export RPC finds the peer gone
+                if not killed:
+                    os.kill(victim_pid, signal.SIGKILL)
+                    killed.append(ctx)
+                return True
+            faults.inject("disagg.migrate", delay=0.5, times=1,
+                          when=kill_prefill)
+
+            for i, p in enumerate(prompts):
+                router.submit(i, p, max_new_tokens=n_new)
+            got = {}
+            deadline = time.monotonic() + 300
+            while router.has_unfinished:
+                assert time.monotonic() < deadline, "drain wedged"
+                for r in router.step():
+                    assert r.ok, (r.request_id, r.finish_reason,
+                                  r.error)
+                    got[r.request_id] = tuple(
+                        int(t) for t in r.output_ids)
+
+            assert killed, "chaos never fired"
+            assert got == want
+            s = router.stats
+            assert s["handoffs"] == len(prompts)
+            assert s["handoff_fallback"] >= 1
+            assert s["failovers"] >= 1
+            # the breaker replaced the dead prefill process
+            assert prefill_h.live
+            assert prefill_h.engine.pid != victim_pid
+        finally:
+            faults.clear_all()
+            for h in router.replicas.handles:
+                eng = h.engine
+                if eng is not None:
+                    try:
+                        eng.shutdown(timeout_s=10)
+                    except Exception:
+                        pass
+
+
+# ---------------------------------------------------------------------------
+# obs_top "== disagg ==" panel
+# ---------------------------------------------------------------------------
+class TestObsTopDisaggPanel:
+    def _obs_top(self):
+        tools = os.path.join(REPO, "tools")
+        sys.path.insert(0, tools)
+        try:
+            import obs_top
+        finally:
+            sys.path.remove(tools)
+        return obs_top
+
+    def test_panel_renders(self, tiny_gpt):
+        obs.enable()
+        router = DisaggRouter(_factory(tiny_gpt),
+                              n_prefill=1, n_decode=1)
+        prompts = _prompts((37, 33), seed=0)
+        for i, p in enumerate(prompts):
+            router.submit(i, p, max_new_tokens=6)
+        deadline = time.monotonic() + 300
+        while router.has_unfinished:
+            assert time.monotonic() < deadline
+            router.step()
+        import json
+        obs_top = self._obs_top()
+        out = obs_top.render(json.loads(obs.to_json()))
+        assert "== disagg ==" in out
+        assert "prefill" in out and "decode" in out
+        assert "migrated" in out

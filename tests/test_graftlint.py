@@ -508,6 +508,40 @@ class TestObsDiscipline:
             readme=_README, path="paddle_tpu/inference/router.py")
         assert fs == []
 
+    def test_bad_role_literal_undocumented(self):
+        fs = analyze("""
+            ROLES = ("prefill", "shredder")
+
+            def launch(factory):
+                return factory(role="engine_shredder")
+        """, rules={"role-literal-documented"},
+            readme=_README + " pool roles: prefill decode "
+                             "engine_prefill engine_decode",
+            path="paddle_tpu/inference/disagg.py")
+        assert rule_ids(fs) == ["role-literal-documented"] * 2
+        assert "shredder" in fs[0].message
+        assert "engine_shredder" in fs[1].message
+
+    def test_good_role_literals(self):
+        fs = analyze("""
+            ROLES = ("prefill", "decode")
+            PROCESS_ROLES = ("engine_prefill", "engine_decode")
+
+            def launch(factory):
+                return factory(role="engine_prefill")
+        """, rules={"role-literal-documented"},
+            readme=_README + " pool roles: prefill decode "
+                             "engine_prefill engine_decode",
+            path="paddle_tpu/inference/disagg.py")
+        assert fs == []
+
+    def test_role_rule_scoped_to_inference(self):
+        fs = analyze("""
+            ROLES = ("shredder",)
+        """, rules={"role-literal-documented"},
+            readme=_README, path="paddle_tpu/resilience/thing.py")
+        assert fs == []
+
     def test_good_stats_keys(self):
         fs = analyze("""
             class E:

@@ -140,6 +140,60 @@ class TestRouting:
         while router.has_unfinished:
             router.step()
 
+    def test_affinity_headroom_spreads_load(self, tiny_gpt):
+        """Affinity must not concentrate a hot prefix onto one replica
+        past the headroom factor: once the cached replica's inflight
+        blows `affinity_max_inflight_factor` x the least-loaded's, the
+        pick falls back to least-loaded (the PR 19 traffic-harness
+        gotcha — session affinity erases fleet pipelining)."""
+        rng = np.random.default_rng(9)
+        prefix = rng.integers(0, 1024, (32,)).astype(np.int32)
+        turns = [np.concatenate([prefix, rng.integers(
+            0, 1024, (k,)).astype(np.int32)]) for k in (3, 5, 7, 9)]
+        router = Router(_factory(tiny_gpt), n_replicas=2,
+                        affinity_max_inflight_factor=1.0)
+        # seed the prefix on one replica, drained to idle
+        router.submit("seed", turns[0], max_new_tokens=4)
+        owner = router._owner["seed"].name
+        while router.has_unfinished:
+            router.step()
+        # pile up same-prefix admissions WITHOUT stepping: affinity
+        # wants the owner every time, but at factor 1.0 the owner may
+        # never carry more inflight than the idle replica + 1 — the
+        # overflow spreads
+        for j, p in enumerate(turns):
+            router.submit(f"q{j}", p, max_new_tokens=4)
+        owners = [router._owner[f"q{j}"].name
+                  for j in range(len(turns))]
+        assert owners.count(owner) == 2
+        assert len(set(owners)) == 2        # both replicas carry load
+        while router.has_unfinished:
+            router.step()
+        _assert_no_leaks(router)
+
+    def test_affinity_headroom_none_always_honors_cache(self,
+                                                        tiny_gpt):
+        """factor=None pins the old behavior: affinity wins no matter
+        how lopsided the load gets."""
+        rng = np.random.default_rng(9)
+        prefix = rng.integers(0, 1024, (32,)).astype(np.int32)
+        turns = [np.concatenate([prefix, rng.integers(
+            0, 1024, (k,)).astype(np.int32)]) for k in (3, 5, 7, 9)]
+        router = Router(_factory(tiny_gpt), n_replicas=2,
+                        affinity_max_inflight_factor=None)
+        router.submit("seed", turns[0], max_new_tokens=4)
+        owner = router._owner["seed"].name
+        while router.has_unfinished:
+            router.step()
+        for j, p in enumerate(turns):
+            router.submit(f"q{j}", p, max_new_tokens=4)
+        owners = {router._owner[f"q{j}"].name
+                  for j in range(len(turns))}
+        assert owners == {owner}        # all piled onto the holder
+        while router.has_unfinished:
+            router.step()
+        _assert_no_leaks(router)
+
     def test_duplicate_rid_refused(self, tiny_gpt):
         router = Router(_factory(tiny_gpt), n_replicas=2)
         router.submit("a", _prompts(1)[0], max_new_tokens=4)

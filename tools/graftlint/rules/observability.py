@@ -442,3 +442,50 @@ class AutoscaleActionDocumented(Rule):
                     mod, line,
                     f"autoscaler action '{name}' is not documented in "
                     "the README Serving SLO control plane section")
+
+
+@register
+class RoleLiteralDocumented(Rule):
+    id = "role-literal-documented"
+    family = "obs"
+    severity = "error"
+    invariant = ("every pool-role / process_role string the serving "
+                 "stack can stamp on a replica — literals in *ROLES* "
+                 "tuple vocabularies and role=/process_role= keyword "
+                 "literals under paddle_tpu/inference/ — appears "
+                 "verbatim in the README")
+    history = ("ISSUE 20: role strings split fleet telemetry, "
+               "capacity lines and perf-ledger baselines per pool "
+               "(engine_prefill vs engine_decode); a role value the "
+               "README does not carry is a telemetry partition an "
+               "operator cannot interpret")
+
+    def check(self, mod):
+        if not mod.path.startswith("paddle_tpu/inference/"):
+            return
+        seen: Dict[str, int] = {}
+        for node in ast.walk(mod.tree):
+            # closed vocabularies: ROLES / PROCESS_ROLES = ("...",)
+            if isinstance(node, ast.Assign):
+                targets = [U.dotted(t) or "" for t in node.targets]
+                if any(t.split(".")[-1].endswith("ROLES")
+                       for t in targets) and \
+                        isinstance(node.value, (ast.Tuple, ast.List)):
+                    for el in node.value.elts:
+                        name = _literal_str(el)
+                        if name is not None and name not in seen:
+                            seen[name] = el.lineno
+            # hand-off sites: factory(role="engine_prefill"),
+            # set_identity(process_role="...")
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg in ("role", "process_role"):
+                        name = _literal_str(kw.value)
+                        if name is not None and name not in seen:
+                            seen[name] = kw.value.lineno
+        for name, line in sorted(seen.items(), key=lambda kv: kv[1]):
+            if _readme_missing(name, mod.project.readme):
+                yield self.finding(
+                    mod, line,
+                    f"replica role '{name}' is not documented in the "
+                    "README Prefill/decode disaggregation section")

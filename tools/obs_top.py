@@ -583,6 +583,65 @@ def render(doc, prev=None, dt=None) -> str:
                 row += f"   last={last[0]}"
             lines.append(row)
 
+    # disagg: prefill/decode disaggregation — role pool sizes, handoff
+    # path split, migration throughput, per-role request latency
+    # (README "Prefill/decode disaggregation")
+    pools = {s["labels"]["role"]: int(s["value"]) for s in
+             _series(doc, "paddle_tpu_disagg_pool_replicas")}
+    hand = {s["labels"]["path"]: int(s["value"]) for s in
+            _series(doc, "paddle_tpu_disagg_handoffs_total")
+            if s["value"]}
+    if any(pools.values()) or hand:
+        lines.append("== disagg ==")
+        if pools:
+            lines.append("  pools        " + "  ".join(
+                f"{role}={pools[role]}" for role in sorted(pools)))
+        if hand:
+            lines.append("  handoffs     " + "  ".join(
+                f"{p}={n}" for p, n in sorted(hand.items())))
+        mig = _counter_sum(doc,
+                           "paddle_tpu_disagg_migrated_bytes_total")
+        if mig:
+            mbs = rate("paddle_tpu_disagg_migrated_bytes_total")
+            row = f"  migrated     {mig / 1e6:10.2f} MB"
+            if mbs is not None:
+                row += f"  ({mbs / 1e6:8.2f} MB/s)"
+            lines.append(row)
+        hq = _hist_quantiles(doc, "paddle_tpu_disagg_handoff_seconds",
+                             prev=prev)
+        if hq:
+            lines.append(f"  handoff      p50={_ms(hq['p50'])}  "
+                         f"p95={_ms(hq['p95'])}  n={hq['count']}")
+        # per-role TTFT/TPOT from a fleet-merged doc: a process maps
+        # to its pool via the pid join series' role label, falling
+        # back to the launcher's role-in-name convention
+        # ("disagg-prefill-0")
+        role_of = {s["labels"]["process"]: s["labels"].get("role", "")
+                   for s in _series(doc,
+                                    "paddle_tpu_fleet_process_pid")}
+        for label, name in (
+                ("TTFT", "paddle_tpu_request_ttft_seconds"),
+                ("TPOT", "paddle_tpu_request_tpot_seconds")):
+            rec = doc.get(name)
+            if not rec or rec.get("kind") != "histogram":
+                continue
+            for role in ("prefill", "decode"):
+                counts = None
+                for s in rec["series"]:
+                    proc = s["labels"].get("process")
+                    if proc is None or \
+                            role not in (role_of.get(proc) or proc):
+                        continue
+                    b = s["value"]["buckets"]
+                    counts = b if counts is None else \
+                        [x + y for x, y in zip(counts, b)]
+                if counts and sum(counts):
+                    p95 = quantile_from_buckets(
+                        rec["buckets"], counts, 0.95)
+                    lines.append(
+                        f"  {label} {role:<8} p95={_ms(p95)}  "
+                        f"n={int(sum(counts))}")
+
     fl = _series(doc, "paddle_tpu_flight_bundles_total")
     if fl:
         lines.append("== flight bundles ==")
